@@ -1,0 +1,47 @@
+"""deepspeed_tpu: a TPU-native large-model training & inference framework.
+
+Capability parity with DeepSpeed v0.10.1 (see SURVEY.md), built on
+JAX/XLA/Pallas: sharding-spec ZeRO over a device mesh instead of runtime
+hooks, `jax.lax` collectives over ICI/DCN instead of NCCL, Pallas kernels
+instead of CUDA.
+
+Public surface mirrors the reference (``deepspeed/__init__.py``):
+``initialize`` (:64), ``init_inference`` (:269), ``comm``, ``zero``,
+``add_config_arguments`` (:246).
+"""
+
+from deepspeed_tpu.version import __version__, __capability_parity__
+
+from deepspeed_tpu.utils.logging import logger, log_dist
+from deepspeed_tpu import comm
+
+__git_hash__ = None
+__git_branch__ = None
+
+_LAZY = {
+    "initialize": ("deepspeed_tpu.runtime.entry", "initialize"),
+    "init_inference": ("deepspeed_tpu.inference.entry", "init_inference"),
+    "add_config_arguments": ("deepspeed_tpu.runtime.entry", "add_config_arguments"),
+    "zero": ("deepspeed_tpu.runtime.zero", None),
+    "DeepSpeedEngine": ("deepspeed_tpu.runtime.engine", "DeepSpeedEngine"),
+    "DeepSpeedConfig": ("deepspeed_tpu.runtime.config", "DeepSpeedConfig"),
+    "ops": ("deepspeed_tpu.ops", None),
+    "moe": ("deepspeed_tpu.moe", None),
+    "pipe": ("deepspeed_tpu.pipe", None),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod_name, attr = _LAZY[name]
+        try:
+            mod = importlib.import_module(mod_name)
+            obj = mod if attr is None else getattr(mod, attr)
+        except (ImportError, AttributeError) as e:
+            # keep hasattr() semantics sane for not-yet-built components
+            raise AttributeError(f"deepspeed_tpu.{name} is not available: {e}") from e
+        globals()[name] = obj
+        return obj
+    raise AttributeError(f"module 'deepspeed_tpu' has no attribute {name!r}")

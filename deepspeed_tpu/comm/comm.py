@@ -1,0 +1,255 @@
+"""Unified communication API — the TPU-native ``deepspeed.comm``.
+
+The reference layers a torch.distributed-like API over NCCL/gloo/oneCCL
+(``deepspeed/comm/comm.py``: ``init_distributed`` :598, ``all_reduce`` :477,
+``all_gather_into_tensor`` :297, ``all_to_all_single`` :331, …). On TPU
+there is no rendezvous daemon or process-group handle:
+
+* **Process level** — ``init_distributed()`` wraps
+  ``jax.distributed.initialize`` (multi-host ICI/DCN bootstrap);
+  ``get_rank``/``get_world_size`` report process (host) coordinates.
+* **Program level** — collectives are ``jax.lax`` primitives over *named
+  mesh axes*. A "process group" is a tuple of axis names (see
+  ``deepspeed_tpu.parallel.topology``). These functions must be called
+  inside ``shard_map``/``pjit`` traced code; XLA schedules them on ICI/DCN.
+
+Unlike torch.distributed these are **functional**: they return the result
+instead of mutating in place.
+"""
+
+import os
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.comm.reduce_op import ReduceOp
+from deepspeed_tpu.utils.logging import logger, log_dist
+from deepspeed_tpu.utils import comms_logging
+
+AxisNames = Union[str, Sequence[str]]
+
+_INITIALIZED = False
+comms_logger = comms_logging.CommsLogger()
+
+
+def _normalize_axes(group: AxisNames):
+    if group is None:
+        raise ValueError("collective requires a mesh-axis group (str or tuple of axis names)")
+    if isinstance(group, str):
+        return (group,)
+    return tuple(group)
+
+
+def is_initialized() -> bool:
+    return _INITIALIZED
+
+
+def init_distributed(dist_backend: Optional[str] = None,
+                     auto_mpi_discovery: bool = True,
+                     distributed_port: int = 29500,
+                     verbose: bool = True,
+                     timeout=None,
+                     init_method=None,
+                     dist_init_required=None,
+                     config=None,
+                     rank: int = -1,
+                     world_size: int = -1) -> None:
+    """Bootstrap multi-host execution (reference ``comm/comm.py:598``).
+
+    On TPU pods each host already knows its slice topology; when the
+    coordinator env vars are present (or explicit rank/world_size given)
+    this calls ``jax.distributed.initialize``. Single-host runs are a
+    no-op. The torch-style arguments are accepted for API parity; the
+    meaningful ones are ``distributed_port``, ``rank`` and ``world_size``.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    coordinator = os.environ.get("DSTPU_COORDINATOR_ADDRESS") or os.environ.get("COORDINATOR_ADDRESS")
+    n_procs = world_size if world_size > 0 else int(os.environ.get("DSTPU_NUM_PROCESSES", "0") or 0)
+    proc_id = rank if rank >= 0 else int(os.environ.get("DSTPU_PROCESS_ID", "-1"))
+    if coordinator and n_procs > 1:
+        # Explicit multi-host config: failures here must be fatal, otherwise
+        # N hosts silently train as N disjoint single-host jobs.
+        if proc_id < 0:
+            raise ValueError("multi-host init requires a process id: pass rank= or set DSTPU_PROCESS_ID")
+        jax.distributed.initialize(coordinator_address=f"{coordinator}:{distributed_port}"
+                                   if ":" not in coordinator else coordinator,
+                                   num_processes=n_procs,
+                                   process_id=proc_id)
+    elif os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
+        # TPU-VM metadata path: jax discovers everything itself.
+        try:
+            jax.distributed.initialize()
+        except RuntimeError as e:  # already initialised (e.g. by the launcher)
+            logger.warning(f"jax.distributed.initialize skipped: {e}")
+    _INITIALIZED = True
+    if verbose:
+        log_dist(f"dstpu.comm initialized: process {get_rank()}/{get_world_size()}, "
+                 f"{jax.local_device_count()} local / {jax.device_count()} global devices")
+
+
+def configure(config=None, enabled=None, prof_all=None, prof_ops=None, verbose=None):
+    """Configure comms logging (reference ``comm/comm.py:configure``)."""
+    comms_logger.configure(config=config, enabled=enabled, prof_all=prof_all, prof_ops=prof_ops, verbose=verbose)
+
+
+# -- process-level topology -------------------------------------------------
+def get_rank(group=None) -> int:
+    """Process (host) index. One process per host on TPU — the reference's
+    one-process-per-GPU ranks have no analog; device-level parallelism is
+    inside the mesh."""
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    return jax.process_count()
+
+
+def get_local_rank() -> int:
+    return 0
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def barrier(group=None, name: str = "dstpu_barrier"):
+    """Cross-host barrier: blocks until every process reaches it (reference
+    ``comm.py:barrier``). Uses a global-device sync collective; a no-op on a
+    single host beyond draining the local device queue."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+    else:
+        (jax.device_put(0.0) + 0).block_until_ready()
+
+
+# -- in-program collectives over mesh axes ----------------------------------
+def _maybe_log(op_name, tensor, group):
+    if comms_logger.enabled:
+        comms_logger.append(op_name=op_name, size=tensor.size * tensor.dtype.itemsize, group=group)
+
+
+def all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, group: AxisNames = None, async_op=False):
+    """All-reduce over the mesh axes in ``group`` (reference ``comm.py:477``)."""
+    axes = _normalize_axes(group)
+    _maybe_log("all_reduce", tensor, axes)
+    if op in (ReduceOp.SUM, ReduceOp.AVG):
+        out = lax.psum(tensor, axes)
+        if op == ReduceOp.AVG:
+            out = out / _axis_size(axes)
+        return out
+    if op == ReduceOp.MAX:
+        return lax.pmax(tensor, axes)
+    if op == ReduceOp.MIN:
+        return lax.pmin(tensor, axes)
+    if op == ReduceOp.PRODUCT:
+        # exp(sum(log|x|)) with explicit sign/zero handling so negative or
+        # zero members don't produce NaN.
+        is_zero = (tensor == 0)
+        log_mag = jnp.where(is_zero, 0.0, jnp.log(jnp.abs(jnp.where(is_zero, 1.0, tensor))))
+        magnitude = jnp.exp(lax.psum(log_mag, axes))
+        neg_count = lax.psum((tensor < 0).astype(jnp.int32), axes)
+        any_zero = lax.psum(is_zero.astype(jnp.int32), axes) > 0
+        sign = 1.0 - 2.0 * (neg_count % 2).astype(tensor.dtype)
+        return jnp.where(any_zero, jnp.zeros_like(magnitude), sign * magnitude)
+    raise NotImplementedError(f"ReduceOp {op} not supported on TPU collectives")
+
+
+def inference_all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, group: AxisNames = None):
+    """Latency-optimized all-reduce for inference (reference ``comm.py:494``).
+    On TPU the compiler already specializes small-message ICI reductions, so
+    this is the same primitive."""
+    return all_reduce(tensor, op=op, group=group)
+
+
+def all_gather(tensor, group: AxisNames = None, axis: int = 0, tiled: bool = True):
+    """All-gather along ``axis`` over mesh axes (reference
+    ``all_gather_into_tensor`` ``comm.py:297``). ``tiled=True`` concatenates
+    shards along ``axis`` (torch semantics); ``tiled=False`` stacks a new
+    leading axis."""
+    axes = _normalize_axes(group)
+    _maybe_log("all_gather", tensor, axes)
+    return lax.all_gather(tensor, axes, axis=axis, tiled=tiled)
+
+
+# alias for torch-API parity
+def all_gather_into_tensor(tensor, group: AxisNames = None, axis: int = 0):
+    return all_gather(tensor, group=group, axis=axis, tiled=True)
+
+
+def reduce_scatter(tensor, op: ReduceOp = ReduceOp.SUM, group: AxisNames = None, axis: int = 0):
+    """Reduce-scatter (reference ``reduce_scatter_tensor`` ``comm.py:280``):
+    sum over the group, each member keeps its slice along ``axis``."""
+    axes = _normalize_axes(group)
+    _maybe_log("reduce_scatter", tensor, axes)
+    out = lax.psum_scatter(tensor, axes, scatter_dimension=axis, tiled=True)
+    if op == ReduceOp.AVG:
+        out = out / _axis_size(axes)
+    elif op != ReduceOp.SUM:
+        raise NotImplementedError(f"reduce_scatter op {op}")
+    return out
+
+
+def all_to_all_single(tensor, group: AxisNames = None, split_axis: int = 0, concat_axis: int = 0):
+    """All-to-all (reference ``all_to_all_single`` ``comm.py:331``): split
+    ``tensor`` along ``split_axis`` into group-size chunks, exchange, concat
+    received chunks along ``concat_axis``."""
+    axes = _normalize_axes(group)
+    _maybe_log("all_to_all_single", tensor, axes)
+    return lax.all_to_all(tensor, axes, split_axis=split_axis, concat_axis=concat_axis, tiled=True)
+
+
+def broadcast(tensor, src: int = 0, group: AxisNames = None):
+    """Broadcast the ``src`` member's value to all members of the group
+    (reference ``comm.py:broadcast``). Inside SPMD this is a masked psum."""
+    axes = _normalize_axes(group)
+    _maybe_log("broadcast", tensor, axes)
+    idx = _group_index(axes)
+    masked = jnp.where(idx == src, tensor, jnp.zeros_like(tensor))
+    return lax.psum(masked, axes)
+
+
+def send_recv(tensor, perm, group: AxisNames = None):
+    """Point-to-point permutation (reference ``pipe/p2p.py`` send/recv):
+    ``perm`` is a list of (src, dst) pairs along a single mesh axis."""
+    axes = _normalize_axes(group)
+    assert len(axes) == 1, "send_recv permutes along exactly one mesh axis"
+    _maybe_log("send_recv", tensor, axes)
+    return lax.ppermute(tensor, axes[0], perm)
+
+
+def _axis_size(axes):
+    total = 1
+    for a in axes:
+        total = total * lax.axis_size(a)
+    return total
+
+
+def _group_index(axes):
+    """Linear index of this shard within the (possibly multi-axis) group."""
+    idx = 0
+    for a in axes:
+        idx = idx * lax.axis_size(a) + lax.axis_index(a)
+    return idx
+
+
+def get_axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def get_axis_size(axis: str):
+    return lax.axis_size(axis)
+
+
+def log_summary(show_straggler=False):
+    """Print accumulated comms statistics (reference ``comm.py:416``)."""
+    comms_logger.log_all(print_log=True, show_straggler=show_straggler)

@@ -1,0 +1,301 @@
+"""Device-mesh topology: the TPU-native replacement for process groups.
+
+The reference builds NCCL process groups per parallel dimension
+(``deepspeed/utils/groups.py:64-485``, ``deepspeed/runtime/pipe/topology.py``).
+On TPU the same roles are axes of one ``jax.sharding.Mesh``; XLA inserts the
+collectives. This module owns the canonical axis vocabulary and mesh
+construction.
+
+Canonical axes (outermost → innermost; innermost axes get ICI-adjacent
+devices, so the most bandwidth-hungry axes go last):
+
+======== =========================================================
+axis     role (reference equivalent)
+======== =========================================================
+pipe     pipeline stages            (``PipeDataParallelTopology``)
+expert   expert parallelism         (``_create_expert_and_data_parallel``)
+data     pure data-parallel replicas (ZeRO replication / hpZ+MiCS
+         cross-shard-group replicas, ``groups.py:428``)
+fsdp     ZeRO parameter/grad/opt-state sharding axis
+         (``zero/stage_1_and_2.py``, ``zero/stage3.py``)
+sequence sequence/context parallelism (beyond the 0.10.1 reference;
+         required capability, SURVEY §2.3)
+tensor   tensor (model) parallelism (``module_inject/``, Megatron mpu)
+======== =========================================================
+
+The total data-parallel world (what the reference calls ``dp_world_size``)
+is ``expert × data × fsdp``: the batch is sharded over those three axes.
+ZeRO's partition group is the ``fsdp`` axis; setting ``fsdp`` smaller than
+the full DP world while ``data > 1`` reproduces ZeRO++ hpZ / MiCS
+sub-group sharding (``groups.py:428``, ``runtime/zero/mics.py``).
+"""
+
+import collections
+import dataclasses
+import itertools
+import os
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.utils.logging import logger
+
+# Canonical mesh axis names, outermost first.
+PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
+DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+SEQUENCE_AXIS = "sequence"
+TENSOR_AXIS = "tensor"
+
+MESH_AXES = (PIPE_AXIS, EXPERT_AXIS, DATA_AXIS, FSDP_AXIS, SEQUENCE_AXIS, TENSOR_AXIS)
+
+#: Axes the global batch is sharded over (the reference's data-parallel group).
+BATCH_AXES = (EXPERT_AXIS, DATA_AXIS, FSDP_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologyConfig:
+    """Sizes for each mesh axis. ``-1`` on ``data`` means "fill remaining
+    devices" (the common case: everything not otherwise claimed is DP)."""
+
+    pipe: int = 1
+    expert: int = 1
+    data: int = -1
+    fsdp: int = 1
+    sequence: int = 1
+    tensor: int = 1
+
+    def resolved(self, n_devices: int) -> "TopologyConfig":
+        for axis in MESH_AXES:
+            size = getattr(self, axis)
+            if size < 1 and not (axis == DATA_AXIS and size == -1):
+                raise ValueError(f"mesh axis {axis!r} must be >= 1 (got {size}); only 'data' may be -1")
+        fixed = self.pipe * self.expert * self.fsdp * self.sequence * self.tensor
+        data = self.data
+        if data == -1:
+            if n_devices % fixed != 0:
+                raise ValueError(f"device count {n_devices} not divisible by fixed axes product {fixed}")
+            data = n_devices // fixed
+        total = fixed * data
+        if total != n_devices:
+            raise ValueError(f"mesh {self} requires {total} devices but {n_devices} are available")
+        return dataclasses.replace(self, data=data)
+
+
+class MeshTopology:
+    """Builds and owns the device mesh plus axis bookkeeping.
+
+    Replaces the reference's cached process-group registry
+    (``deepspeed/utils/groups.py``): a "group" here is just a tuple of mesh
+    axis names, usable directly in ``jax.sharding.PartitionSpec`` or as
+    ``axis_name`` in collectives under ``shard_map``.
+    """
+
+    def __init__(self,
+                 pipe: int = 1,
+                 expert: int = 1,
+                 data: int = -1,
+                 fsdp: int = 1,
+                 sequence: int = 1,
+                 tensor: int = 1,
+                 devices: Optional[Sequence] = None):
+        if devices is None:
+            devices = jax.devices()
+        self.devices = list(devices)
+        cfg = TopologyConfig(pipe, expert, data, fsdp, sequence, tensor).resolved(len(self.devices))
+        self.config = cfg
+        shape = tuple(getattr(cfg, _axis_attr(a)) for a in MESH_AXES)
+        device_grid = np.asarray(self.devices).reshape(shape)
+        self.mesh = Mesh(device_grid, MESH_AXES)
+        logger.debug(f"MeshTopology built: {dict(zip(MESH_AXES, shape))} over {len(self.devices)} devices")
+
+    # -- axis sizes ---------------------------------------------------------
+    def axis_size(self, axis: str) -> int:
+        return self.mesh.shape[axis]
+
+    @property
+    def pipe_parallel_size(self) -> int:
+        return self.axis_size(PIPE_AXIS)
+
+    @property
+    def expert_parallel_size(self) -> int:
+        return self.axis_size(EXPERT_AXIS)
+
+    @property
+    def data_parallel_size(self) -> int:
+        """Total DP world (reference ``groups._get_data_parallel_world_size``):
+        batch-sharding ranks = expert × data × fsdp."""
+        return self.axis_size(EXPERT_AXIS) * self.axis_size(DATA_AXIS) * self.axis_size(FSDP_AXIS)
+
+    @property
+    def expert_data_parallel_size(self) -> int:
+        """DP replicas of each expert (reference expert-DP group size)."""
+        return self.axis_size(DATA_AXIS) * self.axis_size(FSDP_AXIS)
+
+    @property
+    def zero_partition_size(self) -> int:
+        """ZeRO shard count (= reference partition group world size; smaller
+        than ``data_parallel_size`` under hpZ/MiCS)."""
+        return self.axis_size(FSDP_AXIS)
+
+    @property
+    def sequence_parallel_size(self) -> int:
+        return self.axis_size(SEQUENCE_AXIS)
+
+    @property
+    def tensor_parallel_size(self) -> int:
+        return self.axis_size(TENSOR_AXIS)
+
+    @property
+    def model_parallel_size(self) -> int:
+        return self.tensor_parallel_size * self.pipe_parallel_size
+
+    @property
+    def world_size(self) -> int:
+        return len(self.devices)
+
+    # -- partition specs ----------------------------------------------------
+    def batch_spec(self, extra_leading: int = 0, shard_sequence: bool = False) -> P:
+        """PartitionSpec for an activation/batch array whose dim-0 is batch
+        (optionally preceded by ``extra_leading`` unsharded dims, e.g. a
+        gradient-accumulation dim) and dim-1 is sequence."""
+        parts = [None] * extra_leading + [BATCH_AXES]
+        if shard_sequence:
+            parts.append(SEQUENCE_AXIS)
+        return P(*parts)
+
+    def sharding(self, spec: P) -> NamedSharding:
+        return NamedSharding(self.mesh, spec)
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def __enter__(self):
+        self._ctx = self.mesh
+        self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *a):
+        return self._ctx.__exit__(*a)
+
+
+def _axis_attr(axis: str) -> str:
+    return axis
+
+
+# ---------------------------------------------------------------------------
+# ProcessTopology: rank ↔ coordinate bookkeeping, parity with the reference's
+# ``deepspeed/runtime/pipe/topology.py:12`` (axes/coords API). On TPU the mesh
+# already encodes this, but launcher/checkpoint-reshape code wants explicit
+# coordinate math, so we keep the same small class.
+# ---------------------------------------------------------------------------
+class ProcessTopology:
+    """Maps linear ranks to coordinates over named axes (row-major, first
+    axis outermost), mirroring reference ``ProcessTopology``."""
+
+    def __init__(self, axes: Sequence[str], dims: Sequence[int]):
+        self.axes = list(axes)
+        self.dims = list(dims)
+        self.ProcessCoord = collections.namedtuple("ProcessCoord", axes)
+        self.mapping = {}
+        ranges = [range(d) for d in self.dims]
+        for global_rank, coord in enumerate(itertools.product(*ranges)):
+            key = dict(zip(self.axes, coord))
+            self.mapping[self.ProcessCoord(**key)] = global_rank
+
+    def get_rank(self, **coord_kwargs) -> int:
+        if len(coord_kwargs) != len(self.axes):
+            raise ValueError("get_rank() does not support slices, use filter_match()")
+        key = self.ProcessCoord(**coord_kwargs)
+        return self.mapping[key]
+
+    def get_axis_names(self):
+        return self.axes
+
+    def get_rank_repr(self, rank, omit_axes=("data", "pipe"), inner_sep="_", outer_sep="-"):
+        omit_axes = list(omit_axes)
+        axes = [a for a in self.get_axis_names() if a not in omit_axes]
+        names = []
+        for ax in axes:
+            ax_rank = getattr(self.get_coord(rank=rank), ax)
+            names.append(f"{ax}{inner_sep}{ax_rank:02d}")
+        return outer_sep.join(names)
+
+    def get_dim(self, axis) -> int:
+        if axis not in self.axes:
+            return 0
+        return self.dims[self.axes.index(axis)]
+
+    def get_coord(self, rank):
+        for coord, idx in self.mapping.items():
+            if idx == rank:
+                return coord
+        raise ValueError(f"rank {rank} not found in topology")
+
+    def get_axis_comm_lists(self, axis):
+        """All groups of ranks that vary only along ``axis``
+        (reference ``topology.py:get_axis_comm_lists``)."""
+        if axis not in self.axes:
+            return []
+        other_axes = [a for a in self.axes if a != axis]
+        lists = []
+        ranges = [range(self.get_dim(a)) for a in other_axes]
+        for coord in itertools.product(*ranges):
+            other = dict(zip(other_axes, coord))
+            group = [self.get_rank(**{axis: i}, **other) for i in range(self.get_dim(axis))]
+            lists.append(group)
+        return lists
+
+    def filter_match(self, **filter_kwargs):
+        """Ranks whose coordinates match all key=value filters."""
+
+        def _match(coord):
+            return all(getattr(coord, k) == v for k, v in filter_kwargs.items())
+
+        return [self.mapping[c] for c in sorted(self.mapping.keys(), key=lambda c: self.mapping[c]) if _match(c)]
+
+    def get_axis_list(self, axis, idx):
+        return self.filter_match(**{axis: idx})
+
+    def world_size(self) -> int:
+        return int(np.prod(self.dims)) if self.dims else 1
+
+    def __str__(self):
+        return str(self.mapping)
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    """Parity with reference ``pipe/topology.py:232``."""
+
+    def __init__(self, num_pp, num_dp):
+        super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """Parity with reference ``pipe/topology.py:244`` (3D DP×PP×TP)."""
+
+    def __init__(self, num_pp, num_mp, num_dp):
+        super().__init__(axes=["pipe", "data", "model"], dims=[num_pp, num_dp, num_mp])
+
+
+_GLOBAL_TOPOLOGY: Optional[MeshTopology] = None
+
+
+def set_topology(topo: MeshTopology):
+    global _GLOBAL_TOPOLOGY
+    _GLOBAL_TOPOLOGY = topo
+
+
+def get_topology() -> Optional[MeshTopology]:
+    return _GLOBAL_TOPOLOGY
+
+
+def build_topology(pipe=1, expert=1, data=-1, fsdp=1, sequence=1, tensor=1, devices=None) -> MeshTopology:
+    topo = MeshTopology(pipe=pipe, expert=expert, data=data, fsdp=fsdp, sequence=sequence, tensor=tensor,
+                        devices=devices)
+    set_topology(topo)
+    return topo

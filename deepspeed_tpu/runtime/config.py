@@ -1,0 +1,240 @@
+"""Top-level config: one JSON (path or dict) → typed sub-configs.
+
+Parity with reference ``deepspeed/runtime/config.py`` (``DeepSpeedConfig``):
+the same keys, the same batch-size triangle resolution
+(train_batch = micro_batch × gradient_accumulation × dp_world), with a
+TPU-native ``mesh`` block replacing the implicit world-size/mpu plumbing.
+"""
+
+import json
+import os
+from typing import Optional
+
+from pydantic import Field
+
+from deepspeed_tpu.comm.config import DeepSpeedCommsConfig
+from deepspeed_tpu.monitor.config import get_monitor_config
+from deepspeed_tpu.profiling.config import get_flops_profiler_config
+from deepspeed_tpu.runtime import constants as C
+from deepspeed_tpu.runtime.config_utils import (DeepSpeedConfigModel, dict_raise_error_on_duplicate_keys,
+                                                get_scalar_param)
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+from deepspeed_tpu.utils.logging import logger
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+class FP16Config(DeepSpeedConfigModel):
+    """Reference ``runtime/fp16``/config keys (``runtime/config.py`` fp16 block)."""
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = Field(0.0, ge=0.0)  # 0 => dynamic
+    initial_scale_power: int = Field(16, ge=0)
+    loss_scale_window: int = Field(1000, gt=0)
+    hysteresis: int = Field(2, ge=0)
+    consecutive_hysteresis: bool = False
+    min_loss_scale: float = Field(1.0, ge=0.0)
+    fp16_master_weights_and_grads: bool = False
+
+
+class BF16Config(DeepSpeedConfigModel):
+    enabled: bool = False
+
+
+class ActivationCheckpointingConfig(DeepSpeedConfigModel):
+    """Reference ``runtime/activation_checkpointing/config.py`` keys. On TPU
+    rematerialization is `jax.checkpoint` policies; partition_activations
+    maps to sequence/tensor-axis sharding of saved activations."""
+    partition_activations: bool = False
+    contiguous_memory_optimization: bool = False
+    cpu_checkpointing: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+
+
+class MeshConfig(DeepSpeedConfigModel):
+    """TPU-native parallel-topology block (replaces mpu/world-size plumbing).
+
+    ``fsdp`` defaults to "auto": the engine sets it from the ZeRO stage —
+    stage>=1 shards over all remaining devices (or ``zero_hpz_partition_size``
+    / ``mics_shard_size`` when set)."""
+    pipe: int = Field(1, ge=1)
+    tensor: int = Field(1, ge=1)
+    sequence: int = Field(1, ge=1)
+    expert: int = Field(1, ge=1)
+    data: int = -1
+    fsdp: int = -1
+
+
+class CheckpointConfig(DeepSpeedConfigModel):
+    """Reference ``runtime/config.py`` checkpoint block."""
+    tag_validation: str = "Warn"
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write: dict = {}
+
+
+class DeepSpeedConfig:
+    """Parses and validates the full config (reference ``DeepSpeedConfig``,
+    ``runtime/config.py``)."""
+
+    def __init__(self, config, mesh=None, world_size: Optional[int] = None):
+        if isinstance(config, str):
+            if not os.path.exists(config):
+                raise DeepSpeedConfigError(f"Expected a string path to an existing deepspeed config, got {config}")
+            with open(config, "r") as f:
+                self._param_dict = json.load(f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+        elif isinstance(config, dict):
+            self._param_dict = config
+        else:
+            raise ValueError(f"Expected a string path or dict, got: {config} ({type(config)})")
+
+        self._initialize_params(self._param_dict)
+        self.mesh_config = MeshConfig(**self._param_dict.get(C.MESH, {}))
+        self._resolve_batch_size(world_size)
+        self._do_sanity_check()
+
+    # ------------------------------------------------------------------
+    def _initialize_params(self, param_dict):
+        self.train_batch_size = get_scalar_param(param_dict, C.TRAIN_BATCH_SIZE, C.TRAIN_BATCH_SIZE_DEFAULT)
+        self.train_micro_batch_size_per_gpu = get_scalar_param(param_dict, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+                                                               C.TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT)
+        self.gradient_accumulation_steps = get_scalar_param(param_dict, C.GRADIENT_ACCUMULATION_STEPS,
+                                                            C.GRADIENT_ACCUMULATION_STEPS_DEFAULT)
+        self.steps_per_print = get_scalar_param(param_dict, C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT)
+        self.dump_state = get_scalar_param(param_dict, C.DUMP_STATE, C.DUMP_STATE_DEFAULT)
+        self.wall_clock_breakdown = get_scalar_param(param_dict, C.WALL_CLOCK_BREAKDOWN,
+                                                     C.WALL_CLOCK_BREAKDOWN_DEFAULT)
+        self.memory_breakdown = get_scalar_param(param_dict, C.MEMORY_BREAKDOWN, C.MEMORY_BREAKDOWN_DEFAULT)
+        self.seed = get_scalar_param(param_dict, C.SEED, C.SEED_DEFAULT)
+
+        self.gradient_clipping = get_scalar_param(param_dict, C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT)
+        self.prescale_gradients = get_scalar_param(param_dict, C.PRESCALE_GRADIENTS, C.PRESCALE_GRADIENTS_DEFAULT)
+        self.gradient_predivide_factor = get_scalar_param(param_dict, C.GRADIENT_PREDIVIDE_FACTOR,
+                                                          C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
+        self.sparse_gradients_enabled = get_scalar_param(param_dict, C.SPARSE_GRADIENTS, C.SPARSE_GRADIENTS_DEFAULT)
+        self.communication_data_type = get_scalar_param(param_dict, C.COMMUNICATION_DATA_TYPE,
+                                                        C.COMMUNICATION_DATA_TYPE_DEFAULT)
+        self.disable_allgather = get_scalar_param(param_dict, C.DISABLE_ALLGATHER, C.DISABLE_ALLGATHER_DEFAULT)
+        self.dataloader_drop_last = get_scalar_param(param_dict, C.DATALOADER_DROP_LAST,
+                                                     C.DATALOADER_DROP_LAST_DEFAULT)
+
+        # optimizer / scheduler blocks (reference config.py get_optimizer_params)
+        opt = param_dict.get(C.OPTIMIZER)
+        self.optimizer_name = opt[C.TYPE].lower() if opt and C.TYPE in opt else None
+        self.optimizer_params = (opt.get(C.OPTIMIZER_PARAMS, {}) if opt else None)
+        self.optimizer_legacy_fusion = (opt.get(C.LEGACY_FUSION, False) if opt else False)
+        sched = param_dict.get(C.SCHEDULER)
+        self.scheduler_name = sched[C.TYPE] if sched and C.TYPE in sched else None
+        self.scheduler_params = (sched.get(C.SCHEDULER_PARAMS, {}) if sched else None)
+
+        # precision
+        fp16_dict = param_dict.get(C.FP16, {})
+        self.fp16_config = FP16Config(**fp16_dict)
+        bf16_dict = param_dict.get(C.BFLOAT16, param_dict.get(C.BFLOAT16_OLD, {}))
+        self.bf16_config = BF16Config(**bf16_dict)
+        self.fp16_enabled = self.fp16_config.enabled
+        self.bfloat16_enabled = self.bf16_config.enabled
+        self.fp16_auto_cast = self.fp16_config.auto_cast
+        self.loss_scale = self.fp16_config.loss_scale
+        self.initial_dynamic_scale = 2**self.fp16_config.initial_scale_power
+        self.dynamic_loss_scale_args = dict(init_scale=2**self.fp16_config.initial_scale_power,
+                                            scale_window=self.fp16_config.loss_scale_window,
+                                            min_scale=self.fp16_config.min_loss_scale,
+                                            delayed_shift=self.fp16_config.hysteresis)
+
+        # zero
+        self.zero_config = DeepSpeedZeroConfig(**param_dict.get(C.ZERO_OPTIMIZATION, {}))
+        self.zero_optimization_stage = self.zero_config.stage
+        self.zero_enabled = self.zero_optimization_stage > 0
+
+        # subsystems
+        self.activation_checkpointing_config = ActivationCheckpointingConfig(
+            **param_dict.get(C.ACTIVATION_CHECKPOINTING, {}))
+        self.monitor_config = get_monitor_config(param_dict)
+        self.flops_profiler_config = get_flops_profiler_config(param_dict)
+        self.comms_config = DeepSpeedCommsConfig(param_dict)
+        self.checkpoint_config = CheckpointConfig(**param_dict.get(C.CHECKPOINT, {}))
+        self.autotuning_config = param_dict.get(C.AUTOTUNING, {})
+        self.elasticity_config = param_dict.get(C.ELASTICITY, {})
+        self.compression_config = param_dict.get(C.COMPRESSION_TRAINING, {})
+        self.data_efficiency_config = param_dict.get(C.DATA_EFFICIENCY, {})
+        self.curriculum_learning_legacy = param_dict.get(C.CURRICULUM_LEARNING_LEGACY, {})
+        self.curriculum_enabled_legacy = bool(self.curriculum_learning_legacy.get("enabled", False))
+
+    # ------------------------------------------------------------------
+    def _resolve_batch_size(self, world_size: Optional[int]):
+        """Resolve the batch triangle (reference ``runtime/config.py``
+        ``_configure_train_batch_size``): any two of {train_batch_size,
+        micro_batch, gas} determine the third given dp_world_size."""
+        if world_size is None:
+            try:
+                import jax
+                world_size = jax.device_count()
+            except Exception:
+                world_size = 1
+        mesh = self.mesh_config
+        denom = mesh.pipe * mesh.tensor * mesh.sequence
+        if world_size % denom != 0:
+            raise DeepSpeedConfigError(f"world size {world_size} not divisible by pipe*tensor*sequence={denom}")
+        self.dp_world_size = world_size // denom
+
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+
+        if train_batch is not None and micro_batch is not None and grad_acc is not None:
+            pass
+        elif train_batch is not None and micro_batch is not None:
+            grad_acc = train_batch // micro_batch
+            grad_acc //= self.dp_world_size
+        elif train_batch is not None and grad_acc is not None:
+            micro_batch = train_batch // self.dp_world_size
+            micro_batch //= grad_acc
+        elif micro_batch is not None and grad_acc is not None:
+            train_batch = micro_batch * grad_acc * self.dp_world_size
+        elif train_batch is not None:
+            grad_acc = 1
+            micro_batch = train_batch // self.dp_world_size
+        elif micro_batch is not None:
+            train_batch = micro_batch * self.dp_world_size
+            grad_acc = 1
+        else:
+            raise DeepSpeedConfigError("Either train_batch_size or train_micro_batch_size_per_gpu needs to be set")
+
+        self.train_batch_size = train_batch
+        self.train_micro_batch_size_per_gpu = micro_batch
+        self.gradient_accumulation_steps = grad_acc
+
+    def _batch_assertion(self):
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+        assert train_batch > 0, f"Train batch size: {train_batch} has to be greater than 0"
+        assert micro_batch > 0, f"Micro batch size per gpu: {micro_batch} has to be greater than 0"
+        assert grad_acc > 0, f"Gradient accumulation steps: {grad_acc} has to be greater than 0"
+        assert train_batch == micro_batch * grad_acc * self.dp_world_size, (
+            f"Check batch related parameters. train_batch_size is not equal to micro_batch_per_gpu * "
+            f"gradient_acc_step * world_size {train_batch} != {micro_batch} * {grad_acc} * {self.dp_world_size}")
+
+    def _do_sanity_check(self):
+        self._batch_assertion()
+        if self.fp16_enabled and self.bfloat16_enabled:
+            raise DeepSpeedConfigError("fp16 and bf16 modes cannot be simultaneously enabled")
+        if self.optimizer_name is not None and self.optimizer_name not in C.DEEPSPEED_OPTIMIZERS:
+            logger.warning(f"optimizer {self.optimizer_name} is not a recognized built-in; "
+                           "it will be looked up in the client-supplied registry")
+
+    # ------------------------------------------------------------------
+    def print(self, name="DeepSpeedConfig"):
+        logger.info(f"{name}:")
+        for key in sorted(self.__dict__):
+            if key != "_param_dict":
+                logger.info(f"  {key} {getattr(self, key)}")
+
+    @property
+    def param_dict(self):
+        return self._param_dict

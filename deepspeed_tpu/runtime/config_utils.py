@@ -1,0 +1,110 @@
+"""Config plumbing shared by all subsystem configs.
+
+Parity with reference ``deepspeed/runtime/config_utils.py:16``
+(``DeepSpeedConfigModel``): a pydantic base model with support for
+deprecated fields that forward to their replacement, plus the scalar/dict
+param helpers used by the legacy-style readers.
+"""
+
+from functools import partial
+from typing import Dict
+
+from pydantic import BaseModel, ConfigDict, model_validator
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class DeepSpeedConfigModel(BaseModel):
+    """Base for all config blocks.
+
+    Fields may declare ``json_schema_extra={"deprecated": True,
+    "new_param": "other_field"}``; at validation time a set deprecated field
+    logs a warning and writes its (optionally transformed via
+    ``new_param_fn``) value into the replacement field, matching reference
+    ``config_utils.py:16-98`` behavior.
+    """
+
+    model_config = ConfigDict(
+        validate_default=True,
+        validate_assignment=True,
+        use_enum_values=True,
+        populate_by_name=True,
+        extra="forbid",
+        arbitrary_types_allowed=True,
+        protected_namespaces=(),
+    )
+
+    def __init__(self, strict: bool = False, **data):
+        if not strict:  # This is temporary to tolerate version differences
+            data = {k: v for k, v in data.items() if (v != "auto" or k == "replace_method")}
+        super().__init__(**data)
+
+    def _process_deprecated_field(self, dep_field):
+        fields_set = self.model_fields_set
+        kwargs = type(self).model_fields[dep_field].json_schema_extra or {}
+        new_param_fn = kwargs.get("new_param_fn", lambda x: x)
+        param_value = new_param_fn(getattr(self, dep_field))
+        new_field = kwargs.get("new_param", "")
+        dep_msg = kwargs.get("deprecated_msg", "")
+        if dep_field in fields_set:
+            logger.warning(f"Config parameter {dep_field} is deprecated" +
+                           (f" use {new_field} instead" if new_field else "") + (f". {dep_msg}" if dep_msg else ""))
+            if new_field and new_field not in fields_set:
+                try:
+                    setattr(self, new_field, param_value)
+                except Exception as e:
+                    logger.error(f"Tried setting value for '{new_field}' with value from deprecated '{dep_field}'")
+                    raise e
+
+    @model_validator(mode="after")
+    def _deprecated_fields_check(self):
+        fields = type(self).model_fields
+        for field_name, field_info in fields.items():
+            extra = field_info.json_schema_extra or {}
+            if isinstance(extra, dict) and extra.get("deprecated", False):
+                self._process_deprecated_field(field_name)
+        return self
+
+
+def get_scalar_param(param_dict: Dict, param_name: str, param_default_value):
+    """Reference ``config_utils.py:get_scalar_param``."""
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_list_param(param_dict: Dict, param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_dict_param(param_dict: Dict, param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def dict_raise_error_on_duplicate_keys(ordered_pairs):
+    """Reject duplicate keys when parsing JSON (reference
+    ``config_utils.py:dict_raise_error_on_duplicate_keys``)."""
+    d = dict((k, v) for k, v in ordered_pairs)
+    if len(d) != len(ordered_pairs):
+        counter = {}
+        for k, _ in ordered_pairs:
+            counter[k] = counter.get(k, 0) + 1
+        keys = [k for k, v in counter.items() if v > 1]
+        raise ValueError(f"Duplicate keys in DeepSpeed config: {keys}")
+    return d
+
+
+class pp_int(int):
+    """Pretty-printing int for config defaults, e.g. 5e8 shows as
+    ``5e8 (500,000,000)`` in docs (reference ``config_utils.py:pp_int``)."""
+
+    def __new__(cls, val, custom_print_str=None):
+        inst = super().__new__(cls, val)
+        inst.custom_print_str = custom_print_str
+        return inst
+
+    def __repr__(self):
+        if self.custom_print_str:
+            return self.custom_print_str
+        return f"{self.real:,}"
+
+
+ScientificNotationFloat = float

@@ -1,0 +1,2 @@
+from deepspeed_tpu.utils.logging import logger, log_dist, LoggerFactory
+from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer, NoopTimer

@@ -1,0 +1,102 @@
+"""Communication-op logging (reference ``deepspeed/utils/comms_logging.py``).
+
+Under ``jit`` every collective is compiler-scheduled, so per-op wall-clock
+timing (the reference's ``timed_op`` wrapper, ``comm/comm.py:101``) is not
+observable from Python. What *is* static and exact at trace time is the op
+type, message size, and group — so the logger records counts and volumes,
+and bandwidth estimates come from whole-step timing divided across ops
+(or from the JAX profiler for precise per-collective numbers).
+"""
+
+import math
+from collections import defaultdict
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+def get_caller_func(frame=3):
+    import sys
+    return sys._getframe(frame).f_code.co_name
+
+
+def convert_size(size_bytes):
+    if size_bytes == 0:
+        return "0B"
+    size_name = ("B", "KB", "MB", "GB", "TB", "PB")
+    i = int(math.floor(math.log(size_bytes, 1024)))
+    p = math.pow(1024, i)
+    s = round(size_bytes / p, 2)
+    return f"{s} {size_name[i]}"
+
+
+def calc_bw_log(comm_op, size, duration, n_ranks):
+    """Algorithmic vs bus bandwidth for a collective (reference
+    ``comms_logging.py:34``)."""
+    duration = max(duration, 1e-9)
+    if comm_op in ("all_to_all_single",):
+        tput = size / duration
+        busbw = (size / duration) * ((n_ranks - 1) / n_ranks)
+    elif comm_op in ("all_gather", "all_gather_into_tensor", "reduce_scatter", "reduce_scatter_tensor"):
+        size *= n_ranks
+        tput = size / duration
+        busbw = (size / duration) * ((n_ranks - 1) / n_ranks)
+    elif comm_op in ("all_reduce", "inference_all_reduce"):
+        tput = size * 2 / duration
+        busbw = (size / duration) * (2 * (n_ranks - 1) / n_ranks)
+    else:  # broadcast / send_recv / barrier
+        tput = size / duration
+        busbw = tput
+    # convert to Gbps
+    tput *= 8e-9
+    busbw *= 8e-9
+    return tput, busbw
+
+
+class CommsLogger:
+    """Accumulates per-op-name, per-size counts and volumes."""
+
+    def __init__(self):
+        self.comms_dict = defaultdict(lambda: defaultdict(lambda: [0, 0.0]))  # name -> size -> [count, bytes]
+        self.verbose = False
+        self.enabled = False
+        self.prof_all = True
+        self.prof_ops = []
+
+    def configure(self, config=None, enabled=None, prof_all=None, prof_ops=None, verbose=None):
+        if config is not None:
+            enabled = getattr(config, "enabled", enabled)
+            prof_all = getattr(config, "prof_all", prof_all)
+            prof_ops = getattr(config, "prof_ops", prof_ops)
+            verbose = getattr(config, "verbose", verbose)
+        if enabled is not None:
+            self.enabled = enabled
+        if prof_all is not None:
+            self.prof_all = prof_all
+        if prof_ops is not None:
+            self.prof_ops = prof_ops
+        if verbose is not None:
+            self.verbose = verbose
+
+    def append(self, op_name, size, group=None):
+        # Reference gate (comm/comm.py:107): record iff prof_all or op listed.
+        if not (self.prof_all or op_name in self.prof_ops):
+            return
+        entry = self.comms_dict[op_name][size]
+        entry[0] += 1
+        entry[1] += size
+        if self.verbose:
+            logger.info(f"comm op: {op_name} | msg size: {convert_size(size)} | group: {group}")
+
+    def reset(self):
+        self.comms_dict.clear()
+
+    def log_all(self, print_log=True, show_straggler=False):
+        lines = [f"{'Comm. Op':<22}{'Message Size':<20}{'Count':<10}{'Total Volume':<16}"]
+        for op_name, sizes in sorted(self.comms_dict.items()):
+            lines.append(op_name)
+            for size, (count, total) in sorted(sizes.items()):
+                lines.append(f"{'':<22}{convert_size(size):<20}{count:<10}{convert_size(total):<16}")
+        out = "\n".join(lines)
+        if print_log:
+            log_dist("\n" + out)
+        return out

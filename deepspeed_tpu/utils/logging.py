@@ -1,0 +1,88 @@
+"""Rank-aware logging.
+
+TPU-native analog of the reference's ``deepspeed/utils/logging.py``
+(``logger``, ``log_dist``, ``should_log_le``): the same surface, with ranks
+taken from ``jax.process_index()`` (one process per host on TPU) instead of
+``torch.distributed`` ranks.
+"""
+
+import functools
+import logging
+import os
+import sys
+
+log_levels = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+class LoggerFactory:
+
+    @staticmethod
+    def create_logger(name=None, level=logging.INFO):
+        if name is None:
+            raise ValueError("name for logger cannot be None")
+
+        formatter = logging.Formatter(
+            "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d:%(funcName)s] %(message)s")
+
+        logger_ = logging.getLogger(name)
+        logger_.setLevel(level)
+        logger_.propagate = False
+        if not logger_.handlers:
+            ch = logging.StreamHandler(stream=sys.stdout)
+            ch.setLevel(level)
+            ch.setFormatter(formatter)
+            logger_.addHandler(ch)
+        return logger_
+
+
+logger = LoggerFactory.create_logger(
+    name="DeepSpeedTPU", level=log_levels.get(os.environ.get("DSTPU_LOG_LEVEL", "info"), logging.INFO))
+
+
+def _process_index():
+    # Deliberately uncached: before jax.distributed.initialize every host
+    # reports index 0; caching would pin that wrong answer forever. Avoid
+    # forcing backend init from a log call.
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # jax.distributed not initialised / no backend
+        return 0
+
+
+def log_dist(message, ranks=None, level=logging.INFO):
+    """Log ``message`` only on the listed process ranks (default: rank 0).
+
+    Parity with reference ``deepspeed/utils/logging.py:log_dist``; ``ranks``
+    containing ``-1`` means log on every process.
+    """
+    my_rank = _process_index()
+    ranks = ranks or [0]
+    if my_rank in ranks or -1 in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def should_log_le(max_log_level_str):
+    if not isinstance(max_log_level_str, str):
+        raise ValueError("max_log_level_str must be a string")
+    max_log_level_str = max_log_level_str.lower()
+    if max_log_level_str not in log_levels:
+        raise ValueError(f"{max_log_level_str} is not one of the `log_levels`: {log_levels.keys()}")
+    return logger.getEffectiveLevel() <= log_levels[max_log_level_str]
+
+
+def warning_once(message):
+    _warned.setdefault(message, False)
+    if not _warned[message]:
+        logger.warning(message)
+        _warned[message] = True
+
+
+_warned = {}

@@ -1,0 +1,8 @@
+"""Version of the deepspeed_tpu framework.
+
+Mirrors the reference's top-level ``version.txt`` (= 0.10.1); we track the
+capability set of that snapshot, with a TPU-native implementation.
+"""
+
+__version__ = "0.1.0"
+__capability_parity__ = "deepspeed-0.10.1"

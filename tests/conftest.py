@@ -1,0 +1,30 @@
+"""Test harness bootstrap.
+
+The reference spawns one process per GPU (``tests/unit/common.py:147``,
+``DistributedTest``). On TPU the natural analog is a single process with a
+multi-device mesh; for CI we emulate 8 devices on CPU via XLA host
+platform flags. This must run before the first ``import jax`` anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the session may preset a TPU platform
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# A sitecustomize may have force-registered a TPU plugin and pinned
+# jax_platforms; re-pin to cpu before any backend is initialised.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def mesh8():
+    """A fresh 8-device topology with all devices on the fsdp axis."""
+    from deepspeed_tpu.parallel.topology import MeshTopology
+
+    return MeshTopology(fsdp=8, data=1)

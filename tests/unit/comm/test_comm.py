@@ -1,0 +1,111 @@
+"""Collectives over mesh axes — analog of reference ``tests/unit/comm/test_dist.py``."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu import comm as dist
+from deepspeed_tpu.comm import ReduceOp
+from deepspeed_tpu.parallel.topology import MeshTopology, FSDP_AXIS
+
+
+@pytest.fixture(scope="module")
+def topo():
+    return MeshTopology(fsdp=8, data=1)
+
+
+def _shmap(topo, fn, in_specs, out_specs):
+    return jax.jit(jax.shard_map(fn, mesh=topo.mesh, in_specs=in_specs, out_specs=out_specs))
+
+
+def test_all_reduce_sum(topo):
+    x = jnp.arange(8, dtype=jnp.float32)  # shard i holds value i
+
+    f = _shmap(topo, lambda v: dist.all_reduce(v, group=FSDP_AXIS), P(FSDP_AXIS), P(FSDP_AXIS))
+    out = f(x)
+    np.testing.assert_allclose(out, np.full(8, 28.0))
+
+
+def test_all_reduce_avg_max_min(topo):
+    x = jnp.arange(8, dtype=jnp.float32)
+    avg = _shmap(topo, lambda v: dist.all_reduce(v, op=ReduceOp.AVG, group=FSDP_AXIS), P(FSDP_AXIS), P(FSDP_AXIS))(x)
+    np.testing.assert_allclose(avg, np.full(8, 3.5))
+    mx = _shmap(topo, lambda v: dist.all_reduce(v, op=ReduceOp.MAX, group=FSDP_AXIS), P(FSDP_AXIS), P(FSDP_AXIS))(x)
+    np.testing.assert_allclose(mx, np.full(8, 7.0))
+    mn = _shmap(topo, lambda v: dist.all_reduce(v, op=ReduceOp.MIN, group=FSDP_AXIS), P(FSDP_AXIS), P(FSDP_AXIS))(x)
+    np.testing.assert_allclose(mn, np.full(8, 0.0))
+
+
+def test_all_gather(topo):
+    x = jnp.arange(8, dtype=jnp.float32)
+    # every shard ends up with the full [0..7]; out_specs re-tiles so the
+    # global result is 8 concatenated copies
+    f = _shmap(topo, lambda v: dist.all_gather(v, group=FSDP_AXIS), P(FSDP_AXIS), P(FSDP_AXIS))
+    out = f(x)
+    np.testing.assert_allclose(out, np.tile(np.arange(8.0), 8))
+
+
+def test_reduce_scatter(topo):
+    # every shard holds the full [0..7]; reduce-scatter sums and splits
+    x = jnp.tile(jnp.arange(8, dtype=jnp.float32), (8,))
+    f = _shmap(topo, lambda v: dist.reduce_scatter(v, group=FSDP_AXIS), P(FSDP_AXIS), P(FSDP_AXIS))
+    out = f(x)
+    np.testing.assert_allclose(out, np.arange(8.0) * 8)
+
+
+def test_all_to_all(topo):
+    # shard i sends element j to shard j; after exchange shard j holds column j
+    x = jnp.arange(64, dtype=jnp.float32)
+    f = _shmap(topo, lambda v: dist.all_to_all_single(v, group=FSDP_AXIS), P(FSDP_AXIS), P(FSDP_AXIS))
+    out = np.asarray(f(x)).reshape(8, 8)
+    expect = np.arange(64).reshape(8, 8).T
+    np.testing.assert_allclose(out, expect)
+
+
+def test_broadcast(topo):
+    x = jnp.arange(8, dtype=jnp.float32)
+    f = _shmap(topo, lambda v: dist.broadcast(v, src=3, group=FSDP_AXIS), P(FSDP_AXIS), P(FSDP_AXIS))
+    out = f(x)
+    np.testing.assert_allclose(out, np.full(8, 3.0))
+
+
+def test_send_recv_ring(topo):
+    x = jnp.arange(8, dtype=jnp.float32)
+    perm = [(i, (i + 1) % 8) for i in range(8)]
+    f = _shmap(topo, lambda v: dist.send_recv(v, perm, group=FSDP_AXIS), P(FSDP_AXIS), P(FSDP_AXIS))
+    out = f(x)
+    np.testing.assert_allclose(out, np.roll(np.arange(8.0), 1))
+
+
+def test_multi_axis_group():
+    topo = MeshTopology(fsdp=4, data=2)
+    x = jnp.arange(8, dtype=jnp.float32)
+    f = jax.jit(
+        jax.shard_map(lambda v: dist.all_reduce(v, group=("data", "fsdp")),
+                      mesh=topo.mesh,
+                      in_specs=P(("data", "fsdp")),
+                      out_specs=P(("data", "fsdp"))))
+    np.testing.assert_allclose(f(x), np.full(8, 28.0))
+
+
+def test_host_level_api():
+    dist.init_distributed(verbose=False)
+    assert dist.get_rank() == 0
+    assert dist.get_world_size() == 1
+    assert dist.device_count() == 8
+    dist.barrier()
+
+
+def test_comms_logger():
+    topo = MeshTopology(fsdp=8, data=1)
+    dist.comms_logger.reset()
+    dist.configure(enabled=True, verbose=False)
+    x = jnp.arange(8, dtype=jnp.float32)
+    f = _shmap(topo, lambda v: dist.all_reduce(v, group=FSDP_AXIS), P(FSDP_AXIS), P(FSDP_AXIS))
+    f(x)
+    summary = dist.comms_logger.log_all(print_log=False)
+    assert "all_reduce" in summary
+    dist.configure(enabled=False)

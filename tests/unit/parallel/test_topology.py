@@ -1,0 +1,77 @@
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import (MeshTopology, ProcessTopology, PipeDataParallelTopology,
+                                             PipeModelDataParallelTopology, MESH_AXES)
+
+
+def test_mesh_fills_data_axis():
+    topo = MeshTopology()
+    assert topo.data_parallel_size == 8
+    assert topo.world_size == 8
+    assert tuple(topo.mesh.axis_names) == MESH_AXES
+
+
+def test_mesh_axis_split():
+    topo = MeshTopology(tensor=2, fsdp=2)
+    assert topo.tensor_parallel_size == 2
+    assert topo.zero_partition_size == 2
+    assert topo.data_parallel_size == 4  # expert(1) * data(2) * fsdp(2)
+    assert topo.expert_data_parallel_size == 4
+
+
+def test_mesh_invalid_split():
+    with pytest.raises(ValueError):
+        MeshTopology(tensor=3)  # 8 % 3 != 0
+
+
+def test_hpz_style_decomposition():
+    """ZeRO++ hpZ / MiCS: shard group smaller than DP world."""
+    topo = MeshTopology(fsdp=4, data=2)
+    assert topo.zero_partition_size == 4
+    assert topo.data_parallel_size == 8
+
+
+def test_batch_spec():
+    topo = MeshTopology(fsdp=8, data=1)
+    spec = topo.batch_spec()
+    assert spec == P(("expert", "data", "fsdp"))
+    spec2 = topo.batch_spec(extra_leading=1, shard_sequence=True)
+    assert spec2 == P(None, ("expert", "data", "fsdp"), "sequence")
+
+
+def test_sharding_places_data():
+    topo = MeshTopology(fsdp=8, data=1)
+    x = np.arange(16, dtype=np.float32).reshape(16, 1)
+    sharded = jax.device_put(x, topo.sharding(topo.batch_spec()))
+    assert len(sharded.addressable_shards) == 8
+    assert sharded.addressable_shards[0].data.shape == (2, 1)
+
+
+# -- ProcessTopology parity (reference pipe/topology.py) ---------------------
+def test_process_topology_ranks():
+    topo = ProcessTopology(axes=["pipe", "data"], dims=[2, 4])
+    assert topo.world_size() == 8
+    assert topo.get_rank(pipe=0, data=0) == 0
+    assert topo.get_rank(pipe=1, data=0) == 4
+    assert topo.get_dim("data") == 4
+    coord = topo.get_coord(5)
+    assert coord.pipe == 1 and coord.data == 1
+
+
+def test_axis_comm_lists():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    dp_lists = topo.get_axis_comm_lists("data")
+    assert [sorted(g) for g in dp_lists] == [[0, 1], [2, 3]]
+    pp_lists = topo.get_axis_comm_lists("pipe")
+    assert [sorted(g) for g in pp_lists] == [[0, 2], [1, 3]]
+
+
+def test_3d_topology():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    assert topo.world_size() == 8
+    assert topo.filter_match(pipe=0) == [0, 1, 2, 3]
+    assert topo.get_axis_list("model", 0) == [0, 2, 4, 6]

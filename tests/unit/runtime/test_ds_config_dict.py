@@ -1,0 +1,118 @@
+"""Config parsing — analog of reference ``tests/unit/runtime/test_ds_config_dict.py``."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+
+
+def base_config():
+    return {
+        "train_batch_size": 16,
+        "optimizer": {
+            "type": "Adam",
+            "params": {
+                "lr": 0.001
+            }
+        },
+        "fp16": {
+            "enabled": False
+        },
+    }
+
+
+def test_batch_triangle_from_train_batch():
+    cfg = DeepSpeedConfig(base_config(), world_size=8)
+    assert cfg.train_batch_size == 16
+    assert cfg.train_micro_batch_size_per_gpu == 2
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_triangle_micro_and_gas():
+    d = {"train_micro_batch_size_per_gpu": 2, "gradient_accumulation_steps": 4}
+    cfg = DeepSpeedConfig(d, world_size=8)
+    assert cfg.train_batch_size == 64
+
+
+def test_batch_triangle_train_and_gas():
+    d = {"train_batch_size": 64, "gradient_accumulation_steps": 4}
+    cfg = DeepSpeedConfig(d, world_size=8)
+    assert cfg.train_micro_batch_size_per_gpu == 2
+
+
+def test_batch_triangle_inconsistent_raises():
+    d = {"train_batch_size": 10, "train_micro_batch_size_per_gpu": 2, "gradient_accumulation_steps": 4}
+    with pytest.raises(AssertionError):
+        DeepSpeedConfig(d, world_size=8)
+
+
+def test_missing_batch_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"optimizer": {"type": "Adam"}}, world_size=8)
+
+
+def test_fp16_and_bf16_conflict():
+    d = base_config()
+    d["fp16"] = {"enabled": True}
+    d["bf16"] = {"enabled": True}
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig(d, world_size=8)
+
+
+def test_zero_config():
+    d = base_config()
+    d["zero_optimization"] = {"stage": 3, "zero_hpz_partition_size": 4, "zero_quantized_gradients": True}
+    cfg = DeepSpeedConfig(d, world_size=8)
+    assert cfg.zero_enabled
+    assert cfg.zero_optimization_stage == 3
+    assert cfg.zero_config.zero_hpz_partition_size == 4
+    assert cfg.zero_config.zero_quantized_gradients
+
+
+def test_zero_deprecated_field_forwards():
+    d = base_config()
+    d["zero_optimization"] = {"stage": 3, "stage3_gather_fp16_weights_on_model_save": True}
+    cfg = DeepSpeedConfig(d, world_size=8)
+    assert cfg.zero_config.stage3_gather_16bit_weights_on_model_save
+
+
+def test_fp16_loss_scale_args():
+    d = base_config()
+    d["fp16"] = {"enabled": True, "initial_scale_power": 8, "loss_scale_window": 500}
+    cfg = DeepSpeedConfig(d, world_size=8)
+    assert cfg.fp16_enabled
+    assert cfg.initial_dynamic_scale == 256
+    assert cfg.dynamic_loss_scale_args["scale_window"] == 500
+
+
+def test_mesh_block():
+    d = base_config()
+    d["mesh"] = {"tensor": 2, "sequence": 2}
+    cfg = DeepSpeedConfig(d, world_size=8)
+    assert cfg.dp_world_size == 2
+    assert cfg.train_micro_batch_size_per_gpu == 8
+
+
+def test_config_from_json_file(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps(base_config()))
+    cfg = DeepSpeedConfig(str(p), world_size=8)
+    assert cfg.optimizer_name == "adam"
+    assert cfg.optimizer_params == {"lr": 0.001}
+
+
+def test_duplicate_keys_raise(tmp_path):
+    p = tmp_path / "dup.json"
+    p.write_text('{"train_batch_size": 8, "train_batch_size": 16}')
+    with pytest.raises(ValueError):
+        DeepSpeedConfig(str(p), world_size=8)
+
+
+def test_monitor_and_profiler_configs():
+    d = base_config()
+    d["tensorboard"] = {"enabled": True, "output_path": "/tmp/tb"}
+    d["flops_profiler"] = {"enabled": True, "profile_step": 5}
+    cfg = DeepSpeedConfig(d, world_size=8)
+    assert cfg.monitor_config.tensorboard.enabled
+    assert cfg.flops_profiler_config.profile_step == 5
