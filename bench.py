@@ -1,0 +1,82 @@
+"""Benchmark: GPT-2 350M causal-LM training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+``vs_baseline`` is achieved model TFLOP/s per chip divided by the
+reference's headline per-device training throughput claim (64 TFLOP/s per
+V100, BERT-large pretrain — BASELINE.md / reference
+``docs/_posts/2020-05-28-fastest-bert-training.md:13``). Model FLOPs use
+the standard 6*N*T causal-LM estimate.
+
+Run on the real TPU (leave JAX_PLATFORMS alone). Select a smaller model or
+batch via BENCH_MODEL / BENCH_MICRO_BS / BENCH_SEQ env vars.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+
+    model_name = os.environ.get("BENCH_MODEL", "350m")
+    micro_bs = int(os.environ.get("BENCH_MICRO_BS", "4"))
+    seq = int(os.environ.get("BENCH_SEQ", "1024"))
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+
+    n_dev = jax.device_count()
+    cfg_model = get_gpt2_config(model_name, n_positions=seq, remat=True)
+    model = GPT2LMHeadModel(cfg_model)
+
+    ds_config = {
+        "train_batch_size": micro_bs * n_dev,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        "zero_optimization": {"stage": 1 if n_dev > 1 else 0},
+        "steps_per_print": 10**9,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds_config)
+
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg_model.vocab_size,
+                                       (micro_bs * n_dev, seq)).astype(np.int32)}
+
+    # param count for FLOPs estimate
+    engine.initialize_state(batch)
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(engine.state.params))
+
+    # warmup (compile)
+    for _ in range(2):
+        engine.train_batch(batch)
+    jax.block_until_ready(engine.state.params)
+
+    t0 = time.time()
+    for _ in range(steps):
+        engine.train_batch(batch)
+    jax.block_until_ready(engine.state.params)
+    dt = time.time() - t0
+
+    tokens = micro_bs * n_dev * seq * steps
+    tok_per_sec_chip = tokens / dt / n_dev
+    model_tflops = 6.0 * n_params * tok_per_sec_chip / 1e12
+    print(json.dumps({
+        "metric": "gpt2_350m_train_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(model_tflops / 64.0, 4),
+    }))
+    print(f"# n_params={n_params/1e6:.1f}M devices={n_dev} step_time={dt/steps*1e3:.1f}ms "
+          f"model_tflops/chip={model_tflops:.2f}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

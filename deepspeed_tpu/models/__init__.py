@@ -1,0 +1,2 @@
+from deepspeed_tpu.models.gpt2 import (GPT2Config, GPT2LMHeadModel, GPT2_CONFIGS, get_gpt2_config,
+                                       cross_entropy_loss)

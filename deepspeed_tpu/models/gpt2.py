@@ -1,0 +1,195 @@
+"""GPT-2 family — the flagship decoder-only model (config ladder:
+125M → 350M → 760M → XL-1.5B, BASELINE.md).
+
+TPU-first design notes:
+* every parameter carries t5x-style logical axis names via
+  ``nn.with_partitioning`` so the ZeRO planner
+  (``deepspeed_tpu.parallel.sharding``) can derive tensor-parallel and
+  fsdp shardings declaratively — the role the reference fills with
+  Megatron mpu slicing + ``zero.Init`` (``partition_parameters.py``);
+* attention goes through the pluggable backend seam
+  (``deepspeed_tpu.ops.transformer.attention``) so the XLA reference path
+  and the Pallas flash kernel are interchangeable;
+* ``remat`` wraps each block with ``jax.checkpoint`` — the analog of the
+  reference's activation checkpointing (``runtime/activation_checkpointing``).
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.transformer.attention import dot_product_attention
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dropout: float = 0.0
+    layer_norm_epsilon: float = 1e-5
+    dtype: Any = jnp.float32  # compute dtype; params stay in param_dtype
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    attention_backend: str = "xla"
+
+    @property
+    def head_dim(self):
+        return self.n_embd // self.n_head
+
+
+GPT2_CONFIGS = {
+    # tiny config for unit tests (vocab multiple of 8 for mesh divisibility)
+    "test": dict(vocab_size=256, n_positions=128, n_embd=64, n_layer=2, n_head=4),
+    "125m": dict(n_embd=768, n_layer=12, n_head=12),
+    "350m": dict(n_embd=1024, n_layer=24, n_head=16),
+    "760m": dict(n_embd=1536, n_layer=24, n_head=16),
+    "xl": dict(n_embd=1600, n_layer=48, n_head=25),
+}
+
+
+def get_gpt2_config(name: str, **overrides) -> GPT2Config:
+    base = dict(GPT2_CONFIGS[name])
+    base.update(overrides)
+    return GPT2Config(**base)
+
+
+def _dense_init(scale=0.02):
+    return nn.initializers.normal(stddev=scale)
+
+
+class SelfAttention(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        cfg = self.config
+        qkv_proj = nn.DenseGeneral(features=(3, cfg.n_head, cfg.head_dim),
+                                   axis=-1,
+                                   dtype=cfg.dtype,
+                                   param_dtype=cfg.param_dtype,
+                                   kernel_init=nn.with_partitioning(_dense_init(), ("embed", None, "heads", "kv")),
+                                   bias_init=nn.with_partitioning(nn.initializers.zeros, (None, "heads", "kv")),
+                                   name="c_attn")
+        qkv = qkv_proj(x)
+        q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        dropout_rng = None
+        if not deterministic and cfg.dropout > 0.0:
+            dropout_rng = self.make_rng("dropout")
+        attn_out = dot_product_attention(q,
+                                         k,
+                                         v,
+                                         backend=cfg.attention_backend,
+                                         causal=True,
+                                         dropout_rate=0.0 if deterministic else cfg.dropout,
+                                         dropout_rng=dropout_rng)
+        out = nn.DenseGeneral(features=cfg.n_embd,
+                              axis=(-2, -1),
+                              dtype=cfg.dtype,
+                              param_dtype=cfg.param_dtype,
+                              kernel_init=nn.with_partitioning(_dense_init(), ("heads", "kv", "embed")),
+                              bias_init=nn.with_partitioning(nn.initializers.zeros, ("embed",)),
+                              name="c_proj")(attn_out)
+        if not deterministic and cfg.dropout > 0.0:
+            out = nn.Dropout(rate=cfg.dropout)(out, deterministic=False)
+        return out
+
+
+class MLP(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        cfg = self.config
+        h = nn.Dense(features=4 * cfg.n_embd,
+                     dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype,
+                     kernel_init=nn.with_partitioning(_dense_init(), ("embed", "mlp")),
+                     bias_init=nn.with_partitioning(nn.initializers.zeros, ("mlp",)),
+                     name="c_fc")(x)
+        h = jax.nn.gelu(h, approximate=True)
+        h = nn.Dense(features=cfg.n_embd,
+                     dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype,
+                     kernel_init=nn.with_partitioning(_dense_init(), ("mlp", "embed")),
+                     bias_init=nn.with_partitioning(nn.initializers.zeros, ("embed",)),
+                     name="c_proj")(h)
+        if not deterministic and cfg.dropout > 0.0:
+            h = nn.Dropout(rate=cfg.dropout)(h, deterministic=False)
+        return h
+
+
+class LayerNorm(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        return nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                            dtype=cfg.dtype,
+                            param_dtype=cfg.param_dtype,
+                            scale_init=nn.with_partitioning(nn.initializers.ones, ("embed",)),
+                            bias_init=nn.with_partitioning(nn.initializers.zeros, ("embed",)))(x)
+
+
+class Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        # deterministic is positional (not kw-only) so nn.remat can mark it
+        # static (static_argnums below)
+        cfg = self.config
+        x = x + SelfAttention(cfg, name="attn")(LayerNorm(cfg, name="ln_1")(x), deterministic=deterministic)
+        x = x + MLP(cfg, name="mlp")(LayerNorm(cfg, name="ln_2")(x), deterministic=deterministic)
+        return x
+
+
+class GPT2LMHeadModel(nn.Module):
+    """GPT-2 with tied-embedding LM head. Returns logits [B, L, V]."""
+
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, *, deterministic: bool = True):
+        cfg = self.config
+        wte = self.param("wte", nn.with_partitioning(_dense_init(), ("vocab", "embed")),
+                         (cfg.vocab_size, cfg.n_embd), cfg.param_dtype)
+        wpe = self.param("wpe", nn.with_partitioning(_dense_init(0.01), (None, "embed")),
+                         (cfg.n_positions, cfg.n_embd), cfg.param_dtype)
+        wte_value = wte.value if isinstance(wte, nn.Partitioned) else wte
+        wpe_value = wpe.value if isinstance(wpe, nn.Partitioned) else wpe
+
+        _, seq_len = input_ids.shape
+        x = jnp.take(wte_value, input_ids, axis=0).astype(cfg.dtype)
+        x = x + wpe_value[:seq_len].astype(cfg.dtype)
+        if not deterministic and cfg.dropout > 0.0:
+            x = nn.Dropout(rate=cfg.dropout)(x, deterministic=False)
+
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(Block, static_argnums=(2,), prevent_cse=False)
+        for i in range(cfg.n_layer):
+            x = block_cls(cfg, name=f"h_{i}")(x, deterministic)
+        x = LayerNorm(cfg, name="ln_f")(x)
+        # tied LM head (fp32 logits for a stable loss)
+        logits = jnp.einsum("ble,ve->blv", x, wte_value.astype(cfg.dtype), preferred_element_type=jnp.float32)
+        return logits
+
+
+def cross_entropy_loss(logits, labels, ignore_index: int = -100):
+    """Mean token cross-entropy with label masking (fp32 accumulation)."""
+    logits = logits.astype(jnp.float32)
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    label_logit = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
+    nll = (logz - label_logit) * valid
+    return nll.sum() / jnp.maximum(valid.sum(), 1)

@@ -1,0 +1,130 @@
+"""Event monitoring: TensorBoard / W&B / CSV behind one API.
+
+Parity with reference ``deepspeed/monitor/monitor.py`` (``MonitorMaster``
+:29, ``write_events`` :46). Events are ``(tag, value, step)`` tuples; only
+process 0 writes (rank-0 gating as in the reference's ``rank == 0`` checks).
+"""
+
+import os
+from typing import List, Tuple
+
+from deepspeed_tpu.monitor.config import DeepSpeedMonitorConfig
+from deepspeed_tpu.utils.logging import logger
+
+
+class Monitor:
+
+    def __init__(self, monitor_config):
+        self.monitor_config = monitor_config
+
+    def write_events(self, event_list):
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+
+    def __init__(self, tensorboard_config):
+        super().__init__(tensorboard_config)
+        self.summary_writer = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter  # tensorboardX fallback below
+            writer_cls = SummaryWriter
+        except ImportError:
+            try:
+                from tensorboardX import SummaryWriter
+                writer_cls = SummaryWriter
+            except ImportError:
+                logger.warning("tensorboard not available; TensorBoardMonitor disabled")
+                return
+        log_dir = os.path.join(tensorboard_config.output_path or "./runs", tensorboard_config.job_name)
+        self.summary_writer = writer_cls(log_dir=log_dir)
+
+    def write_events(self, event_list, flush=True):
+        if self.summary_writer is None:
+            return
+        for event in event_list:
+            self.summary_writer.add_scalar(*event)
+        if flush:
+            self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+
+    def __init__(self, wandb_config):
+        super().__init__(wandb_config)
+        self.enabled = False
+        try:
+            import wandb
+            self._wandb = wandb
+            wandb.init(project=wandb_config.project, group=wandb_config.group, entity=wandb_config.team)
+            self.enabled = True
+        except Exception as e:
+            logger.warning(f"wandb not available; WandbMonitor disabled ({e})")
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            self._wandb.log({name: value}, step=int(step))
+
+
+class csvMonitor(Monitor):
+
+    def __init__(self, csv_config):
+        super().__init__(csv_config)
+        self.filenames = {}
+        self.output_path = os.path.join(csv_config.output_path or "./csv_logs", csv_config.job_name)
+        os.makedirs(self.output_path, exist_ok=True)
+
+    def write_events(self, event_list):
+        import csv
+        for name, value, step in event_list:
+            fname = os.path.join(self.output_path, name.replace("/", "_") + ".csv")
+            new = fname not in self.filenames
+            self.filenames[fname] = True
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new and os.path.getsize(fname) == 0:
+                    w.writerow(["step", name])
+                w.writerow([int(step), float(value)])
+
+
+class MonitorMaster(Monitor):
+    """Dispatches events to every enabled backend (reference
+    ``monitor.py:29``)."""
+
+    def __init__(self, monitor_config: DeepSpeedMonitorConfig):
+        super().__init__(monitor_config)
+        self.tb_monitor = None
+        self.wandb_monitor = None
+        self.csv_monitor = None
+        rank = _rank()
+        if rank == 0:
+            if monitor_config.tensorboard.enabled:
+                self.tb_monitor = TensorBoardMonitor(monitor_config.tensorboard)
+            if monitor_config.wandb.enabled:
+                self.wandb_monitor = WandbMonitor(monitor_config.wandb)
+            if monitor_config.csv_monitor.enabled:
+                self.csv_monitor = csvMonitor(monitor_config.csv_monitor)
+
+    @property
+    def enabled(self):
+        return any(m is not None for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor))
+
+    def write_events(self, event_list: List[Tuple]):
+        if _rank() != 0:
+            return
+        if self.tb_monitor is not None:
+            self.tb_monitor.write_events(event_list)
+        if self.wandb_monitor is not None:
+            self.wandb_monitor.write_events(event_list)
+        if self.csv_monitor is not None:
+            self.csv_monitor.write_events(event_list)
+
+
+def _rank():
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0

@@ -1,0 +1,3 @@
+from deepspeed_tpu.ops.adam.fused_adam import FusedAdam, fused_adam
+from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb, fused_lamb
+from deepspeed_tpu.ops.adagrad.cpu_adagrad import DeepSpeedCPUAdagrad, adagrad

@@ -1,0 +1,1 @@
+from deepspeed_tpu.ops.adagrad.cpu_adagrad import DeepSpeedCPUAdagrad, adagrad

@@ -1,0 +1,34 @@
+"""Adagrad optimizer (reference ``csrc/adagrad/cpu_adagrad.cpp`` /
+``ops/adagrad/cpu_adagrad.py``). Device version; the host-offloaded C++
+SIMD path plugs in through the offload manager."""
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class AdagradState(NamedTuple):
+    count: jax.Array
+    accum: Any
+
+
+def adagrad(lr=1e-2, eps=1e-10, weight_decay: float = 0.0) -> optax.GradientTransformation:
+
+    def init(params):
+        return AdagradState(count=jnp.zeros([], jnp.int32), accum=jax.tree.map(jnp.zeros_like, params))
+
+    def update(grads, state, params=None):
+        if weight_decay > 0.0:
+            assert params is not None
+            grads = jax.tree.map(lambda g, p: g + weight_decay * p, grads, params)
+        accum = jax.tree.map(lambda a, g: a + jnp.square(g), state.accum, grads)
+        step_lr = lr(state.count + 1) if callable(lr) else lr
+        updates = jax.tree.map(lambda g, a: -step_lr * g / (jnp.sqrt(a) + eps), grads, accum)
+        return updates, AdagradState(count=state.count + 1, accum=accum)
+
+    return optax.GradientTransformation(init, update)
+
+
+DeepSpeedCPUAdagrad = adagrad

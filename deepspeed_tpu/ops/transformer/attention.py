@@ -1,0 +1,91 @@
+"""Attention ops.
+
+This is the seam where attention backends plug in — the TPU analog of the
+reference's fused attention kernels (``csrc/transformer/softmax_kernels.cu``,
+inference ``softmax_context``) and of its block-sparse Triton attention
+(``deepspeed/ops/sparse_attention/``). Backends:
+
+* ``xla``      — reference einsum/softmax implementation (always available,
+                 used for kernel-parity tests).
+* ``flash``    — Pallas blockwise flash attention (``ops.pallas.flash_attention``).
+* ``ring``     — sequence-parallel ring attention over the ``sequence`` mesh
+                 axis (long-context capability, SURVEY §2.3).
+
+All take ``[batch, length, heads, head_dim]`` (BLHD) tensors.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_BACKENDS = {}
+
+
+def register_backend(name):
+
+    def deco(fn):
+        _BACKENDS[name] = fn
+        return fn
+
+    return deco
+
+
+def available_backends():
+    return sorted(_BACKENDS)
+
+
+@register_backend("xla")
+def xla_attention(q: jax.Array,
+                  k: jax.Array,
+                  v: jax.Array,
+                  *,
+                  causal: bool = True,
+                  bias: Optional[jax.Array] = None,
+                  mask: Optional[jax.Array] = None,
+                  scale: Optional[float] = None,
+                  dropout_rate: float = 0.0,
+                  dropout_rng: Optional[jax.Array] = None) -> jax.Array:
+    """Plain XLA attention: softmax(q k^T / sqrt(d) + bias) v.
+
+    fp32 softmax accumulation regardless of input dtype (matches the
+    reference's fused kernel numerics, ``softmax_kernels.cu``).
+    """
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    if scale is None:
+        scale = d**-0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    if causal:
+        q_pos = jnp.arange(lq)[:, None] + (lk - lq)  # support kv-cache decode offsets
+        k_pos = jnp.arange(lk)[None, :]
+        causal_mask = q_pos >= k_pos
+        logits = jnp.where(causal_mask[None, None], logits, jnp.finfo(jnp.float32).min)
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    probs = probs.astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def dot_product_attention(q, k, v, *, backend: str = "xla", **kwargs):
+    """Dispatch to a registered attention backend."""
+    if backend not in _BACKENDS:
+        # lazily import optional backends so plain use never pays for them
+        try:
+            if backend == "flash":
+                from deepspeed_tpu.ops.pallas import flash_attention  # noqa: F401
+            elif backend == "ring":
+                from deepspeed_tpu.parallel import ring_attention  # noqa: F401
+        except ImportError as e:
+            raise ValueError(f"attention backend {backend!r} is not available ({e}); "
+                             f"registered: {available_backends()}") from e
+    if backend not in _BACKENDS:
+        raise ValueError(f"unknown attention backend {backend!r}; available: {available_backends()}")
+    return _BACKENDS[backend](q, k, v, **kwargs)

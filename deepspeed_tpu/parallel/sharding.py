@@ -1,0 +1,157 @@
+"""Logical-axis → mesh-axis sharding rules, and the ZeRO planner's
+parameter-sharding pass.
+
+This is the declarative TPU replacement for the reference's imperative
+partitioning machinery: instead of flattening params into rank-sliced flat
+buffers (``runtime/zero/stage_1_and_2.py:595``) or patching ``nn.Module``
+constructors (``runtime/zero/partition_parameters.py:289``), every array
+gets a ``PartitionSpec`` derived from
+
+1. its *logical* axis names (t5x-style), mapped through rules that encode
+   tensor/sequence/expert parallelism, then
+2. an *fsdp pass* that shards the largest remaining divisible dimension
+   over the ``fsdp`` axis when the ZeRO stage calls for it.
+
+XLA's SPMD partitioner + latency-hiding scheduler then perform the
+gather/scatter/prefetch that the reference drives by hand
+(``partitioned_param_coordinator.py``).
+"""
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import (BATCH_AXES, DATA_AXIS, EXPERT_AXIS, FSDP_AXIS, SEQUENCE_AXIS,
+                                             TENSOR_AXIS, MeshTopology)
+
+# Default logical → mesh rules (first match wins). Models annotate their
+# params/activations with these names (cf. t5x partitioning rules).
+DEFAULT_LOGICAL_RULES: Tuple[Tuple[str, Any], ...] = (
+    ("batch", BATCH_AXES),
+    ("length", SEQUENCE_AXIS),  # activation sequence dim (sequence parallelism)
+    ("vocab", TENSOR_AXIS),
+    ("embed", None),
+    ("mlp", TENSOR_AXIS),
+    ("heads", TENSOR_AXIS),
+    ("kv", None),
+    ("expert", EXPERT_AXIS),
+    ("expert_mlp", TENSOR_AXIS),
+    ("unmodeled", None),
+    ("norm", None),
+    ("relpos_buckets", None),
+)
+
+
+def logical_to_mesh_spec(logical_axes: Sequence[Optional[str]], rules=DEFAULT_LOGICAL_RULES) -> P:
+    """Map a tuple of logical axis names to a PartitionSpec."""
+    rule_map = dict(rules)
+    parts = []
+    used = set()
+    for name in logical_axes:
+        target = rule_map.get(name) if name is not None else None
+        # never assign the same mesh axis to two dims of one array
+        flat = target if isinstance(target, tuple) else (target,) if target else ()
+        if any(t in used for t in flat):
+            target = None
+        for t in flat:
+            used.add(t)
+        parts.append(target)
+    return P(*parts)
+
+
+def _spec_used_axes(spec: P):
+    used = set()
+    for part in spec:
+        if part is None:
+            continue
+        if isinstance(part, (tuple, list)):
+            used.update(part)
+        else:
+            used.add(part)
+    return used
+
+
+def add_fsdp_sharding(spec: P, shape: Sequence[int], fsdp_size: int, min_size: int = 0) -> P:
+    """The ZeRO-3 pass: extend ``spec`` by sharding one dimension over the
+    ``fsdp`` axis.
+
+    Picks the largest dimension that is unassigned and divisible by
+    ``fsdp_size``. Arrays smaller than ``min_size`` elements stay replicated
+    — the analog of the reference's ``stage3_param_persistence_threshold``
+    (small params are kept gathered, ``parameter_offload.py:350``).
+    """
+    if fsdp_size <= 1:
+        return spec
+    if int(np.prod(shape)) < max(min_size, fsdp_size):
+        return spec
+    used = _spec_used_axes(spec)
+    if FSDP_AXIS in used:
+        return spec
+    parts = list(spec) + [None] * (len(shape) - len(spec))
+    candidates = [(dim_size, i) for i, dim_size in enumerate(shape) if parts[i] is None and dim_size % fsdp_size == 0]
+    if not candidates:
+        return spec
+    _, best = max(candidates)
+    parts[best] = FSDP_AXIS
+    return P(*parts)
+
+
+def zero_param_spec(logical_axes: Sequence[Optional[str]],
+                    shape: Sequence[int],
+                    zero_stage: int,
+                    fsdp_size: int,
+                    persistence_threshold: int = 0,
+                    rules=DEFAULT_LOGICAL_RULES) -> P:
+    """PartitionSpec for a *parameter* under a given ZeRO stage.
+
+    stage 0-2: params replicated over data/fsdp (TP/EP sharding still applies);
+    stage 3: params additionally sharded over ``fsdp``
+    (reference ``runtime/zero/stage3.py`` / ``partition_parameters.py``).
+    """
+    spec = logical_to_mesh_spec(logical_axes, rules)
+    if zero_stage >= 3:
+        spec = add_fsdp_sharding(spec, shape, fsdp_size, min_size=persistence_threshold)
+    return spec
+
+
+def zero_optstate_spec(param_spec: P, shape: Sequence[int], zero_stage: int, fsdp_size: int) -> P:
+    """PartitionSpec for *optimizer state* mirroring a param.
+
+    stage >= 1 shards optimizer states over ``fsdp``
+    (reference ``stage_1_and_2.py``: each rank owns 1/N of the flat
+    optimizer state); stage 3 states simply follow the (already sharded)
+    param spec.
+    """
+    if zero_stage >= 1:
+        return add_fsdp_sharding(param_spec, shape, fsdp_size)
+    return param_spec
+
+
+def zero_grad_spec(param_spec: P, shape: Sequence[int], zero_stage: int, fsdp_size: int) -> P:
+    """PartitionSpec for a *gradient* during the step.
+
+    stage >= 2 keeps only the local shard of each grad after reduction
+    (reduce-scatter instead of all-reduce, reference
+    ``stage_1_and_2.py:948`` ``average_tensor`` / ``stage3.py:1176``).
+    """
+    if zero_stage >= 2:
+        return add_fsdp_sharding(param_spec, shape, fsdp_size)
+    return param_spec
+
+
+def tree_param_specs(logical_tree, shape_tree, zero_stage, fsdp_size, persistence_threshold=0,
+                     rules=DEFAULT_LOGICAL_RULES):
+    """Map pytrees of logical-axis tuples + shapes to PartitionSpecs."""
+    return jax.tree.map(
+        lambda axes, shape: zero_param_spec(axes, shape, zero_stage, fsdp_size, persistence_threshold, rules),
+        logical_tree,
+        shape_tree,
+        is_leaf=lambda x: isinstance(x, tuple) and all(isinstance(e, (str, type(None))) for e in x),
+    )
+
+
+def tree_shardings(mesh: Mesh, spec_tree):
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), spec_tree,
+                        is_leaf=lambda x: isinstance(x, P))

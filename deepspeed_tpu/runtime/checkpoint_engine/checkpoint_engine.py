@@ -1,0 +1,25 @@
+"""Pluggable checkpoint backend ABC.
+
+Parity with reference ``runtime/checkpoint_engine/checkpoint_engine.py``
+(``CheckpointEngine``): save/load with tags plus commit semantics so async
+backends (the reference's Nebula; here Orbax async) can defer durability.
+"""
+
+
+class CheckpointEngine:
+
+    def __init__(self, config_params=None):
+        pass
+
+    def create(self, tag):
+        """Log the start of a checkpoint for ``tag``."""
+
+    def save(self, state, tag, metadata=None):
+        raise NotImplementedError
+
+    def load(self, state, shardings, tag, **kwargs):
+        raise NotImplementedError
+
+    def commit(self, tag):
+        """Mark ``tag`` durable (all shards written)."""
+        return True

@@ -1,0 +1,57 @@
+"""Orbax-backed checkpoint engine.
+
+The default backend (role of reference ``TorchCheckpointEngine``). Orbax
+writes each array as a sharded tensorstore with a global index, which gives
+us, for free, the reference's hardest checkpoint feature: loading with a
+*different* topology/world size than the one that saved (the reference
+needs offline reshape machinery for this, ``checkpoint/reshape_meg_2d.py``,
+``deepspeed_checkpoint.py``) — restore simply reads each array with the new
+sharding.
+"""
+
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import CheckpointEngine
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class OrbaxCheckpointEngine(CheckpointEngine):
+
+    def __init__(self, base_dir, config_params=None, use_async: bool = False):
+        super().__init__(config_params)
+        self.base_dir = os.path.abspath(base_dir)
+        self.use_async = use_async
+        self._ckptr = ocp.StandardCheckpointer()
+
+    def _path(self, tag):
+        return os.path.join(self.base_dir, str(tag))
+
+    def save(self, state, tag, metadata: Optional[dict] = None):
+        path = self._path(tag)
+        self._ckptr.save(os.path.join(path, "state"), state, force=True)
+        if metadata is not None and jax.process_index() == 0:
+            with open(os.path.join(path, "metadata.json"), "w") as f:
+                json.dump(metadata, f)
+        log_dist(f"saved checkpoint {tag} -> {path}")
+
+    def load(self, state, shardings, tag, load_optimizer_states=True, load_module_only=False):
+        path = self._path(tag)
+        abstract = jax.tree.map(
+            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s), state, shardings)
+        restored = self._ckptr.restore(os.path.join(path, "state"), abstract)
+        if load_module_only or not load_optimizer_states:
+            # keep current optimizer state / counters, take params only
+            restored = state._replace(params=restored.params) if load_module_only else \
+                state._replace(params=restored.params, step=restored.step, loss_scale=restored.loss_scale)
+        meta = {}
+        meta_path = os.path.join(path, "metadata.json")
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+        log_dist(f"loaded checkpoint {tag} from {path}")
+        return restored, meta

@@ -81,7 +81,7 @@ class DeepSpeedConfig:
     """Parses and validates the full config (reference ``DeepSpeedConfig``,
     ``runtime/config.py``)."""
 
-    def __init__(self, config, mesh=None, world_size: Optional[int] = None):
+    def __init__(self, config, world_size: Optional[int] = None, dp_world_size: Optional[int] = None):
         if isinstance(config, str):
             if not os.path.exists(config):
                 raise DeepSpeedConfigError(f"Expected a string path to an existing deepspeed config, got {config}")
@@ -94,7 +94,12 @@ class DeepSpeedConfig:
 
         self._initialize_params(self._param_dict)
         self.mesh_config = MeshConfig(**self._param_dict.get(C.MESH, {}))
-        self._resolve_batch_size(world_size)
+        self._raw_batch_triangle = (self.train_batch_size, self.train_micro_batch_size_per_gpu,
+                                    self.gradient_accumulation_steps)
+        if dp_world_size is not None:
+            self.resolve_batch_for_dp(dp_world_size)
+        else:
+            self._resolve_batch_size(world_size)
         self._do_sanity_check()
 
     # ------------------------------------------------------------------
@@ -144,7 +149,8 @@ class DeepSpeedConfig:
         self.dynamic_loss_scale_args = dict(init_scale=2**self.fp16_config.initial_scale_power,
                                             scale_window=self.fp16_config.loss_scale_window,
                                             min_scale=self.fp16_config.min_loss_scale,
-                                            delayed_shift=self.fp16_config.hysteresis)
+                                            delayed_shift=self.fp16_config.hysteresis,
+                                            consecutive_hysteresis=self.fp16_config.consecutive_hysteresis)
 
         # zero
         self.zero_config = DeepSpeedZeroConfig(**param_dict.get(C.ZERO_OPTIMIZATION, {}))
@@ -180,11 +186,13 @@ class DeepSpeedConfig:
         denom = mesh.pipe * mesh.tensor * mesh.sequence
         if world_size % denom != 0:
             raise DeepSpeedConfigError(f"world size {world_size} not divisible by pipe*tensor*sequence={denom}")
-        self.dp_world_size = world_size // denom
+        self.resolve_batch_for_dp(world_size // denom)
 
-        train_batch = self.train_batch_size
-        micro_batch = self.train_micro_batch_size_per_gpu
-        grad_acc = self.gradient_accumulation_steps
+    def resolve_batch_for_dp(self, dp_world_size: int):
+        """Re-run the triangle for an explicit DP world size (used when an
+        explicit MeshTopology overrides the config's mesh block)."""
+        self.dp_world_size = dp_world_size
+        train_batch, micro_batch, grad_acc = self._raw_batch_triangle
 
         if train_batch is not None and micro_batch is not None and grad_acc is not None:
             pass
@@ -208,6 +216,7 @@ class DeepSpeedConfig:
         self.train_batch_size = train_batch
         self.train_micro_batch_size_per_gpu = micro_batch
         self.gradient_accumulation_steps = grad_acc
+        self._batch_assertion()
 
     def _batch_assertion(self):
         train_batch = self.train_batch_size
@@ -221,7 +230,7 @@ class DeepSpeedConfig:
             f"gradient_acc_step * world_size {train_batch} != {micro_batch} * {grad_acc} * {self.dp_world_size}")
 
     def _do_sanity_check(self):
-        self._batch_assertion()
+        # batch triangle already asserted inside resolve_batch_for_dp
         if self.fp16_enabled and self.bfloat16_enabled:
             raise DeepSpeedConfigError("fp16 and bf16 modes cannot be simultaneously enabled")
         if self.optimizer_name is not None and self.optimizer_name not in C.DEEPSPEED_OPTIMIZERS:

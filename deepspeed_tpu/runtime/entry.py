@@ -1,0 +1,73 @@
+"""Top-level ``initialize`` — parity with reference ``deepspeed/__init__.py:64``.
+
+``deepspeed.initialize(args, model, ...) -> (engine, optimizer, dataloader,
+lr_scheduler)``: the same 4-tuple, with JAX-native contents (the model is a
+flax Module, the optimizer an optax GradientTransformation, the scheduler a
+``step -> lr`` callable).
+"""
+
+import argparse
+from typing import Optional
+
+from deepspeed_tpu import comm as dist
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.utils.logging import log_dist
+from deepspeed_tpu.version import __version__
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               topology=None,
+               mpu=None,
+               dist_init_required: Optional[bool] = None,
+               collate_fn=None,
+               config=None,
+               config_params=None,
+               loss_fn=None):
+    """Build the training engine (reference ``__init__.py:64-202``).
+
+    Returns ``(engine, optimizer, training_dataloader, lr_scheduler)``.
+    ``config`` is a dict or JSON path; ``args.deepspeed_config`` is honored
+    for parity. ``mpu`` is accepted but unused: the mesh topology subsumes
+    it (pass ``topology=`` to override)."""
+    assert model is not None, "deepspeed.initialize requires a model"
+    log_dist(f"DeepSpeed-TPU info: version={__version__}")
+
+    if config is None:
+        config = config_params
+    if config is None and args is not None and getattr(args, "deepspeed_config", None) is not None:
+        config = args.deepspeed_config
+    assert config is not None, "DeepSpeed requires --deepspeed_config or the config= argument"
+
+    if dist_init_required is None or dist_init_required:
+        dist.init_distributed(verbose=False)
+
+    ds_config = DeepSpeedConfig(config,
+                                dp_world_size=topology.data_parallel_size if topology is not None else None)
+    engine = DeepSpeedEngine(model=model,
+                             config=ds_config,
+                             optimizer=optimizer,
+                             loss_fn=loss_fn,
+                             lr_scheduler=lr_scheduler,
+                             topology=topology,
+                             model_parameters=model_parameters,
+                             training_data=training_data,
+                             collate_fn=collate_fn)
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def add_config_arguments(parser: argparse.ArgumentParser):
+    """Reference ``__init__.py:246``: add --deepspeed flags to an argparser."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed (helper flag to wrap scripts)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to the DeepSpeed json configuration")
+    group.add_argument("--deepscale", default=False, action="store_true", help=argparse.SUPPRESS)
+    group.add_argument("--deepscale_config", default=None, type=str, help=argparse.SUPPRESS)
+    return parser

@@ -1,0 +1,82 @@
+"""Loss scaling for fp16 training.
+
+Functional analog of reference ``runtime/fp16/loss_scaler.py`` (``LossScaler``
+/ ``DynamicLossScaler``): the scaler is a small pytree carried in the train
+state, and scale updates are jit-friendly ``jnp.where`` selects — the
+reference's CPU-side branching (``has_overflow``/``update_scale``) becomes
+part of the compiled step, with overflow-skip handled by the engine.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+INITIAL_LOSS_SCALE = "init_scale"
+SCALE_WINDOW = "scale_window"
+DELAYED_SHIFT = "delayed_shift"
+CONSECUTIVE_HYSTERESIS = "consecutive_hysteresis"
+MIN_LOSS_SCALE = "min_scale"
+
+
+class LossScaleState(NamedTuple):
+    """Carried in the train state (arrays only — the dynamic/static flag is
+    closed over in the update fn so the state stays a clean pytree). For
+    static scaling only ``loss_scale`` matters and update() is identity."""
+    loss_scale: jax.Array  # f32 scalar
+    good_steps: jax.Array  # consecutive overflow-free steps (i32)
+    hysteresis: jax.Array  # remaining tolerated overflows before scale cut (i32)
+
+
+def create_loss_scaler(static_loss_scale: float = 0.0,
+                       init_scale: float = 2**16,
+                       scale_window: int = 1000,
+                       min_scale: float = 1.0,
+                       delayed_shift: int = 2,
+                       consecutive_hysteresis: bool = False):
+    """Returns (initial LossScaleState, update_fn, static config dict).
+
+    ``static_loss_scale > 0`` selects static scaling (reference
+    ``CreateLossScaler``: fp16 + loss_scale!=0 → ``LossScaler``).
+    """
+    dynamic = static_loss_scale == 0.0
+    scale0 = init_scale if dynamic else static_loss_scale
+    state = LossScaleState(loss_scale=jnp.asarray(scale0, jnp.float32),
+                           good_steps=jnp.zeros([], jnp.int32),
+                           hysteresis=jnp.asarray(delayed_shift, jnp.int32))
+
+    def update(state: LossScaleState, overflow: jax.Array) -> LossScaleState:
+        if not dynamic:
+            return state
+        scale_factor = 2.0
+        # on overflow: consume hysteresis; cut scale only when exhausted
+        hysteresis_left = jnp.maximum(state.hysteresis - 1, 0)
+        cut_scale = jnp.maximum(state.loss_scale / scale_factor, min_scale)
+        new_scale_ovf = jnp.where(state.hysteresis <= 1, cut_scale, state.loss_scale)
+        # no overflow: grow scale every scale_window good steps
+        good = state.good_steps + 1
+        grow = (good % scale_window) == 0
+        new_scale_ok = jnp.where(grow, state.loss_scale * scale_factor, state.loss_scale)
+        new_hyst_ok = (jnp.asarray(delayed_shift, jnp.int32)
+                       if not consecutive_hysteresis else jnp.where(grow, delayed_shift, state.hysteresis))
+        return LossScaleState(
+            loss_scale=jnp.where(overflow, new_scale_ovf, new_scale_ok),
+            good_steps=jnp.where(overflow, 0, good),
+            hysteresis=jnp.where(overflow, hysteresis_left, new_hyst_ok),
+        )
+
+    return state, update
+
+
+def has_overflow(grads) -> jax.Array:
+    """Global overflow check: any non-finite value in any grad (reference
+    ``has_overflow_serial``/partitioned variants; the psum across ranks is
+    implicit under SPMD)."""
+    leaves = jax.tree.leaves(grads)
+    if not leaves:
+        return jnp.zeros([], bool)
+    flags = [~jnp.isfinite(g).all() for g in leaves]
+    out = flags[0]
+    for f in flags[1:]:
+        out = out | f
+    return out

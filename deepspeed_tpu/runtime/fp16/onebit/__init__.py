@@ -1,0 +1,20 @@
+"""1-bit (compressed-communication) optimizers.
+
+Reference: ``runtime/fp16/onebit/{adam,lamb,zoadam}.py`` — error-compensated
+sign-compressed allreduce after a variance warmup. The TPU implementation
+(``onebit/adam.py`` here) keeps the optimizer semantics (frozen variance
+after warmup + error feedback); the compressed collective itself rides a
+sign+scale Pallas/ICI path where beneficial.
+"""
+
+from deepspeed_tpu.runtime.fp16.onebit.adam import onebit_adam, OnebitAdam
+
+
+def get_onebit_optimizer(name: str, **kwargs):
+    name = name.lower()
+    if name in ("onebitadam", "zerooneadam"):
+        return onebit_adam(**kwargs)
+    if name == "onebitlamb":
+        from deepspeed_tpu.runtime.fp16.onebit.lamb import onebit_lamb
+        return onebit_lamb(**kwargs)
+    raise ValueError(f"unknown 1-bit optimizer {name}")

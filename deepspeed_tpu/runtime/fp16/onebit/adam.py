@@ -1,0 +1,89 @@
+"""1-bit Adam: error-compensated momentum compression.
+
+Reference ``runtime/fp16/onebit/adam.py:307``: run vanilla Adam for a
+``freeze_step`` warmup, then freeze the variance term and communicate only
+the *sign* of the momentum with an error-feedback buffer (compensation for
+the quantization error), cutting DP gradient traffic ~32×.
+
+TPU design: the optimizer semantics live here as an optax transform carried
+in the sharded train state. In the compression phase the momentum update is
+``sign(m + e) * scale`` with ``e`` the carried compensation error — this is
+mathematically the all-reduced compressed momentum when gradients are
+already mean-reduced by the engine (the engine reduces grads before the
+optimizer, so compression here reproduces the reference's post-allreduce
+server-averaged momentum; a shard_map sign-compressed collective variant
+is the comm-bound optimization path).
+"""
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class OnebitAdamState(NamedTuple):
+    count: jax.Array
+    exp_avg: Any
+    exp_avg_sq: Any  # frozen after freeze_step
+    error_feedback: Any
+
+
+def onebit_adam(lr=1e-3,
+                freeze_step: int = 100000,
+                betas: Tuple[float, float] = (0.9, 0.999),
+                eps: float = 1e-8,
+                weight_decay: float = 0.0,
+                cuda_aware: bool = False,
+                comm_backend_name: str = "ici",
+                **_ignored) -> optax.GradientTransformation:
+    b1, b2 = betas
+
+    def init(params):
+        zeros = lambda: jax.tree.map(jnp.zeros_like, params)
+        return OnebitAdamState(count=jnp.zeros([], jnp.int32),
+                               exp_avg=zeros(),
+                               exp_avg_sq=zeros(),
+                               error_feedback=zeros())
+
+    def update(grads, state, params=None):
+        assert params is not None
+        count = state.count + 1
+        step_lr = lr(count) if callable(lr) else lr
+        warmup = count <= freeze_step
+
+        exp_avg = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state.exp_avg, grads)
+        # variance updates only during warmup (then frozen)
+        exp_avg_sq = jax.tree.map(
+            lambda v, g: jnp.where(warmup, b2 * v + (1 - b2) * jnp.square(g), v), state.exp_avg_sq, grads)
+
+        def _compressed(m, e):
+            # sign compression with error feedback: scale preserves l1 mass
+            corrected = m + e
+            scale = jnp.mean(jnp.abs(corrected))
+            comp = jnp.sign(corrected) * scale
+            new_e = corrected - comp
+            return comp, new_e
+
+        comp_and_err = jax.tree.map(_compressed, exp_avg, state.error_feedback)
+        comp = jax.tree.map(lambda ce: ce[0], comp_and_err, is_leaf=lambda x: isinstance(x, tuple))
+        new_err = jax.tree.map(lambda ce: ce[1], comp_and_err, is_leaf=lambda x: isinstance(x, tuple))
+        # during warmup, momentum is exact and error feedback stays zero
+        momentum = jax.tree.map(lambda m, c: jnp.where(warmup, m, c), exp_avg, comp)
+        err = jax.tree.map(lambda e0, e1: jnp.where(warmup, e0, e1), state.error_feedback, new_err)
+
+        def _direction(m, v, p):
+            upd = m / (jnp.sqrt(v) + eps)
+            if weight_decay > 0.0:
+                upd = upd + weight_decay * p
+            return -step_lr * upd
+
+        updates = jax.tree.map(_direction, momentum, exp_avg_sq, params)
+        return updates, OnebitAdamState(count=count, exp_avg=exp_avg, exp_avg_sq=exp_avg_sq,
+                                        error_feedback=err)
+
+    return optax.GradientTransformation(init, update)
+
+
+def OnebitAdam(params=None, **kwargs):
+    return onebit_adam(**kwargs)

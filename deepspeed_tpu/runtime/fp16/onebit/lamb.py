@@ -1,0 +1,81 @@
+"""1-bit LAMB (reference ``runtime/fp16/onebit/lamb.py:443``): 1-bit Adam's
+compression scheme + LAMB trust-ratio scaling with the ratio frozen to its
+warmup-end value during the compression phase."""
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class OnebitLambState(NamedTuple):
+    count: jax.Array
+    exp_avg: Any
+    exp_avg_sq: Any
+    error_feedback: Any
+    frozen_ratio: Any  # per-tensor trust ratio captured at freeze_step
+
+
+def onebit_lamb(lr=1e-3,
+                freeze_step: int = 100000,
+                betas: Tuple[float, float] = (0.9, 0.999),
+                eps: float = 1e-8,
+                weight_decay: float = 0.0,
+                max_coeff: float = 10.0,
+                min_coeff: float = 0.01,
+                **_ignored) -> optax.GradientTransformation:
+    b1, b2 = betas
+
+    def init(params):
+        zeros = lambda: jax.tree.map(jnp.zeros_like, params)
+        ones = jax.tree.map(lambda p: jnp.ones([], jnp.float32), params)
+        return OnebitLambState(count=jnp.zeros([], jnp.int32),
+                               exp_avg=zeros(),
+                               exp_avg_sq=zeros(),
+                               error_feedback=zeros(),
+                               frozen_ratio=ones)
+
+    def update(grads, state, params=None):
+        assert params is not None
+        count = state.count + 1
+        step_lr = lr(count) if callable(lr) else lr
+        warmup = count <= freeze_step
+
+        exp_avg = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state.exp_avg, grads)
+        exp_avg_sq = jax.tree.map(
+            lambda v, g: jnp.where(warmup, b2 * v + (1 - b2) * jnp.square(g), v), state.exp_avg_sq, grads)
+
+        def _compressed(m, e):
+            corrected = m + e
+            scale = jnp.mean(jnp.abs(corrected))
+            comp = jnp.sign(corrected) * scale
+            return comp, corrected - comp
+
+        ce = jax.tree.map(_compressed, exp_avg, state.error_feedback)
+        comp = jax.tree.map(lambda t: t[0], ce, is_leaf=lambda x: isinstance(x, tuple))
+        new_err = jax.tree.map(lambda t: t[1], ce, is_leaf=lambda x: isinstance(x, tuple))
+        momentum = jax.tree.map(lambda m, c: jnp.where(warmup, m, c), exp_avg, comp)
+        err = jax.tree.map(lambda e0, e1: jnp.where(warmup, e0, e1), state.error_feedback, new_err)
+
+        def _trust_and_dir(m, v, p, frozen):
+            adam_step = m / (jnp.sqrt(v) + eps)
+            if weight_decay > 0.0:
+                adam_step = adam_step + weight_decay * p
+            w_norm = jnp.linalg.norm(p.reshape(-1))
+            u_norm = jnp.linalg.norm(adam_step.reshape(-1))
+            live = jnp.where((w_norm > 0) & (u_norm > 0), jnp.clip(w_norm / u_norm, min_coeff, max_coeff), 1.0)
+            ratio = jnp.where(warmup, live, frozen)
+            return -step_lr * ratio * adam_step, jnp.where(count == freeze_step, live, frozen)
+
+        pairs = jax.tree.map(_trust_and_dir, momentum, exp_avg_sq, params, state.frozen_ratio)
+        updates = jax.tree.map(lambda t: t[0], pairs, is_leaf=lambda x: isinstance(x, tuple))
+        frozen = jax.tree.map(lambda t: t[1], pairs, is_leaf=lambda x: isinstance(x, tuple))
+        return updates, OnebitLambState(count=count, exp_avg=exp_avg, exp_avg_sq=exp_avg_sq,
+                                        error_feedback=err, frozen_ratio=frozen)
+
+    return optax.GradientTransformation(init, update)
+
+
+def OnebitLamb(params=None, **kwargs):
+    return onebit_lamb(**kwargs)

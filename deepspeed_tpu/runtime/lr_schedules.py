@@ -1,0 +1,110 @@
+"""LR schedules, parity with reference ``deepspeed/runtime/lr_schedules.py``:
+``WarmupLR``, ``WarmupDecayLR``, ``OneCycle``, ``LRRangeTest`` — as pure
+``step -> lr`` callables usable both inside jit (schedule passed to the
+optimizer) and from the engine's scheduler shim.
+"""
+
+import math
+from typing import Callable
+
+import jax.numpy as jnp
+
+WARMUP_LR = "WarmupLR"
+WARMUP_DECAY_LR = "WarmupDecayLR"
+ONE_CYCLE = "OneCycle"
+LR_RANGE_TEST = "LRRangeTest"
+VALID_LR_SCHEDULES = [WARMUP_LR, WARMUP_DECAY_LR, ONE_CYCLE, LR_RANGE_TEST]
+
+
+def warmup_lr(warmup_min_lr: float = 0.0,
+              warmup_max_lr: float = 0.001,
+              warmup_num_steps: int = 1000,
+              warmup_type: str = "log") -> Callable:
+    """Reference ``WarmupLR``: log or linear ramp then constant."""
+    warmup_num_steps = max(2, warmup_num_steps)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        frac = jnp.clip(step / warmup_num_steps, 0.0, 1.0)
+        if warmup_type == "log":
+            # log(step)/log(N) ramp as in the reference (guard step<1)
+            frac = jnp.where(step < warmup_num_steps,
+                             jnp.log(jnp.maximum(step, 1.0)) / math.log(warmup_num_steps), 1.0)
+        return warmup_min_lr + (warmup_max_lr - warmup_min_lr) * frac
+
+    return schedule
+
+
+def warmup_decay_lr(total_num_steps: int,
+                    warmup_min_lr: float = 0.0,
+                    warmup_max_lr: float = 0.001,
+                    warmup_num_steps: int = 1000,
+                    warmup_type: str = "log") -> Callable:
+    """Reference ``WarmupDecayLR``: warmup then linear decay to 0."""
+    base = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        decay = jnp.clip(
+            (total_num_steps - step) / max(1.0, total_num_steps - warmup_num_steps), 0.0, 1.0)
+        return jnp.where(step < warmup_num_steps, base(step), warmup_max_lr * decay)
+
+    return schedule
+
+
+def one_cycle(cycle_min_lr: float,
+              cycle_max_lr: float,
+              cycle_first_step_size: int = 2000,
+              cycle_second_step_size: int = None,
+              decay_step_size: int = 0,
+              decay_lr_rate: float = 0.0,
+              **_unused) -> Callable:
+    """Reference ``OneCycle`` (lr triangle + optional decay tail; the
+    momentum leg is handled by the optimizer config)."""
+    if cycle_second_step_size is None:
+        cycle_second_step_size = cycle_first_step_size
+    total_cycle = cycle_first_step_size + cycle_second_step_size
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        up = cycle_min_lr + (cycle_max_lr - cycle_min_lr) * (step / cycle_first_step_size)
+        down = cycle_max_lr - (cycle_max_lr - cycle_min_lr) * ((step - cycle_first_step_size) /
+                                                               cycle_second_step_size)
+        in_cycle = jnp.where(step < cycle_first_step_size, up, jnp.maximum(down, cycle_min_lr))
+        if decay_step_size > 0:
+            decay_steps = jnp.maximum(step - total_cycle, 0.0) / decay_step_size
+            tail = cycle_min_lr * (1.0 / (1.0 + decay_lr_rate * decay_steps))
+            return jnp.where(step > total_cycle, tail, in_cycle)
+        return in_cycle
+
+    return schedule
+
+
+def lr_range_test(lr_range_test_min_lr: float = 1e-3,
+                  lr_range_test_step_size: int = 2000,
+                  lr_range_test_step_rate: float = 1.0,
+                  lr_range_test_staircase: bool = False) -> Callable:
+    """Reference ``LRRangeTest``: linearly/staircase increasing lr probe."""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        interval = step / lr_range_test_step_size
+        if lr_range_test_staircase:
+            interval = jnp.floor(interval)
+        return lr_range_test_min_lr * (1.0 + interval * lr_range_test_step_rate)
+
+    return schedule
+
+
+_SCHEDULES = {
+    WARMUP_LR: warmup_lr,
+    WARMUP_DECAY_LR: warmup_decay_lr,
+    ONE_CYCLE: one_cycle,
+    LR_RANGE_TEST: lr_range_test,
+}
+
+
+def get_lr_schedule(name: str, params: dict) -> Callable:
+    if name not in _SCHEDULES:
+        raise ValueError(f"unknown lr schedule {name!r}; valid: {VALID_LR_SCHEDULES}")
+    return _SCHEDULES[name](**params)

@@ -1,0 +1,2 @@
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+from deepspeed_tpu.runtime.zero.planner import ZeroPlan, build_plan, resolve_topology_axes

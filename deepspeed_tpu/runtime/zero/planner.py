@@ -1,0 +1,202 @@
+"""ZeRO planner: derives every array's sharding from config + topology.
+
+This module is the TPU-native replacement for the reference's three ZeRO
+optimizers (``runtime/zero/stage_1_and_2.py:90``, ``stage3.py:67``) and the
+``zero.Init`` construction-time partitioner (``partition_parameters.py``).
+In JAX, ZeRO is not a runtime mechanism but a *placement policy*:
+
+=====  ==========================================================
+stage  sharding policy (over the ``fsdp`` mesh axis)
+=====  ==========================================================
+0      everything replicated across DP; grads all-reduced (psum)
+1      optimizer states sharded; params/grads replicated
+2      + gradients reduce-scattered (grads sharded after reduction)
+3      + parameters sharded at rest, gathered on use by XLA
+=====  ==========================================================
+
+hpZ (ZeRO++) and MiCS shrink the ``fsdp`` axis below the full DP world
+(the ``data`` axis holds the replicas) — see ``topology.py``. The XLA
+latency-hiding scheduler performs the prefetch/overlap that the reference
+implements via the ``PartitionedParameterCoordinator`` trace machinery.
+"""
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import flax.linen as nn
+
+from deepspeed_tpu.parallel import topology as topo_mod
+from deepspeed_tpu.parallel.sharding import (DEFAULT_LOGICAL_RULES, add_fsdp_sharding, logical_to_mesh_spec)
+from deepspeed_tpu.parallel.topology import FSDP_AXIS, MeshTopology
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+from deepspeed_tpu.utils.logging import logger
+
+
+def resolve_topology_axes(mesh_cfg, zero_cfg: DeepSpeedZeroConfig, n_devices: int) -> dict:
+    """Resolve mesh axis sizes from the config.
+
+    ``fsdp == -1`` (auto) is derived from the ZeRO config: stage>=1 puts all
+    remaining DP on the fsdp axis, unless hpZ (``zero_hpz_partition_size``,
+    reference ``engine.py:825``/``groups.py:428``) or MiCS
+    (``mics_shard_size``, ``runtime/zero/mics.py``) request a smaller shard
+    group, in which case ``data`` holds the replicas.
+    """
+    fixed = mesh_cfg.pipe * mesh_cfg.tensor * mesh_cfg.sequence * mesh_cfg.expert
+    if n_devices % fixed != 0:
+        raise ValueError(f"{n_devices} devices not divisible by pipe*tensor*sequence*expert={fixed}")
+    dp_total = n_devices // fixed
+
+    fsdp = mesh_cfg.fsdp
+    data = mesh_cfg.data
+    if fsdp == -1:
+        if zero_cfg.stage == 0:
+            fsdp = 1
+        elif zero_cfg.mics_shard_size and zero_cfg.mics_shard_size > 0:
+            fsdp = zero_cfg.mics_shard_size
+        elif zero_cfg.zero_hpz_partition_size and zero_cfg.zero_hpz_partition_size > 1:
+            fsdp = zero_cfg.zero_hpz_partition_size
+        elif data != -1:
+            # explicit replica axis: shard over whatever DP remains
+            if dp_total % data != 0:
+                raise ValueError(f"data axis {data} must divide DP world {dp_total}")
+            fsdp = dp_total // data
+        else:
+            fsdp = dp_total
+    if fsdp > dp_total or dp_total % fsdp != 0:
+        raise ValueError(f"fsdp size {fsdp} must divide DP world {dp_total}")
+    if data == -1:
+        data = dp_total // fsdp
+    if data * fsdp != dp_total:
+        raise ValueError(f"data({data}) * fsdp({fsdp}) != DP world ({dp_total})")
+    return dict(pipe=mesh_cfg.pipe, expert=mesh_cfg.expert, data=data, fsdp=fsdp, sequence=mesh_cfg.sequence,
+                tensor=mesh_cfg.tensor)
+
+
+def _logical_specs(abstract_variables):
+    """Pull logical-axis PartitionSpecs out of a flax variables tree whose
+    leaves may be ``nn.Partitioned`` boxes (from ``nn.with_partitioning``)."""
+    return nn.get_partition_spec(abstract_variables)
+
+
+@dataclasses.dataclass
+class ZeroPlan:
+    """All placement decisions for one training setup."""
+
+    topology: MeshTopology
+    zero_stage: int
+    param_specs: Any  # pytree of P aligned with (unboxed) params
+    grad_specs: Any
+    param_shapes: Any
+    rules: tuple = DEFAULT_LOGICAL_RULES
+
+    @property
+    def mesh(self) -> Mesh:
+        return self.topology.mesh
+
+    def param_shardings(self):
+        return jax.tree.map(lambda s: NamedSharding(self.mesh, s), self.param_specs,
+                            is_leaf=lambda x: isinstance(x, P))
+
+    def grad_shardings(self):
+        return jax.tree.map(lambda s: NamedSharding(self.mesh, s), self.grad_specs,
+                            is_leaf=lambda x: isinstance(x, P))
+
+    def optstate_specs(self, opt_state_shapes):
+        """Specs for an optimizer-state pytree: param-like leaves (matched by
+        key-path suffix against the param tree) follow the param spec plus
+        the stage>=1 fsdp pass; scalars are replicated."""
+        param_leaves = {}
+        for path, spec in jax.tree_util.tree_leaves_with_path(
+                self.param_specs, is_leaf=lambda x: isinstance(x, P)):
+            param_leaves[_path_key(path)] = spec
+        shape_map = {}
+        for path, shape in jax.tree_util.tree_leaves_with_path(self.param_shapes,
+                                                               is_leaf=lambda x: isinstance(x, tuple)):
+            shape_map[_path_key(path)] = shape
+
+        def assign(path, leaf):
+            shape = tuple(leaf.shape) if hasattr(leaf, "shape") else ()
+            if len(shape) == 0:
+                return P()
+            key = _path_key(path)
+            for plen in range(len(key), 0, -1):
+                suffix = key[-plen:]
+                if suffix in param_leaves:
+                    spec = param_leaves[suffix]
+                    if tuple(shape_map.get(suffix, ())) == shape:
+                        if self.zero_stage >= 1:
+                            spec = add_fsdp_sharding(spec, shape, self.topology.zero_partition_size)
+                        return spec
+            # unmatched non-scalar state (e.g. a schedule buffer): replicate
+            # unless fsdp-shardable at stage>=1
+            if self.zero_stage >= 1:
+                return add_fsdp_sharding(P(), shape, self.topology.zero_partition_size)
+            return P()
+
+        flat, treedef = jax.tree_util.tree_flatten_with_path(opt_state_shapes)
+        specs = [assign(path, leaf) for path, leaf in flat]
+        return jax.tree_util.tree_unflatten(treedef, specs)
+
+    def optstate_shardings(self, opt_state_shapes):
+        return jax.tree.map(lambda s: NamedSharding(self.mesh, s), self.optstate_specs(opt_state_shapes),
+                            is_leaf=lambda x: isinstance(x, P))
+
+
+def _path_key(path):
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return tuple(parts)
+
+
+def build_plan(abstract_params,
+               zero_cfg: DeepSpeedZeroConfig,
+               topology: MeshTopology,
+               rules=DEFAULT_LOGICAL_RULES) -> ZeroPlan:
+    """Build the placement plan from abstract (shape-only) params.
+
+    ``abstract_params`` is the ``params`` collection from
+    ``jax.eval_shape(model.init, ...)`` — leaves are ``nn.Partitioned``
+    boxes carrying logical axis names, or bare ShapeDtypeStructs.
+    """
+    stage = zero_cfg.stage
+    fsdp_size = topology.zero_partition_size
+    logical = _logical_specs(abstract_params)
+    unboxed = nn.meta.unbox(abstract_params)
+    shapes = jax.tree.map(lambda x: tuple(x.shape), unboxed)
+
+    def to_param_spec(lspec, shape):
+        spec = logical_to_mesh_spec(tuple(lspec), rules)
+        if stage >= 3:
+            # persistence threshold: tiny params stay replicated (reference
+            # stage3_param_persistence_threshold, parameter_offload.py:350)
+            spec = add_fsdp_sharding(spec, shape, fsdp_size,
+                                     min_size=int(zero_cfg.stage3_param_persistence_threshold))
+        return spec
+
+    param_specs = jax.tree.map(to_param_spec, logical, shapes,
+                               is_leaf=lambda x: isinstance(x, P))
+
+    def to_grad_spec(pspec, shape):
+        if stage >= 2:
+            return add_fsdp_sharding(pspec, shape, fsdp_size)
+        return pspec
+
+    grad_specs = jax.tree.map(to_grad_spec, param_specs, shapes,
+                              is_leaf=lambda x: isinstance(x, P))
+
+    n_params = sum(int(np.prod(s)) for s in jax.tree.leaves(shapes, is_leaf=lambda x: isinstance(x, tuple)))
+    logger.info(f"ZeRO plan: stage={stage} fsdp={fsdp_size} params={n_params / 1e6:.1f}M")
+    return ZeroPlan(topology=topology, zero_stage=stage, param_specs=param_specs, grad_specs=grad_specs,
+                    param_shapes=shapes, rules=rules)
