@@ -32,7 +32,8 @@ def main():
     steps = int(os.environ.get("BENCH_STEPS", "10"))
 
     n_dev = jax.device_count()
-    cfg_model = get_gpt2_config(model_name, n_positions=seq, remat=True)
+    attn = os.environ.get("BENCH_ATTN", "flash" if jax.default_backend() == "tpu" else "xla")
+    cfg_model = get_gpt2_config(model_name, n_positions=seq, remat=True, attention_backend=attn)
     model = GPT2LMHeadModel(cfg_model)
 
     ds_config = {

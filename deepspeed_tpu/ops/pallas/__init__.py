@@ -1,0 +1,5 @@
+"""Pallas TPU kernels — the TPU-native replacement for the reference's
+CUDA kernel library (``csrc/``). Each kernel has an XLA reference twin used
+in parity tests; on CPU the kernels run in Pallas interpret mode."""
+
+from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
