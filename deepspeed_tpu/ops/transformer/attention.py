@@ -81,7 +81,7 @@ def dot_product_attention(q, k, v, *, backend: str = "xla", **kwargs):
         try:
             if backend == "flash":
                 from deepspeed_tpu.ops.pallas import flash_attention  # noqa: F401
-            elif backend == "ring":
+            elif backend in ("ring", "ulysses"):
                 from deepspeed_tpu.parallel import ring_attention  # noqa: F401
         except ImportError as e:
             raise ValueError(f"attention backend {backend!r} is not available ({e}); "

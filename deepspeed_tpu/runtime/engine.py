@@ -34,7 +34,7 @@ from deepspeed_tpu import comm as dist
 from deepspeed_tpu.ops.adagrad.cpu_adagrad import adagrad
 from deepspeed_tpu.ops.adam.fused_adam import fused_adam
 from deepspeed_tpu.ops.lamb.fused_lamb import fused_lamb
-from deepspeed_tpu.parallel.topology import BATCH_AXES, MeshTopology
+from deepspeed_tpu.parallel.topology import MeshTopology
 from deepspeed_tpu.runtime import constants as C
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
 from deepspeed_tpu.runtime.fp16.loss_scaler import LossScaleState, create_loss_scaler, has_overflow
@@ -115,6 +115,8 @@ class DeepSpeedEngine:
             config.resolve_batch_for_dp(topology.data_parallel_size)
         self.topology = topology
         self.mesh = topology.mesh
+        from deepspeed_tpu.parallel.topology import set_topology
+        set_topology(topology)  # sequence-parallel attention finds the mesh here
 
         # -- precision (reference engine.py:1056-1069 half()/bfloat16())
         if config.bfloat16_enabled:
@@ -263,8 +265,8 @@ class DeepSpeedEngine:
         fp16 = self.fp16_enabled
         grad_shardings = self.plan.grad_shardings()
         mesh = self.mesh
-        batch_spec = P(None, BATCH_AXES)  # [gas, batch, ...]
-        micro_spec = P(BATCH_AXES)
+        batch_spec = self._batch_spec(with_gas_dim=True)
+        micro_spec = self._batch_spec(with_gas_dim=False)
 
         def grads_of_micro(params, mb, key, scale):
             (scaled_loss, loss), grads = jax.value_and_grad(self._loss_for, has_aux=True)(params, mb, key, scale)
@@ -387,12 +389,19 @@ class DeepSpeedEngine:
             self._train_iter = iter(RepeatingLoader(self.training_dataloader))
         return self._train_iter
 
+    def _batch_spec(self, with_gas_dim: bool) -> P:
+        """[gas?, batch, seq] spec: batch over the DP axes; the sequence dim
+        additionally over the ``sequence`` axis when sequence parallelism is
+        on (tokens then live sequence-sharded end to end — embedding lookup
+        included — and ring/Ulysses attention keeps them that way)."""
+        return self.topology.batch_spec(extra_leading=1 if with_gas_dim else 0,
+                                        shard_sequence=self.topology.sequence_parallel_size > 1)
+
     def _shard_batch(self, batch, with_gas_dim: bool):
         """Global batch dict → device arrays with the batch sharded over the
         DP axes (and optionally reshaped to [gas, micro_global, ...])."""
         gas = self.config.gradient_accumulation_steps
-        spec = P(None, BATCH_AXES) if with_gas_dim else P(BATCH_AXES)
-        sharding = NamedSharding(self.mesh, spec)
+        spec = self._batch_spec(with_gas_dim)
 
         def put(x):
             x = np.asarray(x)
@@ -400,10 +409,11 @@ class DeepSpeedEngine:
                 b = x.shape[0]
                 assert b % gas == 0, f"global batch {b} not divisible by GAS {gas}"
                 x = x.reshape((gas, b // gas) + x.shape[1:])
+            leaf_spec = P(*spec[:x.ndim])  # rank-1 leaves (e.g. weights) drop the seq part
             if jax.process_count() > 1:
                 from jax.experimental import multihost_utils
-                return multihost_utils.host_local_array_to_global_array(x, self.mesh, spec)
-            return jax.device_put(x, sharding)
+                return multihost_utils.host_local_array_to_global_array(x, self.mesh, leaf_spec)
+            return jax.device_put(x, NamedSharding(self.mesh, leaf_spec))
 
         return jax.tree.map(put, batch)
 

@@ -1,0 +1,7 @@
+"""Sequence parallelism package (upstream parity path ``deepspeed.sequence``,
+which appears in DeepSpeed >= 0.10.2 — absent from the 0.10.1 reference but a
+required capability; see SURVEY §2.3)."""
+
+from deepspeed_tpu.sequence.layer import DistributedAttention
+
+__all__ = ["DistributedAttention"]

@@ -1,0 +1,9 @@
+"""Upstream-shaped ``deepspeed.sequence.layer`` surface.
+
+Implementation lives in ``deepspeed_tpu.parallel.ring_attention`` (the
+``sequence`` mesh axis replaces the upstream sequence process group).
+"""
+
+from deepspeed_tpu.parallel.ring_attention import (DistributedAttention, ring_attention, ulysses_attention)
+
+__all__ = ["DistributedAttention", "ring_attention", "ulysses_attention"]
