@@ -39,6 +39,18 @@ class GPT2Config:
     param_dtype: Any = jnp.float32
     remat: bool = False
     attention_backend: str = "xla"
+    # MoE (reference GPT-MoE configs: every other layer is an MoE FFN)
+    moe_num_experts: int = 0  # 0 = dense model
+    moe_layer_freq: int = 2  # MoE every Nth block (reference expert-interval)
+    moe_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_eval_capacity_factor: float = 2.0
+    moe_min_capacity: int = 4
+    moe_aux_loss_coef: float = 0.01
+    moe_noisy_gate_policy: Optional[str] = None
+    moe_use_residual: bool = False
+    moe_drop_tokens: bool = True
+    moe_use_rts: bool = True
 
     @property
     def head_dim(self):
@@ -141,6 +153,7 @@ class LayerNorm(nn.Module):
 
 class Block(nn.Module):
     config: GPT2Config
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
@@ -148,8 +161,25 @@ class Block(nn.Module):
         # static (static_argnums below)
         cfg = self.config
         x = x + SelfAttention(cfg, name="attn")(LayerNorm(cfg, name="ln_1")(x), deterministic=deterministic)
-        x = x + MLP(cfg, name="mlp")(LayerNorm(cfg, name="ln_2")(x), deterministic=deterministic)
-        return x
+        h = LayerNorm(cfg, name="ln_2")(x)
+        if self.use_moe:
+            from deepspeed_tpu.moe import MoE
+            moe_out, l_aux, _ = MoE(hidden_size=cfg.n_embd,
+                                    expert=MLP(cfg),
+                                    num_experts=cfg.moe_num_experts,
+                                    k=cfg.moe_k,
+                                    capacity_factor=cfg.moe_capacity_factor,
+                                    eval_capacity_factor=cfg.moe_eval_capacity_factor,
+                                    min_capacity=cfg.moe_min_capacity,
+                                    use_residual=cfg.moe_use_residual,
+                                    noisy_gate_policy=cfg.moe_noisy_gate_policy,
+                                    drop_tokens=cfg.moe_drop_tokens,
+                                    use_rts=cfg.moe_use_rts,
+                                    name="moe")(h, deterministic=deterministic)
+            x = x + moe_out
+            return x, l_aux
+        x = x + MLP(cfg, name="mlp")(h, deterministic=deterministic)
+        return x, jnp.zeros([], jnp.float32)
 
 
 class GPT2LMHeadModel(nn.Module):
@@ -176,11 +206,16 @@ class GPT2LMHeadModel(nn.Module):
         block_cls = Block
         if cfg.remat:
             block_cls = nn.remat(Block, static_argnums=(2,), prevent_cse=False)
+        aux_total = jnp.zeros([], jnp.float32)
         for i in range(cfg.n_layer):
-            x = block_cls(cfg, name=f"h_{i}")(x, deterministic)
+            use_moe = cfg.moe_num_experts > 0 and (i % cfg.moe_layer_freq == cfg.moe_layer_freq - 1)
+            x, l_aux = block_cls(cfg, use_moe, name=f"h_{i}")(x, deterministic)
+            aux_total = aux_total + l_aux
         x = LayerNorm(cfg, name="ln_f")(x)
         # tied LM head (fp32 logits for a stable loss)
         logits = jnp.einsum("ble,ve->blv", x, wte_value.astype(cfg.dtype), preferred_element_type=jnp.float32)
+        if cfg.moe_num_experts > 0:
+            return logits, aux_total * cfg.moe_aux_loss_coef
         return logits
 
 

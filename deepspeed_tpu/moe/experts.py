@@ -1,0 +1,10 @@
+"""Experts container (reference ``deepspeed/moe/experts.py``).
+
+Kept as a separate import path for parity; the implementation lives in
+``sharded_moe.Experts`` (an ``nn.vmap`` over the expert axis rather than the
+reference's ``num_local_experts`` deep-copied modules + Python loop).
+"""
+
+from deepspeed_tpu.moe.sharded_moe import Experts
+
+__all__ = ["Experts"]

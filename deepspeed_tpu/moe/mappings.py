@@ -1,0 +1,37 @@
+"""Token gather/drop across the tensor-parallel axis
+(reference ``deepspeed/moe/mappings.py:59-89``).
+
+The reference's ``drop_tokens`` slices the sequence dim so each TP rank
+processes a distinct token slice before the MoE all-to-all, and
+``gather_tokens`` all-gathers afterwards — explicit autograd functions over
+NCCL. On TPU both are a sharding constraint: "drop" = shard the dim over
+the ``tensor`` mesh axis, "gather" = replicate it. XLA emits the
+slice/all-gather pair (and transposes them in backward) only where the
+surrounding computation actually needs it.
+"""
+
+import jax
+
+from deepspeed_tpu.parallel.topology import TENSOR_AXIS, get_topology
+
+
+def _constrain_dim(x, dim: int, axis):
+    topo = get_topology()
+    if topo is None or topo.tensor_parallel_size <= 1:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    parts = [None] * x.ndim
+    parts[dim] = axis
+    return jax.lax.with_sharding_constraint(x, NamedSharding(topo.mesh, P(*parts)))
+
+
+def drop_tokens(input_, dim: int = 0):
+    """Divide the tokens on ``dim`` across the tensor-parallel ranks
+    (reference ``mappings.py:85``)."""
+    return _constrain_dim(input_, dim, TENSOR_AXIS)
+
+
+def gather_tokens(input_, dim: int = 0):
+    """Re-replicate tokens previously dropped across TP ranks
+    (reference ``mappings.py:80``)."""
+    return _constrain_dim(input_, dim, None)

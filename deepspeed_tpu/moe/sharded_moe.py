@@ -1,0 +1,364 @@
+"""Sharded MoE: top-1/top-2 gating + the expert-parallel MoE layer.
+
+TPU-native redesign of reference ``deepspeed/moe/sharded_moe.py``
+(``top1gating`` :179, ``top2gating`` :277, ``MOELayer`` :420).
+
+Key departures from the reference, all forced by XLA's compilation model
+(SURVEY.md §7 "static shapes vs dynamic behavior"):
+
+* **Static capacity.** The reference computes capacity from runtime token
+  counts and, with ``drop_tokens=False``, all-reduces a dynamic max
+  (``sharded_moe.py:208``). Under ``jit`` every shape is static: capacity is
+  computed from the *static* token count at trace time, and
+  ``drop_tokens=False`` maps to the worst case ``capacity = tokens_per_group``
+  (no token can ever be dropped, same semantics, no dynamic shapes).
+* **Declarative all-to-all.** The reference wraps ``dist.all_to_all_single``
+  in an autograd Function (``sharded_moe.py:90``). Here the dispatched tensor
+  ``[groups, experts, capacity, model]`` simply carries a sharding constraint
+  moving the ``experts`` dim onto the ``expert`` mesh axis; XLA's SPMD
+  partitioner inserts the all-to-all (and its transpose in the backward pass)
+  and overlaps it with the expert GEMMs.
+* **Group-local gating.** Tokens are reshaped to ``[groups, tokens, model]``
+  where each group maps to one data-parallel shard, so the cumulative-sum
+  position assignment stays shard-local exactly like the reference's
+  per-rank gating, with no cross-device traffic.
+"""
+
+import math
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+import flax.linen as nn
+
+from deepspeed_tpu.parallel.topology import (BATCH_AXES, DATA_AXIS, EXPERT_AXIS, FSDP_AXIS, TENSOR_AXIS,
+                                             get_topology)
+
+TOPK_GATE_TIMER = 'topk_gate'
+MOE_TIMER = 'moe'
+FIRST_ALLTOALL_TIMER = '1st_a2a'
+SECOND_ALLTOALL_TIMER = '2nd_a2a'
+
+
+def _capacity(num_tokens: int, num_experts: int, capacity_factor: float, min_capacity: int,
+              drop_tokens: bool = True) -> int:
+    """Static capacity (reference ``_capacity`` ``sharded_moe.py:156`` computes
+    this on-device; shapes are static under jit so we do it at trace time)."""
+    if not drop_tokens:
+        # worst case: one expert receives every token (reference instead
+        # all-reduces a dynamic max, sharded_moe.py:208 — dynamic shapes
+        # don't exist under XLA)
+        return num_tokens
+    capacity = math.ceil((num_tokens / num_experts) * capacity_factor)
+    # a buffer larger than the token count is pure padding
+    return min(max(capacity, min_capacity), num_tokens)
+
+
+def multiplicative_jitter(x, rng, epsilon=1e-2):
+    """Reference ``sharded_moe.py:50``: multiply by U(1-eps, 1+eps)."""
+    if epsilon == 0:
+        return x
+    u = jax.random.uniform(rng, x.shape, x.dtype, 1.0 - epsilon, 1.0 + epsilon)
+    return x * u
+
+
+def gumbel_rsample(rng, shape):
+    return jax.random.gumbel(rng, shape)
+
+
+def _keep_top_capacity(mask: jax.Array, priority: jax.Array, capacity: int) -> jax.Array:
+    """Keep at most ``capacity`` selected tokens per expert, highest
+    ``priority`` first (reference ``_top_idx`` + scatter trick,
+    ``sharded_moe.py:170,237``). ``mask``/[S, E] one-hot, ``priority``/[S, E]."""
+    num_experts = mask.shape[1]
+    # top-k over the token dim per expert; ties resolve to lowest index
+    # (position priority), matching torch.topk
+    top_idx = jax.lax.top_k(priority.T, capacity)[1]  # [E, C]
+    sel = jnp.zeros(mask.shape, mask.dtype).at[top_idx.T, jnp.arange(num_experts)[None, :]].set(1, mode="drop")
+    return mask * sel
+
+
+def top1gating(logits: jax.Array,
+               capacity_factor: float,
+               min_capacity: int,
+               used_token: Optional[jax.Array] = None,
+               noisy_gate_policy: Optional[str] = None,
+               drop_tokens: bool = True,
+               use_rts: bool = True,
+               rng: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Top-1 gating (reference ``top1gating`` ``sharded_moe.py:179``).
+
+    ``logits``: [tokens, experts] fp32. Returns
+    ``(l_aux, combine_weights [S,E,C], dispatch_mask [S,E,C] bool, exp_counts [E])``.
+    """
+    logits = logits.astype(jnp.float32)
+    num_tokens, num_experts = logits.shape
+    gates = jax.nn.softmax(logits, axis=1)
+    capacity = _capacity(num_tokens, num_experts, capacity_factor, min_capacity, drop_tokens)
+
+    if noisy_gate_policy == 'RSample' and rng is not None:
+        rng, noise_rng = jax.random.split(rng)
+        indices1_s = jnp.argmax(logits + gumbel_rsample(noise_rng, logits.shape), axis=1)
+    else:
+        indices1_s = jnp.argmax(gates, axis=1)
+    mask1 = jax.nn.one_hot(indices1_s, num_experts, dtype=jnp.int32)
+
+    if used_token is not None:
+        mask1 = mask1 * used_token[:, None].astype(mask1.dtype)
+
+    exp_counts = jnp.sum(mask1, axis=0)
+
+    # load-balancing loss (reference sharded_moe.py:212-215)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1.astype(jnp.float32), axis=0)
+    l_aux = jnp.sum(me * ce) * num_experts
+
+    # Random Token Selection (reference sharded_moe.py:218-230): priority is
+    # uniform noise so over-capacity drops are unbiased; without RTS (or in
+    # deterministic eval) priority is position order.
+    if use_rts and rng is not None:
+        rng, rts_rng = jax.random.split(rng)
+        priority = mask1 * jax.random.uniform(rts_rng, mask1.shape)
+    else:
+        priority = mask1.astype(jnp.float32)
+    mask1 = _keep_top_capacity(mask1, priority, capacity)
+
+    # position of each surviving token inside its expert's capacity buffer
+    locations1 = jnp.cumsum(mask1, axis=0) - 1
+    locations1_s = jnp.sum(locations1 * mask1, axis=1)
+
+    gates = gates * mask1.astype(gates.dtype)
+    locations1_sc = jax.nn.one_hot(locations1_s, capacity, dtype=gates.dtype)
+    combine_weights = jnp.einsum("se,sc->sec", gates, locations1_sc)
+    dispatch_mask = combine_weights > 0
+    return l_aux, combine_weights, dispatch_mask, exp_counts
+
+
+def top2gating(logits: jax.Array,
+               capacity_factor: float,
+               min_capacity: int,
+               drop_tokens: bool = True,
+               rng: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Top-2 gating (reference ``top2gating`` ``sharded_moe.py:277``)."""
+    logits = logits.astype(jnp.float32)
+    num_tokens, num_experts = logits.shape
+    gates = jax.nn.softmax(logits, axis=1)
+    capacity = _capacity(num_tokens, num_experts, 2 * capacity_factor, min_capacity, drop_tokens)
+
+    indices1_s = jnp.argmax(gates, axis=1)
+    mask1 = jax.nn.one_hot(indices1_s, num_experts, dtype=jnp.int32)
+
+    # 2nd expert via Gumbel-max on the remaining logits (sharded_moe.py:292)
+    if rng is not None:
+        rng, noise_rng = jax.random.split(rng)
+        logits_w_noise = logits + gumbel_rsample(noise_rng, logits.shape)
+    else:
+        logits_w_noise = logits
+    logits_except1 = jnp.where(mask1.astype(bool), -jnp.inf, logits_w_noise)
+    indices2_s = jnp.argmax(logits_except1, axis=1)
+    mask2 = jax.nn.one_hot(indices2_s, num_experts, dtype=jnp.int32)
+
+    locations1 = jnp.cumsum(mask1, axis=0) - 1
+    locations2 = jnp.cumsum(mask2, axis=0) - 1
+    # 2nd-choice tokens queue behind all 1st-choice tokens (sharded_moe.py:303)
+    locations2 = locations2 + jnp.sum(mask1, axis=0, keepdims=True)
+
+    exp_counts = jnp.sum(mask1, axis=0)
+
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1.astype(jnp.float32), axis=0)
+    l_aux = jnp.mean(me * ce) * num_experts * num_experts
+
+    mask1 = mask1 * (locations1 < capacity).astype(mask1.dtype)
+    mask2 = mask2 * (locations2 < capacity).astype(mask2.dtype)
+
+    locations1_s = jnp.sum(locations1 * mask1, axis=1)
+    locations2_s = jnp.sum(locations2 * mask2, axis=1)
+
+    mask1_f = mask1.astype(gates.dtype)
+    mask2_f = mask2.astype(gates.dtype)
+    gates1_s = jnp.einsum("se,se->s", gates, mask1_f)
+    gates2_s = jnp.einsum("se,se->s", gates, mask2_f)
+    denom_s = jnp.maximum(gates1_s + gates2_s, jnp.finfo(gates.dtype).eps)
+    gates1_s = gates1_s / denom_s
+    gates2_s = gates2_s / denom_s
+
+    gates1 = gates1_s[:, None] * mask1_f
+    gates2 = gates2_s[:, None] * mask2_f
+    locations1_sc = jax.nn.one_hot(locations1_s, capacity, dtype=gates.dtype)
+    locations2_sc = jax.nn.one_hot(locations2_s, capacity, dtype=gates.dtype)
+    combine1 = jnp.einsum("se,sc->sec", gates1, locations1_sc)
+    combine2 = jnp.einsum("se,sc->sec", gates2, locations2_sc)
+    combine_weights = combine1 + combine2
+    dispatch_mask = combine_weights > 0
+    return l_aux, combine_weights, dispatch_mask, exp_counts
+
+
+class TopKGate(nn.Module):
+    """Gate module (reference ``TopKGate`` ``sharded_moe.py:347``): a bias-free
+    fp32 linear + top-k gating. Operates on ``[groups, tokens, model]``."""
+
+    model_dim: int
+    num_experts: int
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 8
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_rts: bool = True
+
+    @nn.compact
+    def __call__(self, tokens, used_token=None, deterministic: bool = True):
+        # the gate runs in fp32 regardless of compute dtype (reference keeps
+        # wg in fp32, sharded_moe.py:373,394)
+        wg = self.param("wg", nn.with_partitioning(nn.initializers.normal(0.02), ("embed", None)),
+                        (self.model_dim, self.num_experts), jnp.float32)
+        wg_value = wg.value if isinstance(wg, nn.Partitioned) else wg
+
+        x = tokens.astype(jnp.float32)
+        rng = None
+        # k==2 needs the rng too: the second expert is Gumbel-max sampled
+        # during training (reference sharded_moe.py:292)
+        if not deterministic and (self.use_rts or self.noisy_gate_policy is not None or self.k == 2):
+            rng = self.make_rng("gating")
+            if self.noisy_gate_policy == 'Jitter':
+                rng, jit_rng = jax.random.split(rng)
+                x = multiplicative_jitter(x, jit_rng)
+        logits = jnp.einsum("gsm,me->gse", x, wg_value)
+
+        cf = self.capacity_factor if not deterministic else self.eval_capacity_factor
+        groups = logits.shape[0]
+        rngs = jax.random.split(rng, groups) if rng is not None else None
+
+        if self.k == 1:
+            gate_fn = lambda lg, r, ut: top1gating(lg, cf, self.min_capacity, ut,
+                                                   self.noisy_gate_policy if not deterministic else None,
+                                                   self.drop_tokens, self.use_rts, r)
+        elif self.k == 2:
+            gate_fn = lambda lg, r, ut: top2gating(lg, cf, self.min_capacity, self.drop_tokens, r)
+        else:
+            raise ValueError(f"Only top-1 and top-2 gatings are supported (got k={self.k})")
+
+        if used_token is None:
+            out = jax.vmap(lambda lg, r: gate_fn(lg, r, None))(logits, rngs) if rngs is not None \
+                else jax.vmap(lambda lg: gate_fn(lg, None, None))(logits)
+        else:
+            ut = used_token.reshape(groups, -1)
+            out = jax.vmap(lambda lg, r, u: gate_fn(lg, r, u))(logits, rngs, ut) if rngs is not None \
+                else jax.vmap(lambda lg, u: gate_fn(lg, None, u))(logits, ut)
+        l_aux, combine_weights, dispatch_mask, exp_counts = out
+        return l_aux.mean(), combine_weights, dispatch_mask, exp_counts.sum(axis=0)
+
+
+class Experts(nn.Module):
+    """Parallel experts (reference ``Experts`` ``moe/experts.py:10``).
+
+    The reference deep-copies the expert module ``num_local_experts`` times
+    and loops; here one ``nn.vmap`` gives every expert its own parameters
+    with a leading ``expert`` logical axis, which the sharding rules map onto
+    the ``expert`` mesh axis — expert-parallel compute with zero loop
+    overhead and a single fused GEMM per projection.
+    """
+
+    expert: nn.Module
+    num_experts: int
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        # x: [groups, experts, capacity, model] → vmap over the expert dim.
+        # An unbound copy keeps params under this scope with a stable name
+        # (reference state-dict path "…experts.deepspeed_experts.N").
+        expert = self.expert.copy(name="deepspeed_experts")
+        xt = jnp.moveaxis(x, 1, 0)  # [E, G, C, M]
+        vmapped = nn.vmap(
+            lambda mdl, xi: mdl(xi, deterministic=deterministic),
+            in_axes=0,
+            out_axes=0,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            metadata_params={nn.meta.PARTITION_NAME: "expert"},
+        )
+        out = vmapped(expert, xt)
+        return jnp.moveaxis(out, 0, 1)
+
+
+def _num_groups(num_tokens_leading: int) -> int:
+    """Pick the token-group count: one group per data-parallel shard when the
+    global topology is known and divides the batch, else a single group."""
+    topo = get_topology()
+    if topo is None:
+        return 1
+    dp = topo.data_parallel_size
+    if dp > 1 and num_tokens_leading % dp == 0:
+        return dp
+    return 1
+
+
+class MOELayer(nn.Module):
+    """The MoE layer (reference ``MOELayer`` ``sharded_moe.py:420``):
+    gate → dispatch einsum → all-to-all → experts → all-to-all → combine.
+
+    On TPU the two all-to-alls are not explicit ops: the dispatched tensor's
+    sharding constraint moves the ``experts`` dim onto the ``expert`` mesh
+    axis (and the group dim off it), and XLA emits the all-to-all pair in
+    forward and backward.
+    """
+
+    expert: nn.Module
+    model_dim: int
+    num_experts: int
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 8
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_rts: bool = True
+
+    @nn.compact
+    def __call__(self, hidden_states, used_token=None, deterministic: bool = True):
+        orig_shape = hidden_states.shape
+        orig_dtype = hidden_states.dtype
+        d_model = orig_shape[-1]
+        batch = orig_shape[0]
+
+        groups = _num_groups(batch)
+        tokens = hidden_states.reshape(groups, -1, d_model)  # [G, S, M]
+
+        topo = get_topology()
+        # constraints only make sense when the group dim actually maps onto
+        # the DP shards (tiny standalone batches would fail divisibility)
+        mesh = topo.mesh if topo is not None and groups == topo.data_parallel_size else None
+
+        def constrain(x, spec):
+            if mesh is None:
+                return x
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
+        tokens = constrain(tokens, (BATCH_AXES, None, None))
+
+        gate = TopKGate(self.model_dim, self.num_experts, self.k, self.capacity_factor,
+                        self.eval_capacity_factor, self.min_capacity, self.noisy_gate_policy,
+                        self.drop_tokens, self.use_rts, name="gate")
+        l_aux, combine_weights, dispatch_mask, exp_counts = gate(tokens, used_token, deterministic)
+
+        # dispatch: [G,S,E,C] × [G,S,M] → [G,E,C,M] (reference 'sec,sm->ecm')
+        dispatched = jnp.einsum("gsec,gsm->gecm", dispatch_mask.astype(orig_dtype), tokens)
+        # "first all-to-all": group dim leaves the expert mesh axis, expert dim
+        # takes it (reference _AllToAll forward, sharded_moe.py:475)
+        dispatched = constrain(dispatched, ((DATA_AXIS, FSDP_AXIS), EXPERT_AXIS, None, None))
+
+        expert_out = Experts(self.expert, self.num_experts, name="experts")(dispatched, deterministic)
+        expert_out = constrain(expert_out, ((DATA_AXIS, FSDP_AXIS), EXPERT_AXIS, None, None))
+
+        # combine: [G,S,E,C] × [G,E,C,M] → [G,S,M]; the sharding constraint on
+        # the output is the "second all-to-all" back to token-sharded layout
+        combined = jnp.einsum("gsec,gecm->gsm", combine_weights.astype(orig_dtype), expert_out)
+        combined = constrain(combined, (BATCH_AXES, None, None))
+
+        out = combined.reshape(orig_shape)
+        self.sow("intermediates", "exp_counts", exp_counts)
+        return out, l_aux.astype(jnp.float32), exp_counts

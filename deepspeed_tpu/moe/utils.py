@@ -1,0 +1,75 @@
+"""MoE utilities (reference ``deepspeed/moe/utils.py``).
+
+The reference tags torch Parameters with ``allreduce=False`` /
+``group_name`` so the engine reduces expert grads over expert-DP groups
+(``engine.py:2345``) and splits optimizer param groups accordingly. On TPU
+the expert axis is part of the sharding spec, so gradient reduction scope is
+automatic; what remains useful is *identifying* expert parameters by pytree
+path — for per-group optimizer settings (optax masking) and checkpoint
+bookkeeping.
+"""
+
+from typing import Any, Dict, List
+
+import jax
+
+import flax.linen as nn
+
+
+def _path_str(path) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+
+
+def is_moe_param_path(path) -> bool:
+    """True if a pytree path belongs to an *expert* parameter (a
+    ``deepspeed_experts`` path segment — gate params are dense/replicated and
+    excluded, matching the reference's ``allreduce=False`` tagging)."""
+    parts = [str(getattr(k, "key", getattr(k, "idx", k))) for k in path]
+    return "deepspeed_experts" in parts
+
+
+def is_moe_param(param) -> bool:
+    """Reference ``is_moe_param``: checks the ``allreduce=False`` tag. Here a
+    single leaf carries no routing info — use :func:`is_moe_param_path` on
+    the pytree path instead. Kept for API parity; a boxed ``nn.Partitioned``
+    leaf whose axis names include ``expert`` also qualifies."""
+    if isinstance(param, nn.Partitioned):
+        return "expert" in (param.names or ())
+    return False
+
+
+def has_moe_layers(module) -> bool:
+    """True if a flax module tree contains an MoE layer
+    (reference ``has_moe_layers``)."""
+    from deepspeed_tpu.moe.layer import MoE
+    from deepspeed_tpu.moe.sharded_moe import MOELayer
+
+    found = False
+
+    def visit(m):
+        nonlocal found
+        if isinstance(m, (MoE, MOELayer)):
+            found = True
+
+    visit(module)
+    for child in getattr(module, "__dict__", {}).values():
+        if isinstance(child, nn.Module):
+            visit(child)
+    # config-driven models flag it directly
+    cfg = getattr(module, "config", None)
+    if cfg is not None and getattr(cfg, "moe_num_experts", 0):
+        found = True
+    return found
+
+
+def split_params_into_different_moe_groups_for_optimizer(param_tree) -> Dict[str, Any]:
+    """Split a params pytree into expert / non-expert boolean masks, the
+    optax analog of the reference's param-group splitting
+    (``utils.py:split_params_into_different_moe_groups_for_optimizer``).
+
+    Returns ``{"expert_mask": tree, "dense_mask": tree}`` suitable for
+    ``optax.masked`` so experts can get distinct hyperparameters.
+    """
+    expert_mask = jax.tree_util.tree_map_with_path(lambda p, _: is_moe_param_path(p), param_tree)
+    dense_mask = jax.tree.map(lambda b: not b, expert_mask)
+    return {"expert_mask": expert_mask, "dense_mask": dense_mask}

@@ -56,12 +56,18 @@ class TrainState(NamedTuple):
 
 
 def default_causal_lm_loss(outputs, batch):
-    """Default loss: next-token cross entropy over ``input_ids``/``labels``."""
+    """Default loss: next-token cross entropy over ``input_ids``/``labels``.
+    MoE models return ``(logits, aux_loss)`` — the (already-scaled)
+    load-balancing loss is added (reference adds ``l_aux`` in the client
+    loss; here it rides along automatically)."""
     from deepspeed_tpu.models.gpt2 import cross_entropy_loss
 
     labels = batch.get("labels", batch["input_ids"]) if isinstance(batch, dict) else batch
-    logits = outputs
-    return cross_entropy_loss(logits[:, :-1], labels[:, 1:])
+    if isinstance(outputs, (tuple, list)):
+        logits, aux_loss = outputs[0], outputs[1]
+    else:
+        logits, aux_loss = outputs, 0.0
+    return cross_entropy_loss(logits[:, :-1], labels[:, 1:]) + aux_loss
 
 
 def _cast_floating(tree, dtype):
@@ -202,6 +208,11 @@ class DeepSpeedEngine:
         params are *initialized shard-by-shard on their owning devices*
         (jit with out_shardings), never materialized replicated — the TPU
         answer to ``zero.Init`` construction-time partitioning."""
+        # re-pin the process-global topology: another engine constructed since
+        # may have repointed it, and model layers (ring attention, MoE
+        # dispatch) resolve the mesh through get_topology() at trace time
+        from deepspeed_tpu.parallel.topology import set_topology
+        set_topology(self.topology)
         if self.state is not None:
             return
         rng = rng if rng is not None else self._base_rng
@@ -245,15 +256,18 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # jitted step construction
     # ------------------------------------------------------------------
-    def _loss_for(self, params, mb, key, scale):
+    def _loss_for(self, params, mb, key, scale, train: bool = True):
         cparams = _cast_floating(params, self.compute_dtype)
         ids = mb["input_ids"] if isinstance(mb, dict) else mb
-        has_dropout = getattr(self.module, "config", None) is not None and getattr(
-            self.module.config, "dropout", 0.0) > 0.0
-        if has_dropout:
+        mcfg = getattr(self.module, "config", None)
+        has_dropout = mcfg is not None and getattr(mcfg, "dropout", 0.0) > 0.0
+        has_moe = mcfg is not None and getattr(mcfg, "moe_num_experts", 0) > 0
+        if train and (has_dropout or has_moe):
+            drop_key, gate_key = jax.random.split(key)
             outputs = self.module.apply({"params": cparams}, ids, deterministic=False,
-                                        rngs={"dropout": key})
+                                        rngs={"dropout": drop_key, "gating": gate_key})
         else:
+            # eval: deterministic gating (eval capacity factor, no RTS/noise)
             outputs = self.module.apply({"params": cparams}, ids, deterministic=True)
         loss = self.loss_fn(outputs, mb)
         return (loss * scale).astype(jnp.float32), loss
@@ -265,8 +279,6 @@ class DeepSpeedEngine:
         fp16 = self.fp16_enabled
         grad_shardings = self.plan.grad_shardings()
         mesh = self.mesh
-        batch_spec = self._batch_spec(with_gas_dim=True)
-        micro_spec = self._batch_spec(with_gas_dim=False)
 
         def grads_of_micro(params, mb, key, scale):
             (scaled_loss, loss), grads = jax.value_and_grad(self._loss_for, has_aux=True)(params, mb, key, scale)
@@ -315,20 +327,21 @@ class DeepSpeedEngine:
             }
             return new_state, metrics
 
+        # batch leaves keep the shardings _shard_batch placed them with (a
+        # single broadcast spec would rank-mismatch scalar/per-sample leaves)
         self._train_step_fn = jax.jit(
             train_step,
-            in_shardings=(self.state_shardings, NamedSharding(mesh, batch_spec), NamedSharding(mesh, P())),
+            in_shardings=(self.state_shardings, None, NamedSharding(mesh, P())),
             out_shardings=(self.state_shardings, NamedSharding(mesh, P())),
             donate_argnums=(0,),
         )
 
         def eval_step(params, mb):
-            _, loss = self._loss_for(params, mb, jax.random.PRNGKey(0), jnp.float32(1.0))
+            _, loss = self._loss_for(params, mb, jax.random.PRNGKey(0), jnp.float32(1.0), train=False)
             return loss
 
         self._eval_step_fn = jax.jit(eval_step,
-                                     in_shardings=(self.state_shardings.params,
-                                                   NamedSharding(mesh, micro_spec)),
+                                     in_shardings=(self.state_shardings.params, None),
                                      out_shardings=NamedSharding(mesh, P()))
 
         # shim path: per-microbatch grads + deferred apply
@@ -336,9 +349,8 @@ class DeepSpeedEngine:
             return grads_of_micro(params, mb, key, scale)
 
         self._micro_grad_fn = jax.jit(micro_grads,
-                                      in_shardings=(self.state_shardings.params,
-                                                    NamedSharding(mesh, micro_spec), NamedSharding(mesh, P()),
-                                                    NamedSharding(mesh, P())),
+                                      in_shardings=(self.state_shardings.params, None,
+                                                    NamedSharding(mesh, P()), NamedSharding(mesh, P())),
                                       out_shardings=(NamedSharding(mesh, P()), grad_shardings))
 
         def apply_grads(state, grads, n_micro):
