@@ -87,8 +87,8 @@ class SelfAttention(nn.Module):
                                    axis=-1,
                                    dtype=cfg.dtype,
                                    param_dtype=cfg.param_dtype,
-                                   kernel_init=nn.with_partitioning(_dense_init(), ("embed", None, "heads", "kv")),
-                                   bias_init=nn.with_partitioning(nn.initializers.zeros, (None, "heads", "kv")),
+                                   kernel_init=nn.with_logical_partitioning(_dense_init(), ("embed", None, "heads", "kv")),
+                                   bias_init=nn.with_logical_partitioning(nn.initializers.zeros, (None, "heads", "kv")),
                                    name="c_attn")
         qkv = qkv_proj(x)
         q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
@@ -106,8 +106,8 @@ class SelfAttention(nn.Module):
                               axis=(-2, -1),
                               dtype=cfg.dtype,
                               param_dtype=cfg.param_dtype,
-                              kernel_init=nn.with_partitioning(_dense_init(), ("heads", "kv", "embed")),
-                              bias_init=nn.with_partitioning(nn.initializers.zeros, ("embed",)),
+                              kernel_init=nn.with_logical_partitioning(_dense_init(), ("heads", "kv", "embed")),
+                              bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
                               name="c_proj")(attn_out)
         if not deterministic and cfg.dropout > 0.0:
             out = nn.Dropout(rate=cfg.dropout)(out, deterministic=False)
@@ -123,15 +123,15 @@ class MLP(nn.Module):
         h = nn.Dense(features=4 * cfg.n_embd,
                      dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype,
-                     kernel_init=nn.with_partitioning(_dense_init(), ("embed", "mlp")),
-                     bias_init=nn.with_partitioning(nn.initializers.zeros, ("mlp",)),
+                     kernel_init=nn.with_logical_partitioning(_dense_init(), ("embed", "mlp")),
+                     bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("mlp",)),
                      name="c_fc")(x)
         h = jax.nn.gelu(h, approximate=True)
         h = nn.Dense(features=cfg.n_embd,
                      dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype,
-                     kernel_init=nn.with_partitioning(_dense_init(), ("mlp", "embed")),
-                     bias_init=nn.with_partitioning(nn.initializers.zeros, ("embed",)),
+                     kernel_init=nn.with_logical_partitioning(_dense_init(), ("mlp", "embed")),
+                     bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
                      name="c_proj")(h)
         if not deterministic and cfg.dropout > 0.0:
             h = nn.Dropout(rate=cfg.dropout)(h, deterministic=False)
@@ -147,8 +147,8 @@ class LayerNorm(nn.Module):
         return nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
                             dtype=cfg.dtype,
                             param_dtype=cfg.param_dtype,
-                            scale_init=nn.with_partitioning(nn.initializers.ones, ("embed",)),
-                            bias_init=nn.with_partitioning(nn.initializers.zeros, ("embed",)))(x)
+                            scale_init=nn.with_logical_partitioning(nn.initializers.ones, ("embed",)),
+                            bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)))(x)
 
 
 class Block(nn.Module):
@@ -190,12 +190,12 @@ class GPT2LMHeadModel(nn.Module):
     @nn.compact
     def __call__(self, input_ids, *, deterministic: bool = True):
         cfg = self.config
-        wte = self.param("wte", nn.with_partitioning(_dense_init(), ("vocab", "embed")),
+        wte = self.param("wte", nn.with_logical_partitioning(_dense_init(), ("vocab", "embed")),
                          (cfg.vocab_size, cfg.n_embd), cfg.param_dtype)
-        wpe = self.param("wpe", nn.with_partitioning(_dense_init(0.01), (None, "embed")),
+        wpe = self.param("wpe", nn.with_logical_partitioning(_dense_init(0.01), (None, "embed")),
                          (cfg.n_positions, cfg.n_embd), cfg.param_dtype)
-        wte_value = wte.value if isinstance(wte, nn.Partitioned) else wte
-        wpe_value = wpe.value if isinstance(wpe, nn.Partitioned) else wpe
+        wte_value = wte.value if isinstance(wte, nn.meta.AxisMetadata) else wte
+        wpe_value = wpe.value if isinstance(wpe, nn.meta.AxisMetadata) else wpe
 
         _, seq_len = input_ids.shape
         x = jnp.take(wte_value, input_ids, axis=0).astype(cfg.dtype)
@@ -217,6 +217,76 @@ class GPT2LMHeadModel(nn.Module):
         if cfg.moe_num_experts > 0:
             return logits, aux_total * cfg.moe_aux_loss_coef
         return logits
+
+
+# ---------------------------------------------------------------------------
+# Pipeline-parallel layer adapters (reference expresses GPT-2 for pipelining
+# as a LayerSpec list — e.g. Megatron's GPT2ModelPipe; here the specs feed
+# deepspeed_tpu.runtime.pipe.module.PipelineModule)
+# ---------------------------------------------------------------------------
+class GPT2EmbedPipe(nn.Module):
+    """Token+position embedding; ``attend`` is the tied LM head."""
+
+    config: GPT2Config
+
+    def setup(self):
+        cfg = self.config
+        self.wte = self.param("wte", nn.with_logical_partitioning(_dense_init(), ("vocab", "embed")),
+                              (cfg.vocab_size, cfg.n_embd), cfg.param_dtype)
+        self.wpe = self.param("wpe", nn.with_logical_partitioning(_dense_init(0.01), (None, "embed")),
+                              (cfg.n_positions, cfg.n_embd), cfg.param_dtype)
+
+    def __call__(self, input_ids):
+        cfg = self.config
+        wte = self.wte.value if isinstance(self.wte, nn.meta.AxisMetadata) else self.wte
+        wpe = self.wpe.value if isinstance(self.wpe, nn.meta.AxisMetadata) else self.wpe
+        x = jnp.take(wte, input_ids, axis=0).astype(cfg.dtype)
+        return x + wpe[:input_ids.shape[-1]].astype(cfg.dtype)
+
+    def attend(self, x):
+        wte = self.wte.value if isinstance(self.wte, nn.meta.AxisMetadata) else self.wte
+        return jnp.einsum("...le,ve->...lv", x, wte.astype(self.config.dtype),
+                          preferred_element_type=jnp.float32)
+
+
+class GPT2BlockPipe(nn.Module):
+    """One transformer block with a plain ``x -> x`` signature (pipeline
+    stages stream activations only; deterministic — pipeline dropout would
+    need per-stage rng plumbing)."""
+
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        out, _ = Block(self.config, name="block")(x, True)
+        return out
+
+
+class GPT2LNPipe(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        return LayerNorm(self.config, name="ln_f")(x)
+
+
+def gpt2_pipe_layers(config: GPT2Config):
+    """The LayerSpec list for a pipelined GPT-2 (embedding tied to the LM
+    head, reference ``TiedLayerSpec`` semantics)."""
+    from deepspeed_tpu.runtime.pipe.module import LayerSpec, TiedLayerSpec
+
+    if config.moe_num_experts > 0:
+        raise ValueError("MoE blocks are not supported in the pipelined GPT-2: the pipeline "
+                         "stage body is deterministic and drops the aux loss. Combine "
+                         "expert parallelism with ZeRO/TP instead (expert mesh axis).")
+
+    return [
+        TiedLayerSpec("embed", GPT2EmbedPipe, config, tied_weight_attr="wte"),
+        *[LayerSpec(GPT2BlockPipe, config) for _ in range(config.n_layer)],
+        LayerSpec(GPT2LNPipe, config),
+        TiedLayerSpec("embed", GPT2EmbedPipe, config, tied_weight_attr="wte",
+                      forward_fn=lambda m, x: m.attend(x)),
+    ]
 
 
 def cross_entropy_loss(logits, labels, ignore_index: int = -100):

@@ -20,7 +20,9 @@ def _constrain_dim(x, dim: int, axis):
     if topo is None or topo.tensor_parallel_size <= 1:
         return x
     from jax.sharding import NamedSharding, PartitionSpec as P
-    parts = [None] * x.ndim
+    # only the target dim is constrained; other dims keep whatever sharding
+    # the surrounding computation gave them
+    parts = [P.UNCONSTRAINED] * x.ndim
     parts[dim] = axis
     return jax.lax.with_sharding_constraint(x, NamedSharding(topo.mesh, P(*parts)))
 

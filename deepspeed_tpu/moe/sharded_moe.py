@@ -213,9 +213,9 @@ class TopKGate(nn.Module):
     def __call__(self, tokens, used_token=None, deterministic: bool = True):
         # the gate runs in fp32 regardless of compute dtype (reference keeps
         # wg in fp32, sharded_moe.py:373,394)
-        wg = self.param("wg", nn.with_partitioning(nn.initializers.normal(0.02), ("embed", None)),
+        wg = self.param("wg", nn.with_logical_partitioning(nn.initializers.normal(0.02), ("embed", None)),
                         (self.model_dim, self.num_experts), jnp.float32)
-        wg_value = wg.value if isinstance(wg, nn.Partitioned) else wg
+        wg_value = wg.value if isinstance(wg, nn.meta.AxisMetadata) else wg
 
         x = tokens.astype(jnp.float32)
         rng = None

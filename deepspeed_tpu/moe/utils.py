@@ -9,15 +9,11 @@ path — for per-group optimizer settings (optax masking) and checkpoint
 bookkeeping.
 """
 
-from typing import Any, Dict, List
+from typing import Any, Dict
 
 import jax
 
 import flax.linen as nn
-
-
-def _path_str(path) -> str:
-    return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
 
 
 def is_moe_param_path(path) -> bool:
@@ -33,33 +29,43 @@ def is_moe_param(param) -> bool:
     single leaf carries no routing info — use :func:`is_moe_param_path` on
     the pytree path instead. Kept for API parity; a boxed ``nn.Partitioned``
     leaf whose axis names include ``expert`` also qualifies."""
-    if isinstance(param, nn.Partitioned):
-        return "expert" in (param.names or ())
+    if isinstance(param, nn.meta.AxisMetadata):
+        return "expert" in (getattr(param, "names", ()) or ())
     return False
 
 
 def has_moe_layers(module) -> bool:
     """True if a flax module tree contains an MoE layer
-    (reference ``has_moe_layers``)."""
+    (reference ``has_moe_layers``).
+
+    Walks module-typed attributes recursively and honors config-driven
+    models' ``moe_num_experts`` flag. Caveat: submodules created inline in
+    an ``@nn.compact`` body don't exist before binding and can only be
+    detected through such a config flag."""
     from deepspeed_tpu.moe.layer import MoE
     from deepspeed_tpu.moe.sharded_moe import MOELayer
 
-    found = False
+    seen = set()
 
-    def visit(m):
-        nonlocal found
+    def visit(m) -> bool:
+        if id(m) in seen:
+            return False
+        seen.add(id(m))
         if isinstance(m, (MoE, MOELayer)):
-            found = True
+            return True
+        cfg = getattr(m, "config", None)
+        if cfg is not None and getattr(cfg, "moe_num_experts", 0):
+            return True
+        for field in getattr(m, "__dataclass_fields__", {}):
+            child = getattr(m, field, None)
+            if isinstance(child, nn.Module) and visit(child):
+                return True
+            if isinstance(child, (list, tuple)):
+                if any(isinstance(c, nn.Module) and visit(c) for c in child):
+                    return True
+        return False
 
-    visit(module)
-    for child in getattr(module, "__dict__", {}).values():
-        if isinstance(child, nn.Module):
-            visit(child)
-    # config-driven models flag it directly
-    cfg = getattr(module, "config", None)
-    if cfg is not None and getattr(cfg, "moe_num_experts", 0):
-        found = True
-    return found
+    return visit(module)
 
 
 def split_params_into_different_moe_groups_for_optimizer(param_tree) -> Dict[str, Any]:

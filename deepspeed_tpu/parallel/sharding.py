@@ -23,8 +23,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from deepspeed_tpu.parallel.topology import (BATCH_AXES, DATA_AXIS, EXPERT_AXIS, FSDP_AXIS, SEQUENCE_AXIS,
-                                             TENSOR_AXIS, MeshTopology)
+from deepspeed_tpu.parallel.topology import (BATCH_AXES, DATA_AXIS, EXPERT_AXIS, FSDP_AXIS, PIPE_AXIS,
+                                             SEQUENCE_AXIS, TENSOR_AXIS, MeshTopology)
 
 # Default logical → mesh rules (first match wins). Models annotate their
 # params/activations with these names (cf. t5x partitioning rules).
@@ -38,6 +38,7 @@ DEFAULT_LOGICAL_RULES: Tuple[Tuple[str, Any], ...] = (
     ("kv", None),
     ("expert", EXPERT_AXIS),
     ("expert_mlp", TENSOR_AXIS),
+    ("layers", PIPE_AXIS),  # stacked pipeline body (runtime/pipe/module.py)
     ("unmodeled", None),
     ("norm", None),
     ("relpos_buckets", None),

@@ -1,0 +1,7 @@
+"""Public pipeline-parallelism surface (reference ``deepspeed/pipe/__init__.py``)."""
+
+from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule, TiedLayerSpec
+from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+from deepspeed_tpu.runtime.pipe import schedule
+
+__all__ = ["PipelineModule", "LayerSpec", "TiedLayerSpec", "PipelineEngine", "schedule"]

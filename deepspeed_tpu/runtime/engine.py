@@ -267,8 +267,11 @@ class DeepSpeedEngine:
             outputs = self.module.apply({"params": cparams}, ids, deterministic=False,
                                         rngs={"dropout": drop_key, "gating": gate_key})
         else:
-            # eval: deterministic gating (eval capacity factor, no RTS/noise)
+            # eval: deterministic gating (eval capacity factor, no RTS/noise);
+            # the aux loss is a training-only regularizer — report pure CE
             outputs = self.module.apply({"params": cparams}, ids, deterministic=True)
+            if has_moe and isinstance(outputs, (tuple, list)):
+                outputs = outputs[0]
         loss = self.loss_fn(outputs, mb)
         return (loss * scale).astype(jnp.float32), loss
 

@@ -49,15 +49,30 @@ def initialize(args=None,
 
     ds_config = DeepSpeedConfig(config,
                                 dp_world_size=topology.data_parallel_size if topology is not None else None)
-    engine = DeepSpeedEngine(model=model,
-                             config=ds_config,
-                             optimizer=optimizer,
-                             loss_fn=loss_fn,
-                             lr_scheduler=lr_scheduler,
-                             topology=topology,
-                             model_parameters=model_parameters,
-                             training_data=training_data,
-                             collate_fn=collate_fn)
+    from deepspeed_tpu.runtime.pipe.module import PipelineModule
+    if isinstance(model, PipelineModule):
+        # reference dispatches PipelineEngine for PipelineModule models
+        # (__init__.py:158)
+        from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+        engine = PipelineEngine(pipeline=model,
+                                config=ds_config,
+                                optimizer=optimizer,
+                                loss_fn=loss_fn,
+                                lr_scheduler=lr_scheduler,
+                                topology=topology,
+                                model_parameters=model_parameters,
+                                training_data=training_data,
+                                collate_fn=collate_fn)
+    else:
+        engine = DeepSpeedEngine(model=model,
+                                 config=ds_config,
+                                 optimizer=optimizer,
+                                 loss_fn=loss_fn,
+                                 lr_scheduler=lr_scheduler,
+                                 topology=topology,
+                                 model_parameters=model_parameters,
+                                 training_data=training_data,
+                                 collate_fn=collate_fn)
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 
 

@@ -1,0 +1,4 @@
+from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule, TiedLayerSpec
+from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+
+__all__ = ["PipelineModule", "LayerSpec", "TiedLayerSpec", "PipelineEngine"]

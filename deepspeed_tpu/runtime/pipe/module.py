@@ -1,0 +1,205 @@
+"""PipelineModule: layer-list partitioning across pipeline stages
+(reference ``runtime/pipe/module.py``: ``LayerSpec`` :560, ``PipelineModule``
+:630-file).
+
+TPU-native redesign. The reference materializes only this stage's layers per
+process and moves tensors with NCCL p2p; here the *homogeneous body* of the
+layer list (the repeated transformer block) is built once with
+``nn.vmap``-stacked parameters carrying a ``layers`` logical axis that the
+sharding rules map onto the ``pipe`` mesh axis — each pipeline stage owns
+``n_body / stages`` layers of every stacked leaf. The prologue (embedding)
+and epilogue (final norm / LM head) are replicated across stages, which is
+exactly the reference's tied-layer treatment (``TiedLayerSpec``, grads
+all-reduced over the pipe axis — ``ReduceTiedGrads``): XLA's shard_map
+transpose performs that psum automatically.
+"""
+
+from typing import Any, Callable, List, Optional
+
+import jax.numpy as jnp
+
+import flax.linen as nn
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class LayerSpec:
+    """Deferred layer construction (reference ``pipe/module.py:560``):
+    ``LayerSpec(ModuleClass, *args, **kwargs)``."""
+
+    def __init__(self, typename, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+        if not issubclass(typename, nn.Module):
+            raise RuntimeError("LayerSpec only supports flax.linen.Module types")
+
+    def build(self, name: Optional[str] = None) -> nn.Module:
+        return self.typename(*self.module_args, name=name, **self.module_kwargs)
+
+    def signature(self):
+        return (self.typename, self.module_args, tuple(sorted(self.module_kwargs.items())))
+
+    def __repr__(self):
+        return f"LayerSpec({self.typename.__name__})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """A layer sharing parameters with every other ``TiedLayerSpec`` of the
+    same ``key`` (reference ``pipe/module.py:585``). ``forward_fn(module, x)``
+    overrides the call for reuse sites (e.g. the tied LM head calling
+    ``embed.attend``)."""
+
+    def __init__(self, key, typename, *module_args, forward_fn: Optional[Callable] = None,
+                 tied_weight_attr='weight', **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+        self.tied_weight_attr = tied_weight_attr
+
+    def signature(self):
+        return ("tied", self.key) + super().signature()
+
+
+def _as_spec(layer) -> LayerSpec:
+    if isinstance(layer, LayerSpec):
+        return layer
+    if isinstance(layer, nn.Module):
+        # flax modules are frozen dataclasses: rebuild-able from fields
+        fields = {k: getattr(layer, k) for k in layer.__dataclass_fields__
+                  if k not in ("name", "parent")}
+        return LayerSpec(type(layer), **fields)
+    raise TypeError(f"pipeline layer must be a LayerSpec or flax Module, got {type(layer)}")
+
+
+class PipelineModule:
+    """Partitions a layer list into prologue | homogeneous body | epilogue.
+
+    The body — the longest contiguous run of layers with identical spec
+    signatures — is what streams through the pipeline; it must divide evenly
+    by the stage count (``partition_method='uniform'``; the reference's
+    ``parameters``/``type:`` balancing degenerates to uniform for a
+    homogeneous body, which is the only layout that maps onto stacked
+    stage-sharded parameters).
+    """
+
+    def __init__(self,
+                 layers: List[Any],
+                 num_stages: Optional[int] = None,
+                 topology=None,
+                 loss_fn: Optional[Callable] = None,
+                 partition_method: str = "uniform",
+                 activation_checkpoint_interval: int = 0,
+                 seed_layers: bool = False):
+        self.specs = [_as_spec(l) for l in layers]
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+
+        if topology is not None:
+            num_stages = topology.pipe_parallel_size
+        if num_stages is None:
+            raise ValueError("PipelineModule needs num_stages or a topology")
+        self.num_stages = num_stages
+
+        # find the homogeneous body: longest run of identical signatures
+        sigs = [s.signature() for s in self.specs]
+        best_start, best_len = 0, 0
+        i = 0
+        while i < len(sigs):
+            j = i
+            while j < len(sigs) and sigs[j] == sigs[i]:
+                j += 1
+            if j - i > best_len:
+                best_start, best_len = i, j - i
+            i = j
+        if best_len == 0:
+            raise ValueError("empty pipeline layer list")
+        self.body_start = best_start
+        self.n_body = best_len
+        self.prologue_specs = self.specs[:best_start]
+        self.epilogue_specs = self.specs[best_start + best_len:]
+        self.body_spec = self.specs[best_start]
+
+        if self.n_body % num_stages != 0:
+            raise ValueError(
+                f"body layer count {self.n_body} must divide evenly across {num_stages} pipeline "
+                f"stages (stacked stage-sharded execution; pad the layer count or change stages)")
+        self.layers_per_stage = self.n_body // num_stages
+        # reference ``self.parts``: stage boundaries over the full layer list
+        self.parts = [best_start + k * self.layers_per_stage for k in range(num_stages)] + \
+                     [best_start + self.n_body]
+        logger.info(f"PipelineModule: prologue={len(self.prologue_specs)} body={self.n_body} "
+                    f"epilogue={len(self.epilogue_specs)} stages={num_stages} "
+                    f"layers/stage={self.layers_per_stage}")
+
+    # -- construction --------------------------------------------------
+    def make_param_module(self) -> nn.Module:
+        """A flax module whose sole job is to *create* all pipeline params in
+        their final layout (stacked body with the ``layers`` logical axis);
+        the engine executes the pipeline functionally from the param tree."""
+        pipeline = self
+
+        class PipeParams(nn.Module):
+
+            @nn.compact
+            def __call__(self, input_ids, deterministic: bool = True):
+                tied = {}
+                h = input_ids
+                for i, spec in enumerate(pipeline.prologue_specs):
+                    m, fwd = pipeline._build_tied(spec, f"prologue_{i}", tied)
+                    h = fwd(m, h)
+                block = pipeline.body_spec.build(name="body")
+                vm = nn.vmap(lambda mdl, xi: mdl(xi),
+                             in_axes=None,
+                             out_axes=0,
+                             axis_size=pipeline.n_body,
+                             variable_axes={"params": 0},
+                             split_rngs={"params": True},
+                             metadata_params={nn.meta.PARTITION_NAME: "layers"})
+                stacked = vm(block, h)
+                h = stacked[0]  # body preserves shape; pick any layer's output
+                for i, spec in enumerate(pipeline.epilogue_specs):
+                    m, fwd = pipeline._build_tied(spec, f"epilogue_{i}", tied)
+                    h = fwd(m, h)
+                return h
+
+        return PipeParams()
+
+    def _build_tied(self, spec: LayerSpec, name: str, tied: dict):
+        """Build (or reuse, for tied keys) a module; returns (module, fwd)."""
+        if isinstance(spec, TiedLayerSpec):
+            if spec.key in tied:
+                m = tied[spec.key]
+            else:
+                m = spec.build(name=f"tied_{spec.key}")
+                tied[spec.key] = m
+            fwd = spec.forward_fn or (lambda mdl, x: mdl(x))
+            return m, fwd
+        return spec.build(name=name), (lambda mdl, x: mdl(x))
+
+    # -- functional application (used by the engine inside shard_map) ---
+    def apply_prologue(self, params, x):
+        for i, spec in enumerate(self.prologue_specs):
+            x = self._apply_one(spec, params, f"prologue_{i}", x)
+        return x
+
+    def apply_epilogue(self, params, x):
+        for i, spec in enumerate(self.epilogue_specs):
+            x = self._apply_one(spec, params, f"epilogue_{i}", x)
+        return x
+
+    def _apply_one(self, spec, params, name, x):
+        m = spec.build()
+        if isinstance(spec, TiedLayerSpec):
+            # tied params live under one shared scope regardless of call site
+            scope = f"tied_{spec.key}"
+            if spec.forward_fn is not None:
+                return spec.forward_fn(m.bind({"params": params[scope]}), x)
+            return m.apply({"params": params[scope]}, x)
+        return m.apply({"params": params[name]}, x)
+
+    def apply_block(self, block_params, x):
+        """Apply ONE body block given its (un-stacked) param subtree."""
+        m = self.body_spec.build()
+        return m.apply({"params": block_params}, x)

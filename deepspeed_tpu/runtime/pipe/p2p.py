@@ -1,0 +1,29 @@
+"""Stage-to-stage activation transfer (reference ``runtime/pipe/p2p.py``).
+
+The reference wraps ``torch.distributed`` isend/irecv with a shape/dtype
+meta handshake. Under shard_map all shapes are static, so "p2p" is a single
+``lax.ppermute`` hop along the ``pipe`` axis; these helpers exist for API
+parity and for custom schedules written against the instruction vocabulary.
+They must be called inside a ``shard_map`` whose manual axes include
+``pipe``.
+"""
+
+import jax
+
+from deepspeed_tpu.parallel.topology import PIPE_AXIS
+
+
+def _shift(x, n_stages: int, direction: int):
+    perm = [(i, (i + direction) % n_stages) for i in range(n_stages)]
+    return jax.lax.ppermute(x, PIPE_AXIS, perm)
+
+
+def send_forward(x, n_stages: int):
+    """SendActivation/RecvActivation pair: every stage passes ``x`` to its
+    next stage and receives from its previous (reference ``p2p.py:send``)."""
+    return _shift(x, n_stages, +1)
+
+
+def send_backward(x, n_stages: int):
+    """SendGrad/RecvGrad pair (reference ``p2p.py:recv``): reverse hop."""
+    return _shift(x, n_stages, -1)

@@ -1,0 +1,258 @@
+"""Pipeline schedules as data (reference ``runtime/pipe/schedule.py``).
+
+The reference's ``PipelineEngine`` interprets these instruction streams
+imperatively, issuing NCCL p2p ops per command (``pipe/engine.py:1295``).
+On TPU the *execution* of a schedule is a single jitted ``lax.scan`` over
+ticks with ``ppermute`` neighbor exchange (``runtime/pipe/engine.py`` here),
+so these classes serve a different role: they are the *specification* —
+used to size buffers, to validate the scan against the reference's 1F1B
+semantics in tests, and to drive the (non-jit) debugging executor.
+
+Instruction vocabulary and the even/odd 1F1B step mapping mirror the
+reference exactly (``schedule.py:189-299,327-489``).
+"""
+
+from abc import ABC, abstractmethod
+
+
+def _is_even(x):
+    return x % 2 == 0
+
+
+def _is_odd(x):
+    return x % 2 != 0
+
+
+class PipeSchedule(ABC):
+    """Generator of sequences of :class:`PipeInstruction` for one stage
+    (reference ``schedule.py:11``)."""
+
+    def __init__(self, micro_batches, stages, stage_id):
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = self.stage_id - 1
+        self.next_stage = self.stage_id + 1
+
+    @abstractmethod
+    def steps(self):
+        """Yield a list of :class:`PipeInstruction` per tick."""
+
+    def num_pipe_buffers(self):
+        return self.micro_batches
+
+    def _valid_micro_batch(self, micro_batch_id):
+        return 0 <= micro_batch_id < self.micro_batches
+
+    def _valid_stage(self, stage_id):
+        return 0 <= stage_id < self.stages
+
+    @property
+    def stage(self):
+        return self.stage_id
+
+    @property
+    def num_stages(self):
+        return self.stages
+
+    @property
+    def num_micro_batches(self):
+        return self.micro_batches
+
+    @property
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self):
+        return self.stage_id == self.stages - 1
+
+    def __iter__(self):
+        self.it = None
+        return self
+
+    def __next__(self):
+        if self.it is None:
+            self.it = self.steps()
+        return next(self.it)
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only pipelining (reference ``schedule.py:135``)."""
+
+    def steps(self):
+        total_steps = self.micro_batches + self.stages - 1
+        for step_id in range(total_steps):
+            micro_batch_id = step_id - self.stage_id
+            cmds = []
+            if 0 <= micro_batch_id < self.micro_batches:
+                if self.is_first_stage or self.is_last_stage:
+                    cmds.append(LoadMicroBatch(buffer_id=micro_batch_id % self.num_pipe_buffers()))
+                if self._valid_stage(self.prev_stage):
+                    cmds.append(RecvActivation(buffer_id=micro_batch_id % self.num_pipe_buffers()))
+                cmds.append(ForwardPass(buffer_id=micro_batch_id % self.num_pipe_buffers()))
+                if self._valid_stage(self.next_stage):
+                    cmds.append(SendActivation(buffer_id=micro_batch_id % self.num_pipe_buffers()))
+            yield cmds
+
+    def num_pipe_buffers(self):
+        return 2
+
+
+class TrainSchedule(PipeSchedule):
+    """Synchronous 1F1B with even/odd interleave (reference
+    ``schedule.py:189``): pipeline parallelism extracted through gradient
+    accumulation; convergence identical to data-parallel at the same global
+    batch."""
+
+    def steps(self):
+        prev_micro_batch_id = -1
+        total_steps = 2 * (self.micro_batches + self.stages - 1)
+        for step_id in range(total_steps):
+            micro_batch_id, is_forward = self._step_to_micro_batch(step_id)
+            cmds = []
+            if self._valid_micro_batch(prev_micro_batch_id):
+                prev_buffer = self._buffer_idx(prev_micro_batch_id)
+            if self._valid_micro_batch(micro_batch_id):
+                curr_buffer = self._buffer_idx(micro_batch_id)
+
+            if is_forward:
+                if self._valid_micro_batch(prev_micro_batch_id) and self._valid_stage(self.prev_stage):
+                    cmds.append(SendGrad(prev_buffer))
+                if self._valid_micro_batch(micro_batch_id) and self._valid_stage(self.prev_stage):
+                    cmds.append(RecvActivation(curr_buffer))
+            else:
+                if self._valid_micro_batch(micro_batch_id) and self._valid_stage(self.next_stage):
+                    cmds.append(RecvGrad(curr_buffer))
+                if self._valid_micro_batch(prev_micro_batch_id) and self._valid_stage(self.next_stage):
+                    cmds.append(SendActivation(prev_buffer))
+
+            if self.stage_id == 0 or self.stage_id == self.stages - 1:
+                if is_forward and self._valid_micro_batch(micro_batch_id):
+                    cmds.append(LoadMicroBatch(curr_buffer))
+
+            if self._valid_micro_batch(micro_batch_id):
+                cmds.append(ForwardPass(curr_buffer) if is_forward else BackwardPass(curr_buffer))
+
+            if step_id == total_steps - 1:
+                cmds.append(ReduceTiedGrads())
+                cmds.append(ReduceGrads())
+                cmds.append(OptimizerStep())
+
+            prev_micro_batch_id = micro_batch_id
+            yield cmds
+
+    def num_pipe_buffers(self):
+        """Max in-flight forwards = stage distance to the last stage
+        (reference ``schedule.py:247``)."""
+        buffers = min(self.stages - self.stage_id, self.micro_batches)
+        return max(2, buffers)
+
+    def _buffer_idx(self, micro_batch_id):
+        assert self._valid_micro_batch(micro_batch_id)
+        return micro_batch_id % self.num_pipe_buffers()
+
+    def _step_to_micro_batch(self, step_id):
+        """Even/odd interleave (reference ``schedule.py:252-299``)."""
+        if _is_even(step_id) and _is_even(self.stage_id):
+            return self._even_step_forward_id(step_id), True
+        if _is_odd(step_id) and _is_odd(self.stage_id):
+            return self._odd_step_forward_id(step_id), True
+        if _is_even(step_id) and _is_odd(self.stage_id):
+            return self._even_step_backward_id(step_id), False
+        if _is_odd(step_id) and _is_even(self.stage_id):
+            return self._odd_step_backward_id(step_id), False
+        raise AssertionError
+
+    def _even_step_forward_id(self, step_id):
+        return step_id // 2 - self.stage_id // 2
+
+    def _odd_step_forward_id(self, step_id):
+        return (step_id - 1) // 2 - self.stage_id // 2
+
+    def _even_step_backward_id(self, step_id):
+        return step_id // 2 - self.stages + (self.stage_id + 1) // 2
+
+    def _odd_step_backward_id(self, step_id):
+        return (step_id - 1) // 2 - self.stages + 1 + self.stage_id // 2
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Plain gradient-accumulation DP expressed as a schedule (reference
+    ``schedule.py:301``)."""
+
+    def steps(self):
+        for step_id in range(self.micro_batches):
+            cmds = [LoadMicroBatch(buffer_id=0), ForwardPass(buffer_id=0), BackwardPass(buffer_id=0)]
+            if step_id == self.micro_batches - 1:
+                cmds.extend([ReduceGrads(), OptimizerStep()])
+            yield cmds
+
+    def num_pipe_buffers(self):
+        return 1
+
+
+class PipeInstruction:
+    """Base instruction (reference ``schedule.py:327``): kwargs become
+    attributes, namedtuple-style."""
+
+    def __init__(self, **kwargs):
+        self.name = self.__class__.__name__
+        self.kwargs = kwargs
+        for key, val in kwargs.items():
+            setattr(self, key, val)
+
+    def __repr__(self):
+        if self.kwargs:
+            args = ', '.join(f'{k}={v}' for k, v in self.kwargs.items())
+            return f'{self.name}({args})'
+        return self.name
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.kwargs == other.kwargs
+
+
+class OptimizerStep(PipeInstruction):
+    pass
+
+
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+class ReduceTiedGrads(PipeInstruction):
+    pass
+
+
+class BufferOpInstruction(PipeInstruction):
+
+    def __init__(self, buffer_id, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class LoadMicroBatch(BufferOpInstruction):
+    pass
+
+
+class ForwardPass(BufferOpInstruction):
+    pass
+
+
+class BackwardPass(BufferOpInstruction):
+    pass
+
+
+class SendActivation(BufferOpInstruction):
+    pass
+
+
+class RecvActivation(BufferOpInstruction):
+    pass
+
+
+class SendGrad(BufferOpInstruction):
+    pass
+
+
+class RecvGrad(BufferOpInstruction):
+    pass
