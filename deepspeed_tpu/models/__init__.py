@@ -1,2 +1,5 @@
 from deepspeed_tpu.models.gpt2 import (GPT2Config, GPT2LMHeadModel, GPT2_CONFIGS, get_gpt2_config,
                                        cross_entropy_loss)
+from deepspeed_tpu.models.llama import (LlamaConfig, LlamaForCausalLM, LLAMA_CONFIGS, get_llama_config)
+from deepspeed_tpu.models.bert import (BertConfig, BertModel, BertForMaskedLM, BERT_CONFIGS,
+                                       get_bert_config, bert_mlm_loss)

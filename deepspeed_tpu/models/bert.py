@@ -1,0 +1,196 @@
+"""BERT family — bidirectional encoder for the MLM/pretraining configs
+(judged ladder: BERT-large ZeRO-1 + FusedAdam, BASELINE.md; the reference's
+fastest-BERT benchmark is its fused training transformer,
+``csrc/transformer/ds_transformer_cuda.cpp``, and its test fixture is a
+vendored BERT, ``tests/unit/modeling.py``).
+
+Post-LN encoder (original BERT), logical sharding names as in gpt2.py.
+"""
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.common import config_from, dense_init as _init, normalize_padding_mask
+from deepspeed_tpu.ops.transformer.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    hidden_dropout_prob: float = 0.0
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    attention_backend: str = "xla"
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def dropout(self):
+        # engine looks at cfg.dropout to decide whether to thread rngs
+        return self.hidden_dropout_prob
+
+
+BERT_CONFIGS = {
+    "test": dict(vocab_size=256, hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
+                 intermediate_size=128, max_position_embeddings=128),
+    "base": dict(hidden_size=768, num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072),
+    "large": dict(hidden_size=1024, num_hidden_layers=24, num_attention_heads=16,
+                  intermediate_size=4096),
+}
+
+
+def get_bert_config(name: str, **overrides) -> BertConfig:
+    return config_from(BERT_CONFIGS, BertConfig, name, **overrides)
+
+
+class BertLayerNorm(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                            scale_init=nn.with_logical_partitioning(nn.initializers.ones, ("embed",)),
+                            bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)))(x)
+
+
+class BertSelfAttention(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask=None, deterministic: bool = True):
+        cfg = self.config
+
+        def proj(name):
+            return nn.DenseGeneral(features=(cfg.num_attention_heads, cfg.head_dim), axis=-1,
+                                   dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                                   kernel_init=nn.with_logical_partitioning(_init(), ("embed", "heads", "kv")),
+                                   bias_init=nn.with_logical_partitioning(nn.initializers.zeros,
+                                                                          ("heads", "kv")),
+                                   name=name)
+
+        q, k, v = proj("query")(x), proj("key")(x), proj("value")(x)
+        mask = normalize_padding_mask(attention_mask)
+        out = dot_product_attention(q, k, v, backend=cfg.attention_backend, causal=False, mask=mask)
+        out = nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype,
+                              param_dtype=cfg.param_dtype,
+                              kernel_init=nn.with_logical_partitioning(_init(), ("heads", "kv", "embed")),
+                              bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
+                              name="output")(out)
+        if not deterministic and cfg.hidden_dropout_prob > 0:
+            out = nn.Dropout(rate=cfg.hidden_dropout_prob)(out, deterministic=False)
+        return out
+
+
+class BertLayer(nn.Module):
+    """Post-LN transformer encoder layer (original BERT ordering; the
+    reference's fused layer supports both pre/post-LN,
+    ``ds_transformer_cuda.cpp`` pre_or_postLayerNorm)."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask=None, deterministic: bool = True):
+        cfg = self.config
+        attn = BertSelfAttention(cfg, name="attention")(x, attention_mask, deterministic)
+        x = BertLayerNorm(cfg, name="attention_ln")(x + attn)
+        h = nn.Dense(features=cfg.intermediate_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     kernel_init=nn.with_logical_partitioning(_init(), ("embed", "mlp")),
+                     bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("mlp",)),
+                     name="intermediate")(x)
+        h = jax.nn.gelu(h, approximate=True)
+        h = nn.Dense(features=cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     kernel_init=nn.with_logical_partitioning(_init(), ("mlp", "embed")),
+                     bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
+                     name="output")(h)
+        if not deterministic and cfg.hidden_dropout_prob > 0:
+            h = nn.Dropout(rate=cfg.hidden_dropout_prob)(h, deterministic=False)
+        return BertLayerNorm(cfg, name="output_ln")(x + h)
+
+
+class BertModel(nn.Module):
+    """Embeddings + encoder stack (+ pooler on [CLS])."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        word = self.param("word_embeddings", nn.with_logical_partitioning(_init(), ("vocab", "embed")),
+                          (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+        pos = self.param("position_embeddings", nn.with_logical_partitioning(_init(), (None, "embed")),
+                         (cfg.max_position_embeddings, cfg.hidden_size), cfg.param_dtype)
+        typ = self.param("token_type_embeddings", nn.with_logical_partitioning(_init(), (None, "embed")),
+                         (cfg.type_vocab_size, cfg.hidden_size), cfg.param_dtype)
+        word_v, pos_v, typ_v = (p.value if isinstance(p, nn.meta.AxisMetadata) else p
+                                for p in (word, pos, typ))
+
+        b, l = input_ids.shape
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = (jnp.take(word_v, input_ids, axis=0) + pos_v[None, :l] +
+             jnp.take(typ_v, token_type_ids, axis=0)).astype(cfg.dtype)
+        x = BertLayerNorm(cfg, name="embeddings_ln")(x)
+
+        layer_cls = BertLayer
+        if cfg.remat:
+            layer_cls = nn.remat(BertLayer, static_argnums=(3,), prevent_cse=False)
+        for i in range(cfg.num_hidden_layers):
+            x = layer_cls(cfg, name=f"layer_{i}")(x, attention_mask, deterministic)
+
+        pooled = nn.Dense(features=cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                          kernel_init=nn.with_logical_partitioning(_init(), ("embed", "embed2")),
+                          bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
+                          name="pooler")(x[:, 0])
+        pooled = jnp.tanh(pooled)
+        # word_v is returned so heads can tie their decoder to the embedding
+        return x, pooled, word_v
+
+
+class BertForMaskedLM(nn.Module):
+    """MLM head tied to the word embeddings; returns logits [B, L, V]."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        encoder = BertModel(cfg, name="bert")
+        x, _, wte = encoder(input_ids, token_type_ids, attention_mask, deterministic)
+        x = nn.Dense(features=cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     kernel_init=nn.with_logical_partitioning(_init(), ("embed", "embed2")),
+                     bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
+                     name="transform")(x)
+        x = jax.nn.gelu(x, approximate=True)
+        x = BertLayerNorm(cfg, name="transform_ln")(x)
+        bias = self.param("decoder_bias", nn.with_logical_partitioning(nn.initializers.zeros, ("vocab",)),
+                          (cfg.vocab_size,), cfg.param_dtype)
+        bias = bias.value if isinstance(bias, nn.meta.AxisMetadata) else bias
+        logits = jnp.einsum("ble,ve->blv", x, wte.astype(cfg.dtype),
+                            preferred_element_type=jnp.float32) + bias.astype(jnp.float32)
+        return logits
+
+
+def bert_mlm_loss(logits, batch):
+    """Masked-LM cross entropy: ``labels == -100`` positions are ignored."""
+    from deepspeed_tpu.models.gpt2 import cross_entropy_loss
+
+    labels = batch["labels"] if isinstance(batch, dict) else batch
+    return cross_entropy_loss(logits, labels)

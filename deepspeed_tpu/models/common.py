@@ -1,0 +1,24 @@
+"""Shared model-zoo helpers."""
+
+import flax.linen as nn
+
+
+def dense_init(scale: float = 0.02):
+    return nn.initializers.normal(stddev=scale)
+
+
+def config_from(table: dict, cls, name: str, **overrides):
+    """Look up a named config dict and build ``cls`` with overrides."""
+    base = dict(table[name])
+    base.update(overrides)
+    return cls(**base)
+
+
+def normalize_padding_mask(attention_mask, ndim_target: int = 4):
+    """[B, L] 0/1 padding mask → [B, 1, 1, L] boolean; pass through masks
+    that already have a broadcastable rank."""
+    if attention_mask is None:
+        return None
+    if attention_mask.ndim == 2:
+        return attention_mask[:, None, None, :].astype(bool)
+    return attention_mask.astype(bool)

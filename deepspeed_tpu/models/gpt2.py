@@ -68,13 +68,13 @@ GPT2_CONFIGS = {
 
 
 def get_gpt2_config(name: str, **overrides) -> GPT2Config:
-    base = dict(GPT2_CONFIGS[name])
-    base.update(overrides)
-    return GPT2Config(**base)
+    from deepspeed_tpu.models.common import config_from
+    return config_from(GPT2_CONFIGS, GPT2Config, name, **overrides)
 
 
 def _dense_init(scale=0.02):
-    return nn.initializers.normal(stddev=scale)
+    from deepspeed_tpu.models.common import dense_init
+    return dense_init(scale)
 
 
 class SelfAttention(nn.Module):

@@ -1,0 +1,240 @@
+"""LLaMA family — RMSNorm + RoPE + SwiGLU + GQA decoder
+(judged config ladder includes LLaMA-7B ZeRO-3 + ZeRO++, BASELINE.md; the
+reference supports LLaMA through kernel injection,
+``module_inject/containers/llama.py``).
+
+TPU-first notes, same conventions as ``models/gpt2.py``:
+* logical axis names via ``nn.with_logical_partitioning`` drive the ZeRO
+  planner (fsdp/TP shardings are derived, never hand-sliced);
+* attention goes through the pluggable backend seam (xla/flash/ring);
+* a flax ``cache`` collection implements incremental decoding (the role of
+  the reference's KV-cache workspace,
+  ``csrc/transformer/inference/includes/inference_context.h``) — static
+  cache shape ``[batch, max_len, kv_heads, head_dim]`` with a scalar write
+  index, jit-friendly.
+"""
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.common import config_from, dense_init as _init, normalize_padding_mask
+from deepspeed_tpu.ops.transformer.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32  # < num_attention_heads → GQA
+    max_position_embeddings: int = 2048
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    attention_backend: str = "xla"
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+LLAMA_CONFIGS = {
+    "test": dict(vocab_size=256, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                 num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=128),
+    "160m": dict(hidden_size=768, intermediate_size=2048, num_hidden_layers=12,
+                 num_attention_heads=12, num_key_value_heads=12),
+    "1b": dict(hidden_size=2048, intermediate_size=5504, num_hidden_layers=24,
+               num_attention_heads=16, num_key_value_heads=16),
+    "7b": dict(hidden_size=4096, intermediate_size=11008, num_hidden_layers=32,
+               num_attention_heads=32, num_key_value_heads=32),
+    "13b": dict(hidden_size=5120, intermediate_size=13824, num_hidden_layers=40,
+                num_attention_heads=40, num_key_value_heads=40),
+}
+
+
+def get_llama_config(name: str, **overrides) -> LlamaConfig:
+    return config_from(LLAMA_CONFIGS, LlamaConfig, name, **overrides)
+
+
+class RMSNorm(nn.Module):
+    """Root-mean-square norm (reference fused kernel
+    ``csrc/transformer/inference/csrc/rms_norm.cu``; XLA fuses this)."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        w = self.param("weight", nn.with_logical_partitioning(nn.initializers.ones, ("embed",)),
+                       (x.shape[-1],), cfg.param_dtype)
+        w = w.value if isinstance(w, nn.meta.AxisMetadata) else w
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        out = x32 * jax.lax.rsqrt(var + cfg.rms_norm_eps)
+        return (out * w.astype(jnp.float32)).astype(cfg.dtype)
+
+
+def rotary_embedding(x, positions, theta: float = 10000.0):
+    """Apply RoPE to ``x`` [B, L, H, D] at ``positions`` [B, L]
+    (reference fused kernel ``apply_rotary_pos_emb.cu``; half-split layout)."""
+    d = x.shape[-1]
+    inv_freq = 1.0 / (theta**(jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, L, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]  # [B, L, 1, D/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+class LlamaAttention(nn.Module):
+    """GQA attention with RoPE and an optional decode cache."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions=None, *, decode: bool = False, attention_mask=None):
+        cfg = self.config
+        b, l, _ = x.shape
+        n_rep = cfg.num_attention_heads // cfg.num_key_value_heads
+
+        def proj(heads, name):
+            return nn.DenseGeneral(features=(heads, cfg.head_dim), axis=-1, use_bias=False,
+                                   dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                                   kernel_init=nn.with_logical_partitioning(_init(), ("embed", "heads", "kv")),
+                                   name=name)
+
+        q = proj(cfg.num_attention_heads, "q_proj")(x)
+        k = proj(cfg.num_key_value_heads, "k_proj")(x)
+        v = proj(cfg.num_key_value_heads, "v_proj")(x)
+
+        causal = True
+        # attention_mask: [B, L] 0/1 padding mask (or a pre-broadcast boolean
+        # mask). In decode mode L must span the cache (max_position_embeddings).
+        mask = normalize_padding_mask(attention_mask)
+        if decode:
+            # static-shape KV cache (flax convention: cache collection)
+            cached_k = self.variable("cache", "cached_key",
+                                     jnp.zeros, (b, cfg.max_position_embeddings,
+                                                 cfg.num_key_value_heads, cfg.head_dim), k.dtype)
+            cached_v = self.variable("cache", "cached_value",
+                                     jnp.zeros, (b, cfg.max_position_embeddings,
+                                                 cfg.num_key_value_heads, cfg.head_dim), v.dtype)
+            cache_index = self.variable("cache", "cache_index",
+                                        lambda: jnp.zeros([], jnp.int32))
+            idx = cache_index.value
+            if positions is None:
+                positions = idx + jnp.broadcast_to(jnp.arange(l)[None, :], (b, l))
+            q = rotary_embedding(q, positions, cfg.rope_theta)
+            k = rotary_embedding(k, positions, cfg.rope_theta)
+            cached_k.value = jax.lax.dynamic_update_slice(cached_k.value, k, (0, idx, 0, 0))
+            cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, v, (0, idx, 0, 0))
+            cache_index.value = idx + l
+            k = cached_k.value
+            v = cached_v.value
+            # causal validity over cache slots, intersected with any caller
+            # padding mask (which spans the cache slots)
+            kv_pos = jnp.arange(cfg.max_position_embeddings)[None, None, None, :]
+            q_pos = positions[:, None, :, None]  # [B, 1, Lq, 1]
+            validity = kv_pos <= q_pos
+            mask = validity if mask is None else jnp.logical_and(validity, mask)
+            causal = False
+        else:
+            if positions is None:
+                positions = jnp.broadcast_to(jnp.arange(l)[None, :], (b, l))
+            q = rotary_embedding(q, positions, cfg.rope_theta)
+            k = rotary_embedding(k, positions, cfg.rope_theta)
+
+        if n_rep > 1:  # GQA: expand kv heads to full heads
+            k = jnp.repeat(k, n_rep, axis=2)
+            v = jnp.repeat(v, n_rep, axis=2)
+
+        out = dot_product_attention(q, k, v, backend=cfg.attention_backend, causal=causal, mask=mask)
+        return nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1), use_bias=False,
+                               dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                               kernel_init=nn.with_logical_partitioning(_init(), ("heads", "kv", "embed")),
+                               name="o_proj")(out)
+
+
+class LlamaMLP(nn.Module):
+    """SwiGLU MLP (reference fused GEGLU/gated-mlp inference kernels,
+    ``csrc/transformer/inference/csrc/gelu.cu`` fused_gemm_gelu family)."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+
+        def dense(feat, names, name):
+            return nn.Dense(features=feat, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                            kernel_init=nn.with_logical_partitioning(_init(), names), name=name)
+
+        gate = dense(cfg.intermediate_size, ("embed", "mlp"), "gate_proj")(x)
+        up = dense(cfg.intermediate_size, ("embed", "mlp"), "up_proj")(x)
+        return dense(cfg.hidden_size, ("mlp", "embed"), "down_proj")(jax.nn.silu(gate) * up)
+
+
+class LlamaDecoderLayer(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions=None, decode: bool = False, attention_mask=None):
+        cfg = self.config
+        x = x + LlamaAttention(cfg, name="self_attn")(
+            RMSNorm(cfg, name="input_layernorm")(x), positions, decode=decode,
+            attention_mask=attention_mask)
+        x = x + LlamaMLP(cfg, name="mlp")(RMSNorm(cfg, name="post_attention_layernorm")(x))
+        return x
+
+
+def init_cache(model: "nn.Module", batch_size: int, rng=None):
+    """Build a zeroed decode cache for ``model`` (the reference's
+    ``allocate_workspace`` KV-cache setup, ``pt_binding.cpp:1928``).
+
+    Uses ``eval_shape`` so no compute runs and the cache index starts at 0
+    (``model.init(decode=True)`` would advance it by tracing the call body).
+    """
+    ids = jnp.zeros((batch_size, 1), jnp.int32)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    shapes = jax.eval_shape(lambda: model.init(rng, ids, decode=True))
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
+
+
+class LlamaForCausalLM(nn.Module):
+    """LLaMA with an untied LM head. Returns logits [B, L, V].
+
+    ``decode=True`` runs incrementally against the flax ``cache`` collection
+    (pass ``mutable=["cache"]`` to ``apply``).
+    """
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, *, deterministic: bool = True, decode: bool = False,
+                 positions=None, attention_mask=None):
+        cfg = self.config
+        wte = self.param("embed_tokens", nn.with_logical_partitioning(_init(), ("vocab", "embed")),
+                         (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+        wte_value = wte.value if isinstance(wte, nn.meta.AxisMetadata) else wte
+        x = jnp.take(wte_value, input_ids, axis=0).astype(cfg.dtype)
+
+        layer_cls = LlamaDecoderLayer
+        if cfg.remat and not decode:
+            layer_cls = nn.remat(LlamaDecoderLayer, static_argnums=(3,), prevent_cse=False)
+        for i in range(cfg.num_hidden_layers):
+            x = layer_cls(cfg, name=f"layers_{i}")(x, positions, decode, attention_mask)
+        x = RMSNorm(cfg, name="norm")(x)
+        logits = nn.Dense(features=cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                          param_dtype=cfg.param_dtype,
+                          kernel_init=nn.with_logical_partitioning(_init(), ("embed", "vocab")),
+                          name="lm_head")(x)
+        return logits.astype(jnp.float32)

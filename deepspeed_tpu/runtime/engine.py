@@ -256,20 +256,33 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # jitted step construction
     # ------------------------------------------------------------------
+    def _module_kwargs(self, mb):
+        """Forward batch-dict keys that the module's signature accepts
+        (attention_mask, token_type_ids, ...) alongside input_ids."""
+        if not isinstance(mb, dict):
+            return {}
+        import inspect
+        try:
+            sig = inspect.signature(type(self.module).__call__)
+        except (TypeError, ValueError):
+            return {}
+        return {k: v for k, v in mb.items() if k not in ("input_ids", "labels") and k in sig.parameters}
+
     def _loss_for(self, params, mb, key, scale, train: bool = True):
         cparams = _cast_floating(params, self.compute_dtype)
         ids = mb["input_ids"] if isinstance(mb, dict) else mb
+        extra = self._module_kwargs(mb)
         mcfg = getattr(self.module, "config", None)
         has_dropout = mcfg is not None and getattr(mcfg, "dropout", 0.0) > 0.0
         has_moe = mcfg is not None and getattr(mcfg, "moe_num_experts", 0) > 0
         if train and (has_dropout or has_moe):
             drop_key, gate_key = jax.random.split(key)
             outputs = self.module.apply({"params": cparams}, ids, deterministic=False,
-                                        rngs={"dropout": drop_key, "gating": gate_key})
+                                        rngs={"dropout": drop_key, "gating": gate_key}, **extra)
         else:
             # eval: deterministic gating (eval capacity factor, no RTS/noise);
             # the aux loss is a training-only regularizer — report pure CE
-            outputs = self.module.apply({"params": cparams}, ids, deterministic=True)
+            outputs = self.module.apply({"params": cparams}, ids, deterministic=True, **extra)
             if has_moe and isinstance(outputs, (tuple, list)):
                 outputs = outputs[0]
         loss = self.loss_fn(outputs, mb)
