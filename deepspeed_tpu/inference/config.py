@@ -1,0 +1,87 @@
+"""Inference config (reference ``deepspeed/inference/config.py``,
+``DeepSpeedInferenceConfig``): same knob vocabulary, TPU semantics.
+
+CUDA-specific fields (``enable_cuda_graph``, ``use_triton`` etc.) are
+accepted and ignored with a note — jit compilation already gives the
+capture/replay behavior CUDA graphs add."""
+
+from typing import Any, Dict, Optional, Union
+
+import jax.numpy as jnp
+from pydantic import Field, field_validator
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+_DTYPES = {
+    "float32": jnp.float32, "fp32": jnp.float32, "float": jnp.float32,
+    "float16": jnp.float16, "fp16": jnp.float16, "half": jnp.float16,
+    "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+    "int8": jnp.int8,
+}
+
+
+class DeepSpeedTPConfig(DeepSpeedConfigModel):
+    """Reference ``DeepSpeedTPConfig``."""
+    enabled: bool = True
+    tp_size: int = 1
+    mpu: Optional[Any] = None
+    tp_group: Optional[Any] = None
+
+
+class DeepSpeedMoEConfig(DeepSpeedConfigModel):
+    """Reference ``DeepSpeedMoEConfig`` (inference)."""
+    enabled: bool = True
+    ep_size: int = 1
+    moe_experts: Union[int, list] = Field(1, alias="num_experts")
+    type: str = "standard"
+
+
+class QuantizationConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    bits: int = 8
+    group_size: int = 64
+
+
+class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    """Reference ``inference/config.py`` surface."""
+
+    replace_with_kernel_inject: bool = Field(False, alias="kernel_inject")
+    dtype: Any = None
+    tensor_parallel: DeepSpeedTPConfig = Field(default_factory=DeepSpeedTPConfig, alias="tp")
+    moe: Union[bool, DeepSpeedMoEConfig] = Field(default_factory=DeepSpeedMoEConfig)
+    quant: QuantizationConfig = Field(default_factory=QuantizationConfig)
+    checkpoint: Optional[Union[str, Dict]] = None
+    base_dir: str = ""
+    max_tokens: int = Field(1024, alias="max_out_tokens")
+    min_out_tokens: int = Field(1, alias="min_tokens")
+    max_new_tokens: int = 64
+    injection_policy: Optional[Dict] = Field(None, alias="injection_dict")
+    replace_method: str = Field("auto", json_schema_extra={"deprecated": True})
+    # CUDA-era knobs: accepted, ignored (jit subsumes graph capture)
+    enable_cuda_graph: bool = False
+    use_triton: bool = False
+    triton_autotune: bool = False
+    # TPU-native extras
+    use_flash_prefill: bool = False  # Pallas flash attention for prefill
+    batch_size: int = 1
+
+    @field_validator("dtype", mode="before")
+    @classmethod
+    def _resolve_dtype(cls, v):
+        if v is None or isinstance(v, str) and v in ("", "auto"):
+            return None
+        if isinstance(v, str):
+            key = v.lower().replace("torch.", "")
+            if key not in _DTYPES:
+                raise ValueError(f"unknown dtype {v!r}; accepted: {sorted(_DTYPES)}")
+            return _DTYPES[key]
+        # torch dtype objects arrive as e.g. torch.float16
+        s = str(v).replace("torch.", "").lower()
+        return _DTYPES.get(s, v)
+
+    @field_validator("moe", mode="before")
+    @classmethod
+    def _moe_bool(cls, v):
+        if isinstance(v, bool):
+            return DeepSpeedMoEConfig(enabled=v)
+        return v

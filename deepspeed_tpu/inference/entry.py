@@ -1,0 +1,30 @@
+"""``init_inference`` — parity with reference ``deepspeed/__init__.py:269``."""
+
+from typing import Optional
+
+from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.utils.logging import log_dist
+from deepspeed_tpu.version import __version__
+
+
+def init_inference(model, config=None, params=None, topology=None, **kwargs):
+    """Build an :class:`InferenceEngine` (reference ``init_inference``).
+
+    ``config`` may be a dict/``DeepSpeedInferenceConfig``; legacy kwargs
+    (``mp_size=``, ``dtype=``, ``replace_with_kernel_inject=`` …) are folded
+    in for parity with the reference's kwarg path (``__init__.py:306``).
+    """
+    log_dist(f"DeepSpeed-TPU inference info: version={__version__}")
+    cfg_dict = dict(config) if isinstance(config, dict) else {}
+    if isinstance(config, DeepSpeedInferenceConfig):
+        ds_config = config
+    else:
+        # legacy kwarg names (reference maps mp_size → tensor_parallel.tp_size)
+        if "mp_size" in kwargs:
+            cfg_dict.setdefault("tensor_parallel", {})
+            if isinstance(cfg_dict["tensor_parallel"], dict):
+                cfg_dict["tensor_parallel"].setdefault("tp_size", kwargs.pop("mp_size"))
+        cfg_dict.update(kwargs)
+        ds_config = DeepSpeedInferenceConfig(**cfg_dict)
+    return InferenceEngine(model, ds_config, params=params, topology=topology)

@@ -1,6 +1,23 @@
 """Shared model-zoo helpers."""
 
+import jax
+import jax.numpy as jnp
+
 import flax.linen as nn
+
+
+def init_cache(model: nn.Module, batch_size: int, rng=None):
+    """Build a zeroed decode cache for any model supporting ``decode=True``
+    (the reference's ``allocate_workspace`` KV-cache setup,
+    ``csrc/transformer/inference/csrc/pt_binding.cpp:1928``).
+
+    Uses ``eval_shape`` so no compute runs and the cache index starts at 0
+    (``model.init(decode=True)`` would advance it by tracing the call body).
+    """
+    ids = jnp.zeros((batch_size, 1), jnp.int32)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    shapes = jax.eval_shape(lambda: model.init(rng, ids, decode=True))
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
 
 
 def dense_init(scale: float = 0.02):

@@ -79,6 +79,7 @@ def _dense_init(scale=0.02):
 
 class SelfAttention(nn.Module):
     config: GPT2Config
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, *, deterministic: bool = True):
@@ -95,11 +96,31 @@ class SelfAttention(nn.Module):
         dropout_rng = None
         if not deterministic and cfg.dropout > 0.0:
             dropout_rng = self.make_rng("dropout")
+        causal, mask = True, None
+        if self.decode:
+            # incremental decoding against a static-shape KV cache (the
+            # reference's inference workspace, inference_context.h)
+            b, l = x.shape[0], x.shape[1]
+            cached_k = self.variable("cache", "cached_key", jnp.zeros,
+                                     (b, cfg.n_positions, cfg.n_head, cfg.head_dim), k.dtype)
+            cached_v = self.variable("cache", "cached_value", jnp.zeros,
+                                     (b, cfg.n_positions, cfg.n_head, cfg.head_dim), v.dtype)
+            cache_index = self.variable("cache", "cache_index", lambda: jnp.zeros([], jnp.int32))
+            idx = cache_index.value
+            cached_k.value = jax.lax.dynamic_update_slice(cached_k.value, k, (0, idx, 0, 0))
+            cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, v, (0, idx, 0, 0))
+            cache_index.value = idx + l
+            k, v = cached_k.value, cached_v.value
+            kv_pos = jnp.arange(cfg.n_positions)[None, None, None, :]
+            q_pos = (idx + jnp.arange(l))[None, None, :, None]
+            mask = kv_pos <= q_pos
+            causal = False
         attn_out = dot_product_attention(q,
                                          k,
                                          v,
                                          backend=cfg.attention_backend,
-                                         causal=True,
+                                         causal=causal,
+                                         mask=mask,
                                          dropout_rate=0.0 if deterministic else cfg.dropout,
                                          dropout_rng=dropout_rng)
         out = nn.DenseGeneral(features=cfg.n_embd,
@@ -154,13 +175,15 @@ class LayerNorm(nn.Module):
 class Block(nn.Module):
     config: GPT2Config
     use_moe: bool = False
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
         # deterministic is positional (not kw-only) so nn.remat can mark it
         # static (static_argnums below)
         cfg = self.config
-        x = x + SelfAttention(cfg, name="attn")(LayerNorm(cfg, name="ln_1")(x), deterministic=deterministic)
+        x = x + SelfAttention(cfg, self.decode, name="attn")(LayerNorm(cfg, name="ln_1")(x),
+                                                             deterministic=deterministic)
         h = LayerNorm(cfg, name="ln_2")(x)
         if self.use_moe:
             from deepspeed_tpu.moe import MoE
@@ -188,7 +211,7 @@ class GPT2LMHeadModel(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, input_ids, *, deterministic: bool = True):
+    def __call__(self, input_ids, *, deterministic: bool = True, decode: bool = False):
         cfg = self.config
         wte = self.param("wte", nn.with_logical_partitioning(_dense_init(), ("vocab", "embed")),
                          (cfg.vocab_size, cfg.n_embd), cfg.param_dtype)
@@ -199,7 +222,16 @@ class GPT2LMHeadModel(nn.Module):
 
         _, seq_len = input_ids.shape
         x = jnp.take(wte_value, input_ids, axis=0).astype(cfg.dtype)
-        x = x + wpe_value[:seq_len].astype(cfg.dtype)
+        if decode:
+            # position offset for wpe; advances in lockstep with each
+            # attention layer's cache_index (same increment per call — flax
+            # offers no clean cross-module read, so the counter is duplicated)
+            pos_idx = self.variable("cache", "position_index", lambda: jnp.zeros([], jnp.int32))
+            positions = pos_idx.value + jnp.arange(seq_len)
+            pos_idx.value = pos_idx.value + seq_len
+            x = x + jnp.take(wpe_value, positions, axis=0).astype(cfg.dtype)[None]
+        else:
+            x = x + wpe_value[:seq_len].astype(cfg.dtype)
         if not deterministic and cfg.dropout > 0.0:
             x = nn.Dropout(rate=cfg.dropout)(x, deterministic=False)
 
@@ -209,7 +241,7 @@ class GPT2LMHeadModel(nn.Module):
         aux_total = jnp.zeros([], jnp.float32)
         for i in range(cfg.n_layer):
             use_moe = cfg.moe_num_experts > 0 and (i % cfg.moe_layer_freq == cfg.moe_layer_freq - 1)
-            x, l_aux = block_cls(cfg, use_moe, name=f"h_{i}")(x, deterministic)
+            x, l_aux = block_cls(cfg, use_moe, decode, name=f"h_{i}")(x, deterministic)
             aux_total = aux_total + l_aux
         x = LayerNorm(cfg, name="ln_f")(x)
         # tied LM head (fp32 logits for a stable loss)

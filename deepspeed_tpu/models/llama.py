@@ -196,17 +196,7 @@ class LlamaDecoderLayer(nn.Module):
         return x
 
 
-def init_cache(model: "nn.Module", batch_size: int, rng=None):
-    """Build a zeroed decode cache for ``model`` (the reference's
-    ``allocate_workspace`` KV-cache setup, ``pt_binding.cpp:1928``).
-
-    Uses ``eval_shape`` so no compute runs and the cache index starts at 0
-    (``model.init(decode=True)`` would advance it by tracing the call body).
-    """
-    ids = jnp.zeros((batch_size, 1), jnp.int32)
-    rng = rng if rng is not None else jax.random.PRNGKey(0)
-    shapes = jax.eval_shape(lambda: model.init(rng, ids, decode=True))
-    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
+from deepspeed_tpu.models.common import init_cache  # noqa: E402  (re-export)
 
 
 class LlamaForCausalLM(nn.Module):

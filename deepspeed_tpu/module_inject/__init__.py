@@ -1,0 +1,10 @@
+"""Module injection: inference kernel policies, AutoTP, HF weight loading
+(reference ``deepspeed/module_inject/``)."""
+
+from deepspeed_tpu.module_inject.auto_tp import AutoTP
+from deepspeed_tpu.module_inject.load_checkpoint import (load_hf_checkpoint, load_hf_gpt2, load_hf_llama)
+from deepspeed_tpu.module_inject.replace_module import (generic_injection, replace_transformer_layer,
+                                                        tp_shard_params)
+
+__all__ = ["AutoTP", "load_hf_checkpoint", "load_hf_gpt2", "load_hf_llama", "generic_injection",
+           "replace_transformer_layer", "tp_shard_params"]

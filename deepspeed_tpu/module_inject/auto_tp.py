@@ -1,0 +1,89 @@
+"""Automatic tensor parallelism for unannotated models
+(reference ``module_inject/auto_tp.py:13`` ``AutoTP``).
+
+The reference walks the torch module graph classifying ``nn.Linear`` layers
+into column-parallel (shard output dim) vs row-parallel (shard input dim,
+all-reduce output) and slices weights. Here models already computed are flax
+param pytrees; ``AutoTP`` classifies each 2-D+ kernel by its *path name*
+using the same layer vocabulary the reference's parser learns from
+supported architectures, and emits a ``PartitionSpec`` tree — XLA inserts
+the (all-gather / all-reduce) collectives a Megatron layout implies.
+"""
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import TENSOR_AXIS
+
+# layer-name vocabulary → Megatron role (reference auto_tp.py builds this by
+# parsing supported HF architectures; kept explicit here)
+COLUMN_PARALLEL_NAMES = (
+    # attention input projections and MLP up-projections: shard the OUTPUT dim
+    "q_proj", "k_proj", "v_proj", "query", "key", "value", "c_attn", "query_key_value",
+    "gate_proj", "up_proj", "c_fc", "fc1", "wi", "intermediate", "dense_h_to_4h",
+)
+ROW_PARALLEL_NAMES = (
+    # attention output and MLP down-projections: shard the INPUT dim,
+    # all-reduce the output (reference LinearAllreduce, module_inject/layers.py:15)
+    "o_proj", "out_proj", "down_proj", "c_proj", "fc2", "wo", "dense_4h_to_h",
+)
+VOCAB_PARALLEL_NAMES = ("wte", "embed_tokens", "word_embeddings", "lm_head", "embed_out")
+
+
+def _path_parts(path) -> Tuple[str, ...]:
+    return tuple(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+
+
+class AutoTP:
+    """Classify params into TP shardings by path (reference ``AutoTP``)."""
+
+    @staticmethod
+    def classify(path_parts: Sequence[str]) -> Optional[str]:
+        for part in path_parts:
+            low = part.lower()
+            if any(n == low or low.endswith(n) for n in ROW_PARALLEL_NAMES):
+                return "row"
+            if any(n == low or low.endswith(n) for n in COLUMN_PARALLEL_NAMES):
+                return "column"
+            if any(n == low or low.endswith(n) for n in VOCAB_PARALLEL_NAMES):
+                return "vocab"
+        return None
+
+    @staticmethod
+    def spec_for(path_parts: Sequence[str], shape: Sequence[int], tp_size: int) -> P:
+        """PartitionSpec for one param. Kernels are [in, ..., out] (flax
+        convention); biases follow the output dim of their layer."""
+        if tp_size <= 1:
+            return P()
+        role = AutoTP.classify(path_parts)
+        is_bias = path_parts and path_parts[-1] in ("bias",)
+        if role is None:
+            return P()
+        if role == "vocab":
+            if len(shape) >= 2 and shape[0] % tp_size == 0:
+                return P(TENSOR_AXIS)  # [vocab, embed]
+            return P()
+        if is_bias:
+            if role == "column" and shape and shape[-1] % tp_size == 0:
+                parts = [None] * (len(shape) - 1) + [TENSOR_AXIS]
+                return P(*parts)
+            return P()  # row-parallel bias is replicated (added post-allreduce)
+        if len(shape) < 2:
+            return P()
+        if role == "column" and shape[-1] % tp_size == 0:
+            parts = [None] * (len(shape) - 1) + [TENSOR_AXIS]
+            return P(*parts)
+        if role == "row" and shape[0] % tp_size == 0:
+            parts = [TENSOR_AXIS] + [None] * (len(shape) - 1)
+            return P(*parts)
+        return P()
+
+    @staticmethod
+    def tp_parser(params, tp_size: int):
+        """Emit a PartitionSpec pytree for a raw param tree
+        (reference ``AutoTP.tp_parser`` + ``ReplaceWithTensorSlicing``)."""
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: AutoTP.spec_for(_path_parts(path), getattr(leaf, "shape", ()), tp_size),
+            params)

@@ -1,0 +1,118 @@
+"""HF-checkpoint → flax param-tree converters
+(reference ``module_inject/load_checkpoint.py`` + the per-arch containers
+``module_inject/containers/{gpt2,llama,bert}.py`` which slice HF weights
+into the injected modules).
+
+These let a reference user bring their torch checkpoints: a HF torch model
+(or its state dict) is remapped into the deepspeed_tpu model-zoo layout.
+Numerical parity is covered by tests (HF torch CPU forward vs ours).
+"""
+
+from typing import Any, Dict
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+def _np(t):
+    return np.asarray(t.detach().cpu().numpy() if hasattr(t, "detach") else t)
+
+
+def _sd(model_or_sd) -> Dict[str, Any]:
+    if hasattr(model_or_sd, "state_dict"):
+        return {k: _np(v) for k, v in model_or_sd.state_dict().items()}
+    return {k: _np(v) for k, v in model_or_sd.items()}
+
+
+def load_hf_gpt2(model_or_sd, cfg) -> dict:
+    """HF ``GPT2LMHeadModel`` → ``models.gpt2.GPT2LMHeadModel`` params.
+
+    HF GPT-2 uses Conv1D ([in, out] kernels, same as flax Dense); qkv is one
+    fused [E, 3E] matrix split into our [E, 3, H, D] layout.
+    """
+    sd = _sd(model_or_sd)
+    pre = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    E, H, D = cfg.n_embd, cfg.n_head, cfg.head_dim
+    params = {
+        "wte": jnp.asarray(sd[f"{pre}wte.weight"]),
+        "wpe": jnp.asarray(sd[f"{pre}wpe.weight"]),
+        "ln_f": {"LayerNorm_0": {"scale": jnp.asarray(sd[f"{pre}ln_f.weight"]),
+                                 "bias": jnp.asarray(sd[f"{pre}ln_f.bias"])}},
+    }
+    for i in range(cfg.n_layer):
+        p = f"{pre}h.{i}."
+        c_attn_w = sd[p + "attn.c_attn.weight"].reshape(E, 3, H, D)
+        c_attn_b = sd[p + "attn.c_attn.bias"].reshape(3, H, D)
+        c_proj_w = sd[p + "attn.c_proj.weight"].reshape(H, D, E)
+        params[f"h_{i}"] = {
+            "ln_1": {"LayerNorm_0": {"scale": jnp.asarray(sd[p + "ln_1.weight"]),
+                                     "bias": jnp.asarray(sd[p + "ln_1.bias"])}},
+            "ln_2": {"LayerNorm_0": {"scale": jnp.asarray(sd[p + "ln_2.weight"]),
+                                     "bias": jnp.asarray(sd[p + "ln_2.bias"])}},
+            "attn": {
+                "c_attn": {"kernel": jnp.asarray(c_attn_w), "bias": jnp.asarray(c_attn_b)},
+                "c_proj": {"kernel": jnp.asarray(c_proj_w), "bias": jnp.asarray(sd[p + "attn.c_proj.bias"])},
+            },
+            "mlp": {
+                "c_fc": {"kernel": jnp.asarray(sd[p + "mlp.c_fc.weight"]),
+                         "bias": jnp.asarray(sd[p + "mlp.c_fc.bias"])},
+                "c_proj": {"kernel": jnp.asarray(sd[p + "mlp.c_proj.weight"]),
+                           "bias": jnp.asarray(sd[p + "mlp.c_proj.bias"])},
+            },
+        }
+    return params
+
+
+def load_hf_llama(model_or_sd, cfg) -> dict:
+    """HF ``LlamaForCausalLM`` → ``models.llama.LlamaForCausalLM`` params.
+
+    HF Linear weights are [out, in] — transposed into flax [in, out]; q/k/v
+    reshape into [in, heads, head_dim]. NOTE: HF LLaMA uses the
+    interleaved-rotary convention permuted at conversion time; weights
+    converted by HF's own script are compatible with half-split RoPE.
+    """
+    sd = _sd(model_or_sd)
+    pre = "model." if any(k.startswith("model.") for k in sd) else ""
+    E, H, KV, D = cfg.hidden_size, cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+
+    def lin_t(name):  # [out, in] -> [in, out]
+        return jnp.asarray(sd[name].T)
+
+    def heads_t(name, heads):  # [heads*D, in] -> [in, heads, D]
+        return jnp.asarray(sd[name].T.reshape(E, heads, D))
+
+    params = {
+        "embed_tokens": jnp.asarray(sd[f"{pre}embed_tokens.weight"]),
+        "norm": {"weight": jnp.asarray(sd[f"{pre}norm.weight"])},
+        # tied-embedding checkpoints (tie_word_embeddings=True) omit lm_head
+        "lm_head": {"kernel": lin_t("lm_head.weight") if "lm_head.weight" in sd
+                    else jnp.asarray(sd[f"{pre}embed_tokens.weight"].T)},
+    }
+    for i in range(cfg.num_hidden_layers):
+        p = f"{pre}layers.{i}."
+        o_w = jnp.asarray(sd[p + "self_attn.o_proj.weight"].T.reshape(H, D, E))
+        params[f"layers_{i}"] = {
+            "input_layernorm": {"weight": jnp.asarray(sd[p + "input_layernorm.weight"])},
+            "post_attention_layernorm": {"weight": jnp.asarray(sd[p + "post_attention_layernorm.weight"])},
+            "self_attn": {
+                "q_proj": {"kernel": heads_t(p + "self_attn.q_proj.weight", H)},
+                "k_proj": {"kernel": heads_t(p + "self_attn.k_proj.weight", KV)},
+                "v_proj": {"kernel": heads_t(p + "self_attn.v_proj.weight", KV)},
+                "o_proj": {"kernel": o_w},
+            },
+            "mlp": {
+                "gate_proj": {"kernel": lin_t(p + "mlp.gate_proj.weight")},
+                "up_proj": {"kernel": lin_t(p + "mlp.up_proj.weight")},
+                "down_proj": {"kernel": lin_t(p + "mlp.down_proj.weight")},
+            },
+        }
+    return params
+
+
+def load_hf_checkpoint(hf_model, arch: str, cfg) -> dict:
+    """Dispatch by architecture (reference per-arch policy containers)."""
+    loaders = {"gpt2": load_hf_gpt2, "llama": load_hf_llama}
+    if arch not in loaders:
+        raise ValueError(f"no HF converter for architecture {arch!r}; available: {sorted(loaders)}")
+    return loaders[arch](hf_model, cfg)

@@ -1,0 +1,97 @@
+"""Policy application: swap a model onto the inference-optimized path and
+shard its params for tensor parallelism
+(reference ``module_inject/replace_module.py:283`` ``replace_transformer_layer``).
+
+The reference rewrites torch modules into fused-kernel
+``DeepSpeedTransformerInference`` blocks and slices weights per TP rank.
+On TPU both steps are declarative:
+
+* "kernel injection" = rebuilding the flax model config with the optimized
+  attention backend (Pallas flash for prefill; the decode path's fused
+  cache math is already in the model) and the serving dtype;
+* "weight slicing"   = a ``device_put`` onto NamedShardings derived from
+  the model's logical axis names — or, for unannotated models, from
+  :class:`AutoTP` name classification.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import flax.linen as nn
+
+from deepspeed_tpu.module_inject.auto_tp import AutoTP
+from deepspeed_tpu.parallel.sharding import DEFAULT_LOGICAL_RULES, logical_to_mesh_spec
+from deepspeed_tpu.parallel.topology import MeshTopology
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def generic_injection(model, dtype=None, enable_cuda_graph=False):
+    """Reference ``replace_module.py:187`` (diffusers): accepted for API
+    parity; TPU serving needs no graph capture (jit is the graph)."""
+    return model
+
+
+def replace_transformer_layer(model: nn.Module, config) -> nn.Module:
+    """Rebuild the model with inference-optimized settings (the TPU analog
+    of swapping in ``DeepSpeedTransformerInference``)."""
+    mcfg = getattr(model, "config", None)
+    if mcfg is None or not dataclasses.is_dataclass(mcfg):
+        return model
+    updates = {}
+    if config.dtype is not None and hasattr(mcfg, "dtype") and mcfg.dtype != config.dtype:
+        updates["dtype"] = config.dtype
+    if (config.replace_with_kernel_inject and config.use_flash_prefill
+            and hasattr(mcfg, "attention_backend") and mcfg.attention_backend != "flash"):
+        # Pallas flash kernel for full-sequence forward() calls; the decode
+        # loop always uses the model's fused cache path (masked XLA
+        # attention — the flash kernel takes no explicit mask yet)
+        updates["attention_backend"] = "flash"
+    if not updates:
+        return model
+    new_cfg = dataclasses.replace(mcfg, **updates)
+    log_dist(f"inference injection: {type(model).__name__} config updates {list(updates)}")
+    return type(model)(new_cfg)
+
+
+def tp_shard_params(params, model: Optional[nn.Module], topology: MeshTopology,
+                    example_ids=None, rules=DEFAULT_LOGICAL_RULES):
+    """Shard a param tree over the ``tensor`` mesh axis.
+
+    Annotated models (logical axis names) get exact Megatron layouts via the
+    sharding rules; raw trees fall back to AutoTP name classification
+    (reference ``ReplaceWithTensorSlicing`` / ``AutoTP``).
+    """
+    mesh = topology.mesh
+
+    def drop_indivisible(spec: P, shape) -> P:
+        """Drop axis assignments a dim can't honor (e.g. 2 kv heads on a
+        4-way tensor axis — the reference's slicer has the same guard in
+        ``ReplaceWithTensorSlicing.strided_copy``)."""
+        parts = list(spec) + [None] * (len(shape) - len(spec))
+        out = []
+        for dim, part in zip(shape, parts):
+            axes = part if isinstance(part, tuple) else (part,) if part else ()
+            size = 1
+            for a in axes:
+                size *= mesh.shape[a]
+            out.append(part if size > 0 and dim % max(size, 1) == 0 else None)
+        return P(*out)
+
+    specs = None
+    if model is not None and example_ids is not None:
+        try:
+            abstract = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0), example_ids))
+            logical = nn.get_partition_spec(abstract["params"])
+            specs = jax.tree.map(lambda s: logical_to_mesh_spec(tuple(s), rules), logical,
+                                 is_leaf=lambda x: isinstance(x, P))
+        except Exception:
+            specs = None
+    if specs is None:
+        specs = AutoTP.tp_parser(params, topology.tensor_parallel_size)
+    specs = jax.tree.map(lambda s, p: drop_indivisible(s, getattr(p, "shape", ())), specs, params,
+                         is_leaf=lambda x: isinstance(x, P))
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P))
+    return jax.device_put(params, shardings), specs
