@@ -18,6 +18,10 @@ def init_inference(model, config=None, params=None, topology=None, **kwargs):
     log_dist(f"DeepSpeed-TPU inference info: version={__version__}")
     cfg_dict = dict(config) if isinstance(config, dict) else {}
     if isinstance(config, DeepSpeedInferenceConfig):
+        if kwargs:
+            # reference raises on conflicting config + kwargs (__init__.py:318)
+            raise ValueError(f"init_inference got both a DeepSpeedInferenceConfig and kwargs "
+                             f"{sorted(kwargs)}; fold the kwargs into the config")
         ds_config = config
     else:
         # legacy kwarg names (reference maps mp_size → tensor_parallel.tp_size)

@@ -1,0 +1,11 @@
+"""Quantization ops (reference ``deepspeed/ops/quantizer`` +
+``csrc/quantization``)."""
+
+from deepspeed_tpu.ops.quantizer.core import (QuantParams, dequantize, fake_quantize, pack_int4,
+                                              quantize, quantized_reduction, swizzle_quant, unpack_int4)
+
+# reference `ds_quantizer` entry (ops/quantizer/quantizer.py): QAT fake-quant
+ds_quantizer = fake_quantize
+
+__all__ = ["QuantParams", "quantize", "dequantize", "fake_quantize", "pack_int4", "unpack_int4",
+           "swizzle_quant", "quantized_reduction", "ds_quantizer"]

@@ -1,0 +1,156 @@
+"""Quantization kernels (reference ``csrc/quantization/``: ``quantize.cu``,
+``dequantize.cu``, ``fake_quantizer.cu``, ``swizzled_quantize.cu``,
+``quant_reduce.cu``; Python surface ``deepspeed/ops/quantizer``).
+
+TPU-native: grouped sym/asym int8/int4 quantization as jnp ops — XLA fuses
+the max-reduce + scale + round into the surrounding computation, which is
+what the reference's hand-fused CUDA kernels buy. Int4 values are packed
+two-per-byte so quantized collectives really move half the bytes.
+
+Stochastic rounding (reference ``fake_quantizer.cu`` sr_* variants) keeps
+quantized training unbiased: round up with probability equal to the
+fractional part.
+"""
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class QuantParams(NamedTuple):
+    """Per-group quantization metadata. ``offset`` is the asymmetric zero
+    point (None ⇒ symmetric)."""
+    scale: jax.Array  # [groups, 1] fp32
+    offset: Optional[jax.Array]  # [groups, 1] fp32 or None
+
+
+def divisor_groups(size: int, target_group_size: int) -> int:
+    """Largest group count ≤ size/target that divides ``size`` exactly
+    (``quantize`` requires an even split; real tensor sizes are rarely
+    multiples of the target group size)."""
+    groups = max(1, size // max(target_group_size, 1))
+    while groups > 1 and size % groups != 0:
+        groups -= 1
+    return groups
+
+
+def _q_range(num_bits: int, symmetric: bool) -> Tuple[float, float]:
+    if symmetric:
+        q = float(2**(num_bits - 1) - 1)  # int8: ±127, int4: ±7
+        return -q, q
+    return 0.0, float(2**num_bits - 1)  # uint range
+
+
+def _round(x, stochastic_rounding: bool, rng):
+    if stochastic_rounding:
+        if rng is None:
+            raise ValueError("stochastic_rounding=True requires an rng key")
+        noise = jax.random.uniform(rng, x.shape, jnp.float32)
+        return jnp.floor(x + noise)
+    return jnp.rint(x)
+
+
+def quantize(x: jax.Array,
+             num_bits: int = 8,
+             symmetric: bool = True,
+             num_groups: int = 1,
+             stochastic_rounding: bool = False,
+             rng: Optional[jax.Array] = None) -> Tuple[jax.Array, QuantParams]:
+    """Grouped quantization of ``x`` (any shape, size divisible by
+    ``num_groups``). Returns int8 codes of shape [groups, group_size] —
+    int4 codes occupy the low nibble (use :func:`pack_int4` to halve bytes).
+    """
+    flat = x.reshape(num_groups, -1).astype(jnp.float32)
+    qmin, qmax = _q_range(num_bits, symmetric)
+    if symmetric:
+        absmax = jnp.max(jnp.abs(flat), axis=1, keepdims=True)
+        scale = jnp.where(absmax > 0, absmax / qmax, 1.0)
+        q = _round(flat / scale, stochastic_rounding, rng)
+        q = jnp.clip(q, qmin, qmax)
+        return q.astype(jnp.int8), QuantParams(scale=scale, offset=None)
+    lo = jnp.min(flat, axis=1, keepdims=True)
+    hi = jnp.max(flat, axis=1, keepdims=True)
+    scale = jnp.where(hi > lo, (hi - lo) / qmax, 1.0)
+    q = _round((flat - lo) / scale, stochastic_rounding, rng)
+    q = jnp.clip(q, qmin, qmax)
+    # asymmetric codes are unsigned (int8 storage would clip 128..255)
+    return q.astype(jnp.uint8), QuantParams(scale=scale, offset=lo)
+
+
+def dequantize(q: jax.Array, params: QuantParams, shape=None) -> jax.Array:
+    """Inverse of :func:`quantize` (reference ``dequantize.cu``)."""
+    flat = q.astype(jnp.float32)
+    if params.offset is None:
+        out = flat * params.scale
+    else:
+        out = flat * params.scale + params.offset
+    return out.reshape(shape) if shape is not None else out
+
+
+def pack_int4(q: jax.Array) -> jax.Array:
+    """Pack int4 codes (int8 storage, range ±7 or 0..15) two-per-byte along
+    the last dim (must be even)."""
+    lo = q[..., 0::2] & 0xF
+    hi = q[..., 1::2] & 0xF
+    return (lo | (hi << 4)).astype(jnp.int8)
+
+
+def unpack_int4(packed: jax.Array, symmetric: bool = True) -> jax.Array:
+    """Inverse of :func:`pack_int4`; sign-extends when symmetric."""
+    lo = packed & 0xF
+    hi = (packed >> 4) & 0xF
+    out = jnp.stack([lo, hi], axis=-1).reshape(*packed.shape[:-1], -1)
+    if symmetric:
+        out = jnp.where(out > 7, out - 16, out)  # sign-extend nibble
+    return out.astype(jnp.int8)
+
+
+def fake_quantize(x: jax.Array,
+                  num_bits: int = 8,
+                  symmetric: bool = True,
+                  num_groups: int = 1,
+                  stochastic_rounding: bool = False,
+                  rng: Optional[jax.Array] = None) -> jax.Array:
+    """Quantize-dequantize in one step (reference ``fake_quantizer.cu`` —
+    MoQ training and QAT use this)."""
+    q, params = quantize(x, num_bits, symmetric, num_groups, stochastic_rounding, rng)
+    return dequantize(q, params, x.shape).astype(x.dtype)
+
+
+def swizzle_quant(x: jax.Array,
+                  num_bits: int = 8,
+                  num_groups: int = 1,
+                  pipeline_size: int = 1,
+                  nodes: int = 1,
+                  devices_per_node: int = 1,
+                  rng: Optional[jax.Array] = None):
+    """Quantize with the hierarchical-all-to-all swizzle
+    (reference ``swizzled_quantize.cu`` / ``pt_binding.cpp:swizzle_quant``).
+
+    The data is viewed as [pipeline, nodes, devices_per_node, rest] and the
+    node/device dims are transposed so each node's traffic is contiguous for
+    the first (intra-node) all-to-all hop of qgZ.
+    """
+    total = x.size
+    chunk = total // (pipeline_size * nodes * devices_per_node)
+    v = x.reshape(pipeline_size, nodes, devices_per_node, chunk)
+    v = jnp.transpose(v, (0, 2, 1, 3))  # devices-major → node-contiguous
+    return quantize(v, num_bits=num_bits, symmetric=True, num_groups=num_groups,
+                    stochastic_rounding=rng is not None, rng=rng)
+
+
+def quantized_reduction(q: jax.Array,
+                        params: QuantParams,
+                        num_bits_in: int,
+                        num_bits_out: int,
+                        devices: int,
+                        rng: Optional[jax.Array] = None):
+    """Dequantize ``devices`` chunks, average, requantize at a lower width
+    (reference ``quant_reduce.cu`` — the inter-node hop of qgZ reduces int8
+    partials into int4 output)."""
+    groups = q.shape[0]
+    vals = dequantize(q, params)  # [groups, gs]
+    vals = vals.reshape(devices, groups // devices, -1).mean(axis=0)
+    return quantize(vals, num_bits=num_bits_out, symmetric=True,
+                    num_groups=groups // devices, stochastic_rounding=rng is not None, rng=rng)

@@ -1,0 +1,114 @@
+"""Batched/quantized collectives
+(reference ``deepspeed/runtime/comm/coalesced_collectives.py``:
+``reduce_scatter_coalesced`` :72, ``all_to_all_quant_reduce`` :31).
+
+``all_to_all_quant_reduce`` is qgZ (ZeRO++): a two-hop hierarchical
+gradient reduction — int8 all-to-all + reduce within the node (``fsdp``
+axis ≅ intra-node group, ``_get_local_all_to_all_group``
+``groups.py:324``), then int4 (packed two-per-byte) all-to-all + reduce
+across nodes (``data`` axis), so the slow hop moves 4× fewer bytes than
+fp32 reduce-scatter. Runs as a ``shard_map`` manual over exactly those two
+mesh axes; everything else composes automatically.
+"""
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu.ops.quantizer.core import (dequantize, divisor_groups, pack_int4, quantize,
+                                              unpack_int4)
+from deepspeed_tpu.parallel.topology import DATA_AXIS, FSDP_AXIS
+
+
+def reduce_scatter_coalesced(tensors: Sequence[jax.Array], mesh: Mesh, axes=(DATA_AXIS, FSDP_AXIS)):
+    """Flatten-and-batch reduce-scatter (reference ``:72``): each device
+    gets the mean of its 1/W slice of every tensor, as one fused op.
+
+    Input tensors are per-device values stacked on a leading world dim
+    sharded over ``axes``; returns the scattered means with the same
+    leading layout.
+    """
+    world = 1
+    for a in axes:
+        world *= mesh.shape[a]
+
+    def spmd(xs):
+        outs = []
+        for x in xs:
+            x = x.reshape(-1)
+            y = jax.lax.psum_scatter(x.reshape(world, -1), axes, scatter_dimension=0, tiled=False)
+            outs.append(y / world)
+        return tuple(outs)
+
+    fn = jax.shard_map(spmd, mesh=mesh, in_specs=(tuple(P(axes) for _ in tensors),),
+                       out_specs=tuple(P(axes) for _ in tensors), axis_names=set(axes))
+    return fn(tuple(tensors))
+
+
+def _a2a_reduce_one(x, axis: str, axis_size: int, num_bits: int, groups_per_chunk: int, rng):
+    """One hierarchical hop: chunk → quantize → all_to_all → dequant → mean."""
+    n = x.shape[-1]
+    chunks = x.reshape(axis_size, n // axis_size)
+    use_pack = num_bits == 4 and (n // axis_size) % 2 == 0
+    q, params = quantize(chunks, num_bits=num_bits, symmetric=True,
+                         num_groups=axis_size * groups_per_chunk,
+                         stochastic_rounding=rng is not None, rng=rng)
+    q = q.reshape(axis_size, -1)
+    scale = params.scale.reshape(axis_size, -1)
+    if use_pack:
+        q = pack_int4(q)
+    # exchange: device i sends chunk j to device j (reference intra/inter
+    # all-to-all, coalesced_collectives.py:31)
+    q = jax.lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=False)
+    scale = jax.lax.all_to_all(scale, axis, split_axis=0, concat_axis=0, tiled=False)
+    if use_pack:
+        q = unpack_int4(q, symmetric=True)
+    vals = q.astype(jnp.float32) * jnp.repeat(scale, q.shape[-1] // scale.shape[-1], axis=-1)
+    return vals.mean(axis=0)  # [n // axis_size]
+
+
+def all_to_all_quant_reduce(tensors: Sequence[jax.Array],
+                            mesh: Mesh,
+                            intra_axis: str = FSDP_AXIS,
+                            inter_axis: str = DATA_AXIS,
+                            group_size: int = 2048,
+                            rng: Optional[jax.Array] = None):
+    """qgZ quantized gradient reduction (reference ``:31`` +
+    ``csrc/quantization/quant_reduce.cu``).
+
+    Inputs: per-device partial gradients stacked on a leading world dim
+    sharded over ``(inter_axis, intra_axis)``. Output: the all-device mean,
+    scattered the same way (each device owns its 1/W slice). Hop 1 moves
+    int8 over the fast (intra/ICI-near) axis; hop 2 moves packed int4 over
+    the slow axis.
+    """
+    intra = mesh.shape[intra_axis]
+    inter = mesh.shape[inter_axis]
+    stochastic = rng is not None
+
+    def spmd(xs, key):
+        outs = []
+        for i, x in enumerate(xs):
+            v = x.reshape(-1).astype(jnp.float32)
+            k1 = k2 = None
+            if stochastic:
+                k1, k2 = jax.random.split(jax.random.fold_in(key, i))
+            if intra > 1:
+                gpc = divisor_groups(v.shape[-1] // intra, group_size)
+                v = _a2a_reduce_one(v, intra_axis, intra, 8, gpc, k1)
+            if inter > 1:
+                gpc2 = divisor_groups(v.shape[-1] // inter, group_size)
+                v = _a2a_reduce_one(v, inter_axis, inter, 4, gpc2, k2)
+            outs.append(v)
+        return tuple(outs)
+
+    in_specs = (tuple(P((inter_axis, intra_axis)) for _ in tensors), P())
+    # after hop 1 a device owns chunk[intra_idx] (width n/intra), after hop 2
+    # its sub-chunk[inter_idx]: final slice offset = intra_idx*(n/intra) +
+    # inter_idx*(n/intra/inter) → the scattered output is INTRA-major
+    out_specs = tuple(P((intra_axis, inter_axis)) for _ in tensors)
+    fn = jax.shard_map(spmd, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                       axis_names={intra_axis, inter_axis})
+    return fn(tuple(tensors), rng if stochastic else jax.random.PRNGKey(0))

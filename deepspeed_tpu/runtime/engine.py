@@ -268,7 +268,50 @@ class DeepSpeedEngine:
             return {}
         return {k: v for k, v in mb.items() if k not in ("input_ids", "labels") and k in sig.parameters}
 
+    def _quantize_gathered_weights(self, params):
+        """ZeRO++ ``zero_quantized_weights`` numerics: the fsdp-sharded
+        params are all-gathered through an int8 QDQ (reference quantized
+        weight all-gather, ``partition_parameters.py:628`` ``CUDAQuantizer``;
+        per-output-channel groups)."""
+        from deepspeed_tpu.ops.quantizer import fake_quantize
+
+        def qdq(p):
+            if not jnp.issubdtype(p.dtype, jnp.floating) or p.ndim < 2:
+                return p
+            # straight-through estimator: quantization error is outside the
+            # gradient path (the reference quantizes the all-gather payload
+            # outside autograd — identity gradient)
+            q = fake_quantize(p, num_bits=8, num_groups=p.shape[0])
+            return p + jax.lax.stop_gradient(q - p)
+
+        return jax.tree.map(qdq, params)
+
+    def _quantize_reduced_grads(self, grads, key):
+        """ZeRO++ ``zero_quantized_gradients`` (qgZ) numerics: gradients pass
+        through the two-hop quantized reduction's int8→int4 QDQ with
+        stochastic rounding (reference ``all_to_all_quant_reduce``,
+        ``runtime/comm/coalesced_collectives.py:31``). Communication itself
+        rides the sharding constraint; this applies the matching precision
+        loss so convergence behavior is faithful."""
+        from deepspeed_tpu.ops.quantizer import fake_quantize
+
+        from deepspeed_tpu.ops.quantizer.core import divisor_groups
+
+        def qdq(path_leaf):
+            i, g = path_leaf
+            if not jnp.issubdtype(g.dtype, jnp.floating):
+                return g
+            groups = divisor_groups(g.size, 2048)
+            k = jax.random.fold_in(key, i)
+            return fake_quantize(g, num_bits=4, num_groups=groups,
+                                 stochastic_rounding=True, rng=k)
+
+        leaves, treedef = jax.tree.flatten(grads)
+        return jax.tree.unflatten(treedef, [qdq((i, g)) for i, g in enumerate(leaves)])
+
     def _loss_for(self, params, mb, key, scale, train: bool = True):
+        if self.config.zero_config.zero_quantized_weights:
+            params = self._quantize_gathered_weights(params)
         cparams = _cast_floating(params, self.compute_dtype)
         ids = mb["input_ids"] if isinstance(mb, dict) else mb
         extra = self._module_kwargs(mb)
@@ -316,6 +359,8 @@ class DeepSpeedEngine:
             # average over microbatches and unscale (reference engine.py:1868
             # scales loss by 1/GAS; fp16 unscaling in optimizer step)
             grads = jax.tree.map(lambda g: g / (gas * scale), grads)
+            if self.config.zero_config.zero_quantized_gradients:
+                grads = self._quantize_reduced_grads(grads, jax.random.fold_in(rng, 1))
             # ZeRO stage>=2: keep only the local shard after reduction
             grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
 
