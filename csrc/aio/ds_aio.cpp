@@ -1,0 +1,163 @@
+// Async host file I/O engine for NVMe offload (ZeRO-Infinity spill).
+// TPU-native counterpart of reference csrc/aio/ (deepspeed_py_aio_handle.cpp,
+// deepspeed_aio_common.cpp): a thread-pool handle with submit/wait semantics.
+// The reference drives libaio O_DIRECT; this engine uses a worker pool of
+// pread/pwrite (the reference's own fallback scheme) — same interface
+// contract: async submit, bounded queue, explicit wait.
+//
+// C ABI for ctypes.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct AioHandle {
+    std::vector<std::thread> workers;
+    std::queue<std::function<void()>> tasks;
+    std::mutex mu;
+    std::condition_variable cv;
+    std::condition_variable done_cv;
+    std::atomic<int64_t> inflight{0};
+    std::atomic<int64_t> errors{0};
+    bool stop = false;
+
+    explicit AioHandle(int n_threads) {
+        for (int i = 0; i < n_threads; ++i) {
+            workers.emplace_back([this] {
+                for (;;) {
+                    std::function<void()> task;
+                    {
+                        std::unique_lock<std::mutex> lk(mu);
+                        cv.wait(lk, [this] { return stop || !tasks.empty(); });
+                        if (stop && tasks.empty()) return;
+                        task = std::move(tasks.front());
+                        tasks.pop();
+                    }
+                    task();
+                    if (--inflight == 0) {
+                        std::lock_guard<std::mutex> lk(mu);
+                        done_cv.notify_all();
+                    }
+                }
+            });
+        }
+    }
+
+    ~AioHandle() {
+        {
+            std::lock_guard<std::mutex> lk(mu);
+            stop = true;
+        }
+        cv.notify_all();
+        for (auto& w : workers) w.join();
+    }
+
+    void submit(std::function<void()> fn) {
+        ++inflight;
+        {
+            std::lock_guard<std::mutex> lk(mu);
+            tasks.push(std::move(fn));
+        }
+        cv.notify_one();
+    }
+
+    int wait() {
+        std::unique_lock<std::mutex> lk(mu);
+        done_cv.wait(lk, [this] { return inflight.load() == 0; });
+        return (int)errors.exchange(0);
+    }
+};
+
+bool write_all(const char* path, const void* buf, int64_t nbytes) {
+    int fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    if (fd < 0) return false;
+    const char* src = (const char*)buf;
+    int64_t left = nbytes;
+    off_t off = 0;
+    while (left > 0) {
+        ssize_t w = ::pwrite(fd, src + off, (size_t)left, off);
+        if (w <= 0) {
+            ::close(fd);
+            return false;
+        }
+        left -= w;
+        off += w;
+    }
+    ::close(fd);
+    return true;
+}
+
+bool read_all(const char* path, void* buf, int64_t nbytes) {
+    int fd = ::open(path, O_RDONLY);
+    if (fd < 0) return false;
+    char* dst = (char*)buf;
+    int64_t left = nbytes;
+    off_t off = 0;
+    while (left > 0) {
+        ssize_t r = ::pread(fd, dst + off, (size_t)left, off);
+        if (r <= 0) {
+            ::close(fd);
+            return false;
+        }
+        left -= r;
+        off += r;
+    }
+    ::close(fd);
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* aio_handle_create(int n_threads) {
+    if (n_threads < 1) n_threads = 1;
+    return new AioHandle(n_threads);
+}
+
+void aio_handle_destroy(void* h) { delete (AioHandle*)h; }
+
+// async write of nbytes from buf to path (buf must stay alive until wait)
+void aio_pwrite_async(void* h, const char* path, const void* buf, int64_t nbytes) {
+    auto* handle = (AioHandle*)h;
+    std::string p(path);
+    handle->submit([handle, p, buf, nbytes] {
+        if (!write_all(p.c_str(), buf, nbytes)) ++handle->errors;
+    });
+}
+
+// async read of nbytes from path into buf (buf must stay alive until wait)
+void aio_pread_async(void* h, const char* path, void* buf, int64_t nbytes) {
+    auto* handle = (AioHandle*)h;
+    std::string p(path);
+    handle->submit([handle, p, buf, nbytes] {
+        if (!read_all(p.c_str(), buf, nbytes)) ++handle->errors;
+    });
+}
+
+// block until every submitted op completes; returns the number of failed ops
+// since the last wait
+int aio_wait(void* h) { return ((AioHandle*)h)->wait(); }
+
+// synchronous helpers (reference deepspeed_py_aio.cpp sync paths)
+int aio_write_sync(const char* path, const void* buf, int64_t nbytes) {
+    return write_all(path, buf, nbytes) ? 0 : -1;
+}
+
+int aio_read_sync(const char* path, void* buf, int64_t nbytes) {
+    return read_all(path, buf, nbytes) ? 0 : -1;
+}
+
+}  // extern "C"

@@ -1,0 +1,110 @@
+"""DeepSpeedCPUAdam — host-resident Adam over offloaded optimizer states
+(reference ``deepspeed/ops/adam/cpu_adam.py:181`` ``DeepSpeedCPUAdam``).
+
+The device computes gradients; fp32 master params + moments live in host
+RAM as numpy arrays, updated by the AVX C++ kernel (``csrc/adam/
+cpu_adam.cpp``). ``step`` mutates the host state in place and returns the
+updated masters (optionally also a bf16 copy for the device).
+"""
+
+import ctypes
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.ops.op_builder import CPUAdamBuilder
+
+
+def _f32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class DeepSpeedCPUAdam:
+
+    def __init__(self,
+                 lr: float = 1e-3,
+                 betas: Tuple[float, float] = (0.9, 0.999),
+                 eps: float = 1e-8,
+                 weight_decay: float = 0.0,
+                 bias_correction: bool = True,
+                 adamw_mode: bool = True,
+                 fp32_optimizer_states: bool = True):
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.bias_correction = bias_correction
+        self.adamw_mode = adamw_mode
+        self.lib = CPUAdamBuilder().load()
+        self.state: Dict[int, Dict[str, np.ndarray]] = {}
+        self.step_count = 0
+
+    def _ensure_state(self, idx: int, n: int):
+        if idx not in self.state:
+            self.state[idx] = {"m": np.zeros(n, np.float32), "v": np.zeros(n, np.float32)}
+        return self.state[idx]
+
+    def step(self, params: List[np.ndarray], grads: List[np.ndarray],
+             bf16_out: Optional[List[np.ndarray]] = None, lr: Optional[float] = None):
+        """In-place fused update of every (param, grad) pair.
+
+        ``params`` must be C-contiguous fp32 numpy arrays (the host masters).
+        ``bf16_out``: optional preallocated uint16 arrays receiving the
+        bf16-rounded updated params (device copy, zero extra passes).
+        """
+        self.step_count += 1
+        use_lr = self.lr if lr is None else lr
+        for i, (p, g) in enumerate(zip(params, grads)):
+            assert p.dtype == np.float32 and p.flags.c_contiguous, "host master must be fp32 contiguous"
+            g32 = np.ascontiguousarray(g.reshape(-1), np.float32)
+            flat = p.reshape(-1)
+            st = self._ensure_state(i, flat.size)
+            if bf16_out is not None:
+                out = bf16_out[i].reshape(-1)
+                self.lib.ds_adam_update_copy_bf16(
+                    _f32p(flat), _f32p(g32), _f32p(st["m"]), _f32p(st["v"]),
+                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+                    flat.size, self.step_count, use_lr, self.betas[0], self.betas[1], self.eps,
+                    self.weight_decay, int(self.adamw_mode), int(self.bias_correction))
+            else:
+                self.lib.ds_adam_update(
+                    _f32p(flat), _f32p(g32), _f32p(st["m"]), _f32p(st["v"]),
+                    flat.size, self.step_count, use_lr, self.betas[0], self.betas[1], self.eps,
+                    self.weight_decay, int(self.adamw_mode), int(self.bias_correction))
+        return params
+
+    # -- checkpoint surface -------------------------------------------------
+    def state_dict(self):
+        return {"step": self.step_count,
+                "state": {str(k): {"m": v["m"], "v": v["v"]} for k, v in self.state.items()}}
+
+    def load_state_dict(self, sd):
+        self.step_count = int(sd["step"])
+        self.state = {int(k): {"m": np.asarray(v["m"]), "v": np.asarray(v["v"])}
+                      for k, v in sd["state"].items()}
+
+    def reset_state(self):
+        self.step_count = 0
+        self.state = {}
+
+
+class DeepSpeedCPUAdagrad:
+    """Reference ``deepspeed/ops/adagrad/cpu_adagrad.py``."""
+
+    def __init__(self, lr=1e-2, eps=1e-10, weight_decay=0.0):
+        self.lr = lr
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.lib = CPUAdamBuilder().load()
+        self.state: Dict[int, np.ndarray] = {}
+
+    def step(self, params: List[np.ndarray], grads: List[np.ndarray], lr: Optional[float] = None):
+        use_lr = self.lr if lr is None else lr
+        for i, (p, g) in enumerate(zip(params, grads)):
+            flat = p.reshape(-1)
+            if i not in self.state:
+                self.state[i] = np.zeros(flat.size, np.float32)
+            g32 = np.ascontiguousarray(g.reshape(-1), np.float32)
+            self.lib.ds_adagrad_update(_f32p(flat), _f32p(g32), _f32p(self.state[i]), flat.size,
+                                       use_lr, self.eps, self.weight_decay)
+        return params

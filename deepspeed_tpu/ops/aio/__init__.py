@@ -1,0 +1,5 @@
+"""Async I/O handle (reference ``deepspeed/ops/aio`` / ``csrc/aio``)."""
+
+from deepspeed_tpu.ops.aio.aio_handle import AsyncIOHandle
+
+__all__ = ["AsyncIOHandle"]

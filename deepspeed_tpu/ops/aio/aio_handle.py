@@ -1,0 +1,57 @@
+"""Python wrapper over the C++ aio engine
+(reference ``aio_handle`` class, ``csrc/aio/py_lib/py_ds_aio.cpp:14-20``:
+``aio_read``/``aio_write``/submit+wait semantics)."""
+
+from typing import Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.op_builder import AsyncIOBuilder
+
+
+class AsyncIOHandle:
+    """Thread-pool async file reads/writes of numpy buffers.
+
+    Buffers must stay alive (and unmodified for writes) until ``wait()``
+    returns — same contract as the reference's pinned bounce buffers.
+    """
+
+    def __init__(self, n_threads: int = 4):
+        self.lib = AsyncIOBuilder().load()
+        self._h = self.lib.aio_handle_create(int(n_threads))
+        self._pending = []  # keep buffer refs alive until wait()
+
+    def pwrite(self, buf: np.ndarray, path: str):
+        buf = np.ascontiguousarray(buf)
+        self._pending.append(buf)
+        self.lib.aio_pwrite_async(self._h, str(path).encode(), buf.ctypes.data, buf.nbytes)
+
+    def pread(self, buf: np.ndarray, path: str):
+        assert buf.flags.c_contiguous and buf.flags.writeable
+        self._pending.append(buf)
+        self.lib.aio_pread_async(self._h, str(path).encode(), buf.ctypes.data, buf.nbytes)
+
+    def wait(self) -> int:
+        """Block until all submitted ops complete; returns failure count."""
+        errors = self.lib.aio_wait(self._h)
+        self._pending.clear()
+        return errors
+
+    def sync_pwrite(self, buf: np.ndarray, path: str) -> int:
+        buf = np.ascontiguousarray(buf)
+        return self.lib.aio_write_sync(str(path).encode(), buf.ctypes.data, buf.nbytes)
+
+    def sync_pread(self, buf: np.ndarray, path: str) -> int:
+        return self.lib.aio_read_sync(str(path).encode(), buf.ctypes.data, buf.nbytes)
+
+    def close(self):
+        if self._h is not None:
+            self.wait()
+            self.lib.aio_handle_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,5 @@
+"""Native op builders (reference ``op_builder/``)."""
+
+from deepspeed_tpu.ops.op_builder.builder import AsyncIOBuilder, CPUAdamBuilder, OpBuilder
+
+__all__ = ["OpBuilder", "CPUAdamBuilder", "AsyncIOBuilder"]

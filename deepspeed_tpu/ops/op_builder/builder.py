@@ -1,0 +1,132 @@
+"""Native-op build system (reference ``op_builder/builder.py:102``
+``OpBuilder`` / JIT load ``:443-456``).
+
+Compiles the C++ sources under ``csrc/`` into shared libraries on first use
+(g++, cached by source hash under ``~/.cache/deepspeed_tpu``) and loads them
+via ctypes — the image ships no pybind11, and a C ABI keeps the boundary
+simple. ``is_compatible()`` probes the toolchain like the reference's
+builder compatibility checks.
+"""
+
+import ctypes
+import hashlib
+import os
+import shutil
+import subprocess
+from pathlib import Path
+from typing import List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+REPO_ROOT = Path(__file__).resolve().parents[3]
+CACHE_DIR = Path(os.environ.get("DS_BUILD_CACHE", Path.home() / ".cache" / "deepspeed_tpu"))
+
+
+class OpBuilder:
+    NAME = "base"
+
+    def sources(self) -> List[str]:
+        raise NotImplementedError
+
+    def cxx_args(self) -> List[str]:
+        args = ["-O3", "-std=c++17", "-fPIC", "-shared", "-pthread"]
+        if self._supports_march_native():
+            args.append("-march=native")
+        return args
+
+    def _supports_march_native(self) -> bool:
+        return True
+
+    def compiler(self) -> str:
+        return os.environ.get("CXX", "g++")
+
+    def is_compatible(self) -> bool:
+        return shutil.which(self.compiler()) is not None
+
+    def absolute_sources(self) -> List[Path]:
+        return [REPO_ROOT / s for s in self.sources()]
+
+    def _hash(self) -> str:
+        h = hashlib.sha256()
+        for src in self.absolute_sources():
+            h.update(src.read_bytes())
+        h.update(" ".join(self.cxx_args()).encode())
+        return h.hexdigest()[:16]
+
+    def lib_path(self) -> Path:
+        return CACHE_DIR / f"{self.NAME}_{self._hash()}.so"
+
+    def build(self) -> Path:
+        out = self.lib_path()
+        if out.exists():
+            return out
+        CACHE_DIR.mkdir(parents=True, exist_ok=True)
+        srcs = [str(s) for s in self.absolute_sources()]
+        cmd = [self.compiler()] + self.cxx_args() + srcs + ["-o", str(out)]
+        logger.info(f"building native op {self.NAME}: {' '.join(cmd)}")
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(f"native build of {self.NAME} failed:\n{e.stderr}") from e
+        return out
+
+    _lib: Optional[ctypes.CDLL] = None
+
+    def load(self) -> ctypes.CDLL:
+        """JIT build + dlopen (reference ``OpBuilder.load``/``jit_load``)."""
+        if type(self)._lib is None:
+            if not self.is_compatible():
+                raise RuntimeError(f"op {self.NAME} is not compatible: no C++ compiler found")
+            type(self)._lib = ctypes.CDLL(str(self.build()))
+            self._declare(type(self)._lib)
+        return type(self)._lib
+
+    def _declare(self, lib: ctypes.CDLL):
+        """Subclasses declare argtypes/restypes here."""
+
+
+class CPUAdamBuilder(OpBuilder):
+    """Reference ``op_builder/cpu_adam.py``."""
+
+    NAME = "cpu_adam"
+
+    def sources(self):
+        return ["csrc/adam/cpu_adam.cpp"]
+
+    def cxx_args(self):
+        return super().cxx_args() + ["-mavx2", "-mfma"]
+
+    def _declare(self, lib):
+        f32p = ctypes.POINTER(ctypes.c_float)
+        u16p = ctypes.POINTER(ctypes.c_uint16)
+        lib.ds_adam_update.argtypes = [f32p, f32p, f32p, f32p, ctypes.c_int64, ctypes.c_int32,
+                                       ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+                                       ctypes.c_float, ctypes.c_int32, ctypes.c_int32]
+        lib.ds_adam_update_copy_bf16.argtypes = lib.ds_adam_update.argtypes[:4] + [u16p] + \
+            lib.ds_adam_update.argtypes[4:]
+        lib.ds_adagrad_update.argtypes = [f32p, f32p, f32p, ctypes.c_int64, ctypes.c_float,
+                                          ctypes.c_float, ctypes.c_float]
+
+
+class AsyncIOBuilder(OpBuilder):
+    """Reference ``op_builder/async_io.py``."""
+
+    NAME = "ds_aio"
+
+    def sources(self):
+        return ["csrc/aio/ds_aio.cpp"]
+
+    def _declare(self, lib):
+        lib.aio_handle_create.argtypes = [ctypes.c_int]
+        lib.aio_handle_create.restype = ctypes.c_void_p
+        lib.aio_handle_destroy.argtypes = [ctypes.c_void_p]
+        lib.aio_pwrite_async.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+                                         ctypes.c_int64]
+        lib.aio_pread_async.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+                                        ctypes.c_int64]
+        lib.aio_wait.argtypes = [ctypes.c_void_p]
+        lib.aio_wait.restype = ctypes.c_int
+        lib.aio_write_sync.argtypes = [ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64]
+        lib.aio_write_sync.restype = ctypes.c_int
+        lib.aio_read_sync.argtypes = [ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64]
+        lib.aio_read_sync.restype = ctypes.c_int

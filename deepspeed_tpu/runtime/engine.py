@@ -231,9 +231,18 @@ class DeepSpeedEngine:
         else:
             params = jax.jit(init_params, out_shardings=param_shardings)(rng)
 
-        opt_shapes = jax.eval_shape(self.optimizer.init, params)
-        opt_shardings = self.plan.optstate_shardings(opt_shapes)
-        opt_state = jax.jit(self.optimizer.init, out_shardings=opt_shardings)(params)
+        off = self.config.zero_config.offload_optimizer
+        self._offload_enabled = off is not None and getattr(off, "device", "none") not in (None, "none")
+        if self._offload_enabled:
+            # moments live off-device (host RAM / NVMe): no optax state
+            if self.fp16_enabled:
+                raise NotImplementedError("offload_optimizer with fp16 loss scaling is not "
+                                          "supported; use bf16 or fp32")
+            opt_state, opt_shardings = {}, {}
+        else:
+            opt_shapes = jax.eval_shape(self.optimizer.init, params)
+            opt_shardings = self.plan.optstate_shardings(opt_shapes)
+            opt_state = jax.jit(self.optimizer.init, out_shardings=opt_shardings)(params)
 
         repl = NamedSharding(self.mesh, P())
         ls_state = jax.device_put(self._ls_state0, repl)
@@ -241,11 +250,115 @@ class DeepSpeedEngine:
                                 params=params,
                                 opt_state=opt_state,
                                 loss_scale=ls_state)
+        self._setup_offload_optimizer()
         self.state_shardings = TrainState(step=repl,
                                           params=param_shardings,
                                           opt_state=opt_shardings,
                                           loss_scale=jax.tree.map(lambda _: repl, self._ls_state0))
         self._build_step_fns()
+
+    # ------------------------------------------------------------------
+    # ZeRO-Offload / ZeRO-Infinity: optimizer states off-device
+    # (reference stage_1_and_2 cpu_offload / stage3 + swap_tensor; SURVEY §7.3)
+    # ------------------------------------------------------------------
+    def _accumulate_grads(self, params, batch, rng, scale, grad_shardings, gas, clip, fp16):
+        """The shared fwd+bwd core: GAS microbatch scan, 1/gas averaging,
+        (optional) qgZ QDQ, ZeRO reduction constraint, clipping, overflow.
+        Used by the fused on-device step AND the offload grads-only step so
+        the two paths cannot drift."""
+        keys = jax.random.split(rng, gas)
+
+        def micro(acc, xs):
+            mb, key = xs
+            (_, loss), grads = jax.value_and_grad(self._loss_for, has_aux=True)(params, mb, key, scale)
+            grads = _cast_floating(grads, jnp.float32)
+            return jax.tree.map(jnp.add, acc, grads), loss
+
+        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        grads, losses = jax.lax.scan(micro, zeros, (batch, keys))
+        # average over microbatches and unscale (reference engine.py:1868
+        # scales loss by 1/GAS; fp16 unscaling in optimizer step)
+        grads = jax.tree.map(lambda g: g / (gas * scale), grads)
+        if self.config.zero_config.zero_quantized_gradients:
+            grads = self._quantize_reduced_grads(grads, jax.random.fold_in(rng, 1))
+        # ZeRO stage>=2: keep only the local shard after reduction
+        grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
+        gnorm = _global_norm(grads)
+        overflow = has_overflow(grads) if fp16 else ~jnp.isfinite(gnorm)
+        if clip > 0:
+            factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+            grads = jax.tree.map(lambda g: g * factor, grads)
+        return losses.mean(), grads, gnorm, overflow
+
+    def _build_offload_step_fns(self, grad_shardings):
+        """Device side of the offload path: fwd+bwd+clip only; the update
+        happens on host."""
+        gas = self.config.gradient_accumulation_steps
+        clip = self.config.gradient_clipping
+        mesh = self.mesh
+
+        def grads_only(params, batch, rng):
+            return self._accumulate_grads(params, batch, rng, jnp.float32(1.0), grad_shardings,
+                                          gas, clip, fp16=False)
+
+        self._grads_only_fn = jax.jit(
+            grads_only,
+            in_shardings=(self.state_shardings.params, None, NamedSharding(mesh, P())),
+            out_shardings=(NamedSharding(mesh, P()), grad_shardings, NamedSharding(mesh, P()),
+                           NamedSharding(mesh, P())))
+
+    def _setup_offload_optimizer(self):
+        off = self.config.zero_config.offload_optimizer
+        self._host_opt = None
+        if off is None or getattr(off, "device", "none") in (None, "none"):
+            return
+        device = off.device if isinstance(off.device, str) else str(off.device)
+        params = dict(self.config.optimizer_params or {})
+        lr = params.get("lr", 1e-3)
+        betas = tuple(params.get("betas", (0.9, 0.999)))
+        eps = params.get("eps", 1e-8)
+        wd = params.get("weight_decay", 0.0)
+        adamw = (self.config.optimizer_name or C.ADAM_OPTIMIZER) == C.ADAMW_OPTIMIZER
+        if device == "cpu":
+            from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+            self._host_opt = DeepSpeedCPUAdam(lr=lr, betas=betas, eps=eps, weight_decay=wd,
+                                              adamw_mode=adamw)
+        elif device == "nvme":
+            from deepspeed_tpu.runtime.swap_tensor.optimizer_swapper import NVMeAdam
+            nvme_path = getattr(off, "nvme_path", None) or "/tmp/ds_tpu_nvme"
+            self._host_opt = NVMeAdam(swap_dir=os.path.join(str(nvme_path), "optimizer"),
+                                      lr=lr, betas=betas, eps=eps, weight_decay=wd, adamw_mode=adamw)
+        else:
+            raise ValueError(f"unknown offload_optimizer.device {device!r}")
+        # fp32 host masters (reference: fp32 flat master partitions in host RAM)
+        self._host_masters = [np.ascontiguousarray(np.asarray(jax.device_get(p), np.float32))
+                              for p in jax.tree.leaves(self.state.params)]
+        log_dist(f"optimizer offload enabled: device={device} "
+                 f"({sum(m.size for m in self._host_masters) / 1e6:.1f}M host master elems)")
+
+    def _offload_train_batch(self, device_batch, rng):
+        """fwd+bwd on device (jitted), optimizer update on host via the C++
+        kernel (reference async_accumulate_grad_in_cpu_via_gpu +
+        cpu_adam path, stage_1_and_2.py:1086)."""
+        loss, grads, gnorm, overflow = self._grads_only_fn(self.state.params, device_batch, rng)
+        if bool(overflow):
+            new_ls = self._ls_update(self.state.loss_scale, jnp.asarray(True))
+            self.state = self.state._replace(loss_scale=new_ls, step=self.state.step + 1)
+            return loss, {"loss": loss, "grad_norm": gnorm, "overflow": jnp.asarray(True),
+                          "loss_scale": new_ls.loss_scale}
+        grad_leaves = [np.asarray(jax.device_get(g), np.float32) for g in jax.tree.leaves(grads)]
+        self._host_opt.step(self._host_masters, grad_leaves, lr=self.get_lr()[0])
+        # push updated masters back into the sharded device params
+        leaves, treedef = jax.tree.flatten(self.state.params)
+        shard_leaves = jax.tree.leaves(self.state_shardings.params)
+        new_leaves = [jax.device_put(m.reshape(old.shape).astype(old.dtype), s)
+                      for m, old, s in zip(self._host_masters, leaves, shard_leaves)]
+        new_params = jax.tree.unflatten(treedef, new_leaves)
+        new_ls = self._ls_update(self.state.loss_scale, jnp.asarray(False))
+        self.state = TrainState(step=self.state.step + 1, params=new_params,
+                                opt_state=self.state.opt_state, loss_scale=new_ls)
+        return loss, {"loss": loss, "grad_norm": gnorm, "overflow": jnp.asarray(False),
+                      "loss_scale": new_ls.loss_scale}
 
     def _example_ids(self, batch):
         ids = batch["input_ids"] if isinstance(batch, dict) else batch
@@ -339,6 +452,9 @@ class DeepSpeedEngine:
         grad_shardings = self.plan.grad_shardings()
         mesh = self.mesh
 
+        if getattr(self, "_offload_enabled", False):
+            self._build_offload_step_fns(grad_shardings)
+
         def grads_of_micro(params, mb, key, scale):
             (scaled_loss, loss), grads = jax.value_and_grad(self._loss_for, has_aux=True)(params, mb, key, scale)
             grads = _cast_floating(grads, jnp.float32)
@@ -346,29 +462,8 @@ class DeepSpeedEngine:
 
         def train_step(state: TrainState, batch, rng):
             scale = state.loss_scale.loss_scale if fp16 else jnp.float32(1.0)
-            keys = jax.random.split(rng, gas)
-
-            def micro(acc, xs):
-                mb, key = xs
-                loss, grads = grads_of_micro(state.params, mb, key, scale)
-                acc = jax.tree.map(jnp.add, acc, grads)
-                return acc, loss
-
-            zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
-            grads, losses = jax.lax.scan(micro, zeros, (batch, keys))
-            # average over microbatches and unscale (reference engine.py:1868
-            # scales loss by 1/GAS; fp16 unscaling in optimizer step)
-            grads = jax.tree.map(lambda g: g / (gas * scale), grads)
-            if self.config.zero_config.zero_quantized_gradients:
-                grads = self._quantize_reduced_grads(grads, jax.random.fold_in(rng, 1))
-            # ZeRO stage>=2: keep only the local shard after reduction
-            grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
-
-            overflow = has_overflow(grads) if fp16 else jnp.zeros([], bool)
-            gnorm = _global_norm(grads)
-            if clip > 0:
-                factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
-                grads = jax.tree.map(lambda g: g * factor, grads)
+            losses, grads, gnorm, overflow = self._accumulate_grads(
+                state.params, batch, rng, scale, grad_shardings, gas, clip, fp16)
 
             updates, new_opt = self.optimizer.update(grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
@@ -381,7 +476,7 @@ class DeepSpeedEngine:
             new_state = TrainState(step=state.step + 1, params=new_params, opt_state=new_opt,
                                    loss_scale=new_ls)
             metrics = {
-                "loss": losses.mean(),
+                "loss": losses,
                 "grad_norm": gnorm,
                 "overflow": overflow,
                 "loss_scale": new_ls.loss_scale,
@@ -506,7 +601,10 @@ class DeepSpeedEngine:
         self.timers(TRAIN_BATCH_TIMER).start()
         device_batch = self._shard_batch(batch, with_gas_dim=True)
         rng = jax.random.fold_in(self._base_rng, self.global_steps)
-        self.state, metrics = self._train_step_fn(self.state, device_batch, rng)
+        if getattr(self, "_host_opt", None) is not None:
+            _, metrics = self._offload_train_batch(device_batch, rng)
+        else:
+            self.state, metrics = self._train_step_fn(self.state, device_batch, rng)
         self.global_steps += 1
         self.global_samples += self.config.train_batch_size
         self.micro_steps += self.config.gradient_accumulation_steps
@@ -525,6 +623,9 @@ class DeepSpeedEngine:
         """Compute the (scaled-down-by-GAS) loss for one microbatch and
         stash it for ``backward``. Returns the loss array."""
         self.initialize_state(batch)
+        if getattr(self, "_host_opt", None) is not None:
+            raise NotImplementedError("offload_optimizer requires the fused train_batch() path; "
+                                      "the forward/backward/step shims keep state on device")
         self._pending_batch = self._shard_batch(batch, with_gas_dim=False)
         key = jax.random.fold_in(self._base_rng, self.micro_steps)
         scale = self.state.loss_scale.loss_scale if self.fp16_enabled else jnp.float32(1.0)
@@ -622,6 +723,11 @@ class DeepSpeedEngine:
             "client_state": client_state or {},
         }
         engine.save(self.state, tag, metadata=meta)
+        if getattr(self, "_host_opt", None) is not None and dist.get_rank() == 0:
+            # offloaded optimizer state (host masters + moments bookkeeping)
+            np.save(os.path.join(save_dir, tag, "host_optimizer.npy"),
+                    {"opt": self._host_opt.state_dict(),
+                     "masters": self._host_masters}, allow_pickle=True)
         if save_latest and dist.get_rank() == 0:
             with open(os.path.join(save_dir, "latest"), "w") as f:
                 f.write(tag)
@@ -645,6 +751,21 @@ class DeepSpeedEngine:
                                      load_optimizer_states=load_optimizer_states,
                                      load_module_only=load_module_only)
         self.state = restored
+        if getattr(self, "_host_opt", None) is not None:
+            host_path = os.path.join(load_dir, tag, "host_optimizer.npy")
+            if os.path.exists(host_path):
+                blob = np.load(host_path, allow_pickle=True).item()
+                self._host_opt.load_state_dict(blob["opt"])
+                self._host_masters = [np.ascontiguousarray(m, np.float32) for m in blob["masters"]]
+            else:
+                # checkpoint has no host-optimizer state (saved without
+                # offload): rebuild masters from the restored params so the
+                # next step doesn't clobber them with init-time values
+                logger.warning(f"no host_optimizer state in {load_dir}/{tag}; rebuilding fp32 "
+                               f"masters from restored params, optimizer moments reset")
+                self._host_masters = [np.ascontiguousarray(np.asarray(jax.device_get(p), np.float32))
+                                      for p in jax.tree.leaves(self.state.params)]
+                self._host_opt.reset_state()
         self.global_steps = meta.get("global_steps", 0)
         self.global_samples = meta.get("global_samples", 0)
         self.micro_steps = meta.get("micro_steps", 0)

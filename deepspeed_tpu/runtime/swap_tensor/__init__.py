@@ -1,0 +1,3 @@
+from deepspeed_tpu.runtime.swap_tensor.optimizer_swapper import NVMeAdam, PipelinedOptimizerSwapper
+
+__all__ = ["NVMeAdam", "PipelinedOptimizerSwapper"]

@@ -1,0 +1,168 @@
+"""NVMe optimizer-state swapping (ZeRO-Infinity)
+(reference ``runtime/swap_tensor/``: ``OptimizerSwapper``/partitioned
+``partitioned_optimizer_swapper.py:218``, pipelined overlap
+``pipelined_optimizer_swapper.py``, double-buffer ``async_swapper.py:174``).
+
+Moments live on NVMe as one file pair per parameter; during ``step`` the
+swapper streams them through host RAM with double buffering: while leaf
+``i`` is being updated by the C++ Adam kernel, leaf ``i+1``'s states are
+already being read by the aio thread pool, and leaf ``i-1``'s updated
+states are being written back — the reference's pipelined
+swap-in/compute/swap-out overlap (``pipelined_optimizer_swapper.py``).
+"""
+
+import os
+from pathlib import Path
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.aio import AsyncIOHandle
+from deepspeed_tpu.utils.logging import logger
+
+
+class PipelinedOptimizerSwapper:
+
+    def __init__(self, swap_dir: str, n_threads: int = 4):
+        self.swap_dir = Path(swap_dir)
+        self.swap_dir.mkdir(parents=True, exist_ok=True)
+        self.read_handle = AsyncIOHandle(n_threads)
+        self.write_handle = AsyncIOHandle(n_threads)
+        self._sizes: Dict[int, int] = {}
+
+    def _paths(self, idx: int):
+        return (self.swap_dir / f"exp_avg_{idx}.bin", self.swap_dir / f"exp_avg_sq_{idx}.bin")
+
+    def initialize(self, sizes: List[int], reuse_existing: bool = False):
+        """Create zeroed state files (reference swapper init writes the
+        initial optimizer state to NVMe).
+
+        ``reuse_existing=True`` keeps files already on disk — ONLY for an
+        explicit checkpoint resume; a fresh run must not inherit another
+        run's moments from a shared swap dir."""
+        for i, n in enumerate(sizes):
+            self._sizes[i] = n
+            mp, vp = self._paths(i)
+            stale = mp.exists() and mp.stat().st_size != n * 4
+            if not reuse_existing or not mp.exists() or stale:
+                zeros = np.zeros(n, np.float32)
+                self.write_handle.pwrite(zeros, mp)
+                self.write_handle.pwrite(zeros, vp)
+        errs = self.write_handle.wait()
+        assert errs == 0, f"{errs} swap-file writes failed in {self.swap_dir}"
+
+    def swap_in_async(self, idx: int, m_buf: np.ndarray, v_buf: np.ndarray):
+        mp, vp = self._paths(idx)
+        self.read_handle.pread(m_buf, mp)
+        self.read_handle.pread(v_buf, vp)
+
+    def wait_swap_in(self) -> None:
+        errs = self.read_handle.wait()
+        assert errs == 0, "optimizer state swap-in failed"
+
+    def swap_out_async(self, idx: int, m: np.ndarray, v: np.ndarray):
+        mp, vp = self._paths(idx)
+        self.write_handle.pwrite(m.copy(), mp)
+        self.write_handle.pwrite(v.copy(), vp)
+
+    def wait_swap_out(self) -> None:
+        errs = self.write_handle.wait()
+        assert errs == 0, "optimizer state swap-out failed"
+
+    def close(self):
+        self.read_handle.close()
+        self.write_handle.close()
+
+
+class NVMeAdam:
+    """Adam whose moments live on NVMe (ZeRO-Infinity optimizer path):
+    C++ AVX update + pipelined aio swapping, double-buffered."""
+
+    def __init__(self, swap_dir: str, lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+                 adamw_mode=True, n_threads: int = 4):
+        from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+
+        self.inner = DeepSpeedCPUAdam(lr=lr, betas=betas, eps=eps, weight_decay=weight_decay,
+                                      adamw_mode=adamw_mode)
+        self.swapper = PipelinedOptimizerSwapper(swap_dir, n_threads)
+        self._initialized = False
+        self._resumed = False
+        # two host bounce-buffer pairs (reference AsyncTensorSwapper double
+        # buffering, async_swapper.py:174)
+        self._bufs: List[Optional[np.ndarray]] = [None, None, None, None]
+
+    def _ensure_buffers(self, max_size: int):
+        if self._bufs[0] is None or self._bufs[0].size < max_size:
+            self._bufs = [np.empty(max_size, np.float32) for _ in range(4)]
+
+    def step(self, params: List[np.ndarray], grads: List[np.ndarray], lr: Optional[float] = None):
+        n_leaves = len(params)
+        sizes = [p.size for p in params]
+        if not self._initialized:
+            self.swapper.initialize(sizes, reuse_existing=self._resumed)
+            self._initialized = True
+        self._ensure_buffers(max(sizes))
+        self.inner.step_count += 1
+        use_lr = self.inner.lr if lr is None else lr
+
+        # prefetch leaf 0 into buffer set A
+        a_m, a_v, b_m, b_v = self._bufs
+        self.swapper.swap_in_async(0, a_m[:sizes[0]].reshape(-1), a_v[:sizes[0]])
+        for i in range(n_leaves):
+            self.swapper.wait_swap_in()
+            cur_m, cur_v = a_m[:sizes[i]], a_v[:sizes[i]]
+            if i + 1 < n_leaves:  # overlap: prefetch next while updating
+                self.swapper.swap_in_async(i + 1, b_m[:sizes[i + 1]], b_v[:sizes[i + 1]])
+            flat = params[i].reshape(-1)
+            g32 = np.ascontiguousarray(grads[i].reshape(-1), np.float32)
+            import ctypes
+            f32p = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+            self.inner.lib.ds_adam_update(
+                f32p(flat), f32p(g32), f32p(cur_m), f32p(cur_v), flat.size,
+                self.inner.step_count, use_lr, self.inner.betas[0], self.inner.betas[1],
+                self.inner.eps, self.inner.weight_decay, int(self.inner.adamw_mode), 1)
+            self.swapper.wait_swap_out()  # previous writeback must finish
+            self.swapper.swap_out_async(i, cur_m, cur_v)
+            a_m, b_m = b_m, a_m
+            a_v, b_v = b_v, a_v
+        self.swapper.wait_swap_out()
+        return params
+
+    @property
+    def step_count(self):
+        return self.inner.step_count
+
+    def state_dict(self):
+        """Portable checkpoint: moments are read back off NVMe into the dict
+        so a resume works on a different machine/swap dir."""
+        state = {}
+        h = self.swapper.read_handle
+        for i, n in self.swapper._sizes.items():
+            m = np.empty(n, np.float32)
+            v = np.empty(n, np.float32)
+            mp, vp = self.swapper._paths(i)
+            assert h.sync_pread(m, mp) == 0 and h.sync_pread(v, vp) == 0, "moment readback failed"
+            state[str(i)] = {"m": m, "v": v}
+        return {"step": self.inner.step_count, "swap_dir": str(self.swapper.swap_dir),
+                "state": state}
+
+    def load_state_dict(self, sd):
+        self.inner.step_count = int(sd["step"])
+        state = sd.get("state", {})
+        if state:
+            sizes = []
+            for i in sorted(int(k) for k in state):
+                m, v = state[str(i)]["m"], state[str(i)]["v"]
+                sizes.append(m.size)
+                mp, vp = self.swapper._paths(i)
+                self.swapper.write_handle.pwrite(np.asarray(m, np.float32), mp)
+                self.swapper.write_handle.pwrite(np.asarray(v, np.float32), vp)
+            assert self.swapper.write_handle.wait() == 0, "moment restore write failed"
+            self.swapper._sizes = {i: n for i, n in enumerate(sizes)}
+            self._resumed = True
+            self._initialized = False  # re-init will keep the restored files
+
+    def reset_state(self):
+        self.inner.reset_state()
+        self._initialized = False
+        self._resumed = False

@@ -40,13 +40,18 @@ def test_int4_pack_unpack():
 
 
 def test_stochastic_rounding_unbiased():
-    x = jnp.full((1, 1024), 0.3, jnp.float32) * 127.0 / 127.0
-    # value 0.3 of scale-1 grid: E[round] should be ≈ 0.3
-    q, params = quantize(x * 127, num_bits=8, num_groups=1,
+    # values land strictly between grid points: one anchor at 127.0 pins the
+    # scale to 1.0, the rest sit at 40.3 → codes must mix 40s and 41s with
+    # E[code] ≈ 40.3
+    x = jnp.concatenate([jnp.full((1, 1), 127.0), jnp.full((1, 8191), 40.3)], axis=1)
+    q, params = quantize(x, num_bits=8, num_groups=1,
                          stochastic_rounding=True, rng=jax.random.PRNGKey(0))
-    # scale is max/127 = 0.3*127/127... use mean of dequant ≈ mean of x
-    out = dequantize(q, params)
-    np.testing.assert_allclose(float(out.mean()), float((x * 127).mean()), rtol=5e-3)
+    codes = np.asarray(q)[0, 1:]
+    assert set(np.unique(codes)) == {40, 41}, "SR must mix adjacent codes"
+    np.testing.assert_allclose(codes.mean(), 40.3, atol=0.02)
+    # deterministic rounding collapses to a single code
+    q_det, _ = quantize(x, num_bits=8, num_groups=1)
+    assert set(np.unique(np.asarray(q_det)[0, 1:])) == {40}
 
 
 def test_fake_quantize_preserves_shape_dtype():
