@@ -1,0 +1,8 @@
+"""Elastic training (reference ``deepspeed/elasticity/``)."""
+
+from deepspeed_tpu.elasticity.elasticity import (ElasticityConfig, ElasticityConfigError,
+                                                 ElasticityError, ElasticityIncompatibleWorldSize,
+                                                 compute_elastic_config, elasticity_enabled)
+
+__all__ = ["compute_elastic_config", "elasticity_enabled", "ElasticityConfig", "ElasticityError",
+           "ElasticityConfigError", "ElasticityIncompatibleWorldSize"]

@@ -1,0 +1,82 @@
+"""Per-node process spawner (reference ``deepspeed/launcher/launch.py``:
+``main`` :132, signal handling / ``terminate_process_tree`` :118).
+
+TPU difference: ONE worker process per host — JAX drives every local chip
+from a single process, and ``jax.distributed.initialize`` (seeded from the
+env set here) replaces per-rank NCCL rendezvous. The reference's
+one-process-per-GPU fanout collapses to a single child with supervision.
+"""
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--node_rank", type=int, default=0)
+    parser.add_argument("--nnodes", type=int, default=1)
+    parser.add_argument("--master_addr", type=str, default="127.0.0.1")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--num_chips", type=int, default=0)
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args)
+
+
+def terminate_process_tree(pid: int):
+    """Kill a child and its descendants (reference ``launch.py:118``)."""
+    try:
+        os.killpg(os.getpgid(pid), signal.SIGTERM)
+        time.sleep(2)
+        os.killpg(os.getpgid(pid), signal.SIGKILL)
+    except ProcessLookupError:
+        pass
+
+
+def build_child_env(node_rank: int, nnodes: int, master_addr: str, master_port: int,
+                    num_chips: int = 0) -> dict:
+    """Env contract consumed by ``comm.init_distributed`` →
+    ``jax.distributed.initialize``."""
+    env = os.environ.copy()
+    env["COORDINATOR_ADDRESS"] = f"{master_addr}:{master_port}"
+    env["JAX_COORDINATOR_ADDRESS"] = env["COORDINATOR_ADDRESS"]
+    env["NODE_RANK"] = str(node_rank)
+    env["JAX_PROCESS_ID"] = str(node_rank)
+    env["JAX_NUM_PROCESSES"] = str(nnodes)
+    # reference-compatible names so user scripts keep working
+    env["RANK"] = str(node_rank)
+    env["LOCAL_RANK"] = "0"
+    env["WORLD_SIZE"] = str(nnodes)
+    env["MASTER_ADDR"] = master_addr
+    env["MASTER_PORT"] = str(master_port)
+    if num_chips:
+        env["DS_TPU_NUM_CHIPS"] = str(num_chips)
+    return env
+
+
+def main(args=None):
+    args = parse_args(args)
+    env = build_child_env(args.node_rank, args.nnodes, args.master_addr, args.master_port,
+                          args.num_chips)
+    cmd = [sys.executable, args.user_script] + args.user_args
+    logger.info(f"node {args.node_rank}/{args.nnodes}: spawning {' '.join(cmd)}")
+    child = subprocess.Popen(cmd, env=env, start_new_session=True)
+
+    def handler(signum, frame):
+        logger.warning(f"signal {signum}: terminating child {child.pid}")
+        terminate_process_tree(child.pid)
+        sys.exit(128 + signum)
+
+    signal.signal(signal.SIGTERM, handler)
+    signal.signal(signal.SIGINT, handler)
+    return child.wait()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

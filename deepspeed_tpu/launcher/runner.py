@@ -1,0 +1,160 @@
+"""Multi-host launch CLI (reference ``deepspeed/launcher/runner.py``:
+``main`` :387, hostfile parse :199, --include/--exclude filters :254,
+world-info encode :352).
+
+TPU semantics: one worker **process per host** (JAX owns all local chips;
+``jax.distributed.initialize`` replaces the per-rank NCCL rendezvous), so a
+"slot" in the hostfile is a chip for accounting but processes are spawned
+per node. The per-node spawner is ``launcher/launch.py``.
+"""
+
+import argparse
+import base64
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+from collections import OrderedDict
+from typing import Dict
+
+from deepspeed_tpu.utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+EXPORT_ENVS = ["PYTHONPATH", "PATH", "JAX_PLATFORMS", "TPU_CHIPS_PER_HOST_BOUNDS", "XLA_FLAGS"]
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="deepspeed-tpu launcher",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="Hostfile: lines of '<host> slots=<n_chips>'")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="Include hosts/chips, e.g. 'host1@host2:0,2'")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="Exclude hosts/chips, same syntax as --include")
+    parser.add_argument("--num_nodes", type=int, default=-1)
+    parser.add_argument("--num_gpus", "--num_chips", dest="num_gpus", type=int, default=-1)
+    parser.add_argument("--master_addr", type=str, default="")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--launcher", type=str, default="pdsh",
+                        choices=["pdsh", "openmpi", "mpich", "slurm", "ssh"])
+    parser.add_argument("--launcher_args", type=str, default="")
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("--elastic_training", action="store_true")
+    parser.add_argument("user_script", type=str, help="training script to launch")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args)
+
+
+def fetch_hostfile(hostfile_path: str) -> Dict[str, int]:
+    """Parse ``host slots=N`` lines (reference ``runner.py:199``)."""
+    if not os.path.isfile(hostfile_path):
+        return {}
+    resource_pool = OrderedDict()
+    with open(hostfile_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            m = re.match(r"^(\S+)\s+slots=(\d+)$", line)
+            if m is None:
+                raise ValueError(f"hostfile line malformed: {line!r} (want '<host> slots=<n>')")
+            host, slots = m.group(1), int(m.group(2))
+            if host in resource_pool:
+                raise ValueError(f"hostfile contains duplicate host {host}")
+            resource_pool[host] = slots
+    return resource_pool
+
+
+def _parse_filter(spec: str) -> Dict[str, list]:
+    """``host1@host2:0,2`` → {host1: [], host2: [0, 2]} (reference
+    ``parse_resource_filter`` semantics; [] = whole host)."""
+    out = OrderedDict()
+    if not spec:
+        return out
+    for part in spec.split("@"):
+        if ":" in part:
+            host, slots = part.split(":", 1)
+            out[host] = [int(s) for s in slots.split(",")]
+        else:
+            out[part] = []
+    return out
+
+
+def parse_resource_filter(resource_pool: Dict[str, int], include_str="", exclude_str=""):
+    """Apply --include/--exclude (reference ``runner.py:254``)."""
+    if include_str and exclude_str:
+        raise ValueError("--include and --exclude are mutually exclusive")
+    active = OrderedDict()
+    if include_str:
+        for host, slots in _parse_filter(include_str).items():
+            if host not in resource_pool:
+                raise ValueError(f"included host {host} not in hostfile")
+            avail = resource_pool[host]
+            if slots:
+                bad = [s for s in slots if s >= avail]
+                if bad:
+                    raise ValueError(f"host {host} has {avail} slots; invalid: {bad}")
+                active[host] = len(slots)
+            else:
+                active[host] = avail
+        return active
+    if exclude_str:
+        excl = _parse_filter(exclude_str)
+        for host, avail in resource_pool.items():
+            if host in excl:
+                slots = excl[host]
+                if not slots:
+                    continue  # whole host excluded
+                remaining = avail - len(slots)
+                if remaining > 0:
+                    active[host] = remaining
+            else:
+                active[host] = avail
+        return active
+    return OrderedDict(resource_pool)
+
+
+def encode_world_info(resource_pool: Dict[str, int]) -> str:
+    """base64 world info handed to every node (reference ``runner.py:352``)."""
+    return base64.urlsafe_b64encode(json.dumps(resource_pool).encode()).decode()
+
+
+def main(args=None):
+    args = parse_args(args)
+    resource_pool = fetch_hostfile(args.hostfile)
+
+    if not resource_pool:
+        # single node: all local chips
+        n = args.num_gpus if args.num_gpus > 0 else 0
+        env = os.environ.copy()
+        cmd = [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+               "--node_rank", "0", "--nnodes", "1",
+               "--master_addr", args.master_addr or "127.0.0.1",
+               "--master_port", str(args.master_port)]
+        if n:
+            cmd += ["--num_chips", str(n)]
+        cmd += [args.user_script] + args.user_args
+        logger.info(f"single-node launch: {' '.join(cmd)}")
+        return subprocess.call(cmd, env=env)
+
+    active = parse_resource_filter(resource_pool, args.include, args.exclude)
+    if args.num_nodes > 0:
+        active = OrderedDict(list(active.items())[:args.num_nodes])
+    world_info = encode_world_info(active)
+    master_addr = args.master_addr or list(active.keys())[0]
+
+    from deepspeed_tpu.launcher.multinode_runner import get_runner
+    runner = get_runner(args.launcher, args, world_info, active, master_addr)
+    if not runner.backend_exists():
+        raise RuntimeError(f"launcher backend {args.launcher!r} not available on this system")
+    cmd = runner.get_cmd(os.environ.copy(), active)
+    logger.info(f"multi-node launch ({args.launcher}): {' '.join(cmd)}")
+    return subprocess.call(cmd)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
