@@ -192,8 +192,13 @@ class InferenceEngine:
             self._gen_fn = self._build_generate(batch, prompt_len, max_new, do_sample, temperature,
                                                 top_k, top_p, eos_token_id)
             self._gen_key = key
-        base = rng if rng is not None else self._rng
-        self._rng, use_rng = jax.random.split(base)
+        if rng is not None:
+            # caller-supplied key: use it directly without touching the
+            # engine's own stream, so later rng-less calls stay independent
+            # of (and uncorrelated with) the caller's key
+            use_rng = rng
+        else:
+            self._rng, use_rng = jax.random.split(self._rng)
         out, n = self._gen_fn(self.params, ids, use_rng)
         n = int(n)
         return jnp.concatenate([ids, out[:, :n]], axis=1)

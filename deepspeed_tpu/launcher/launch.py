@@ -10,11 +10,48 @@ one-process-per-GPU fanout collapses to a single child with supervision.
 import argparse
 import os
 import signal
+import socket
 import subprocess
 import sys
 import time
 
 from deepspeed_tpu.utils.logging import logger
+
+
+def infer_node_rank(default: int = 0) -> int:
+    """Derive this host's node rank when the launcher ran an identical
+    command on every node (pdsh/mpirun/srun — reference
+    ``launcher/launch.py:132`` reads RANK-style env per backend).
+
+    Priority: scheduler-provided rank env (OpenMPI/MPICH/Slurm), then
+    position of the local hostname in ``DS_NODE_LIST`` (set by PDSHRunner).
+    A DS_NODE_LIST that does not contain this host is a hard error — every
+    node silently claiming rank ``default`` would deadlock the rendezvous.
+    """
+    for var in ("OMPI_COMM_WORLD_RANK", "PMI_RANK", "SLURM_NODEID"):
+        if os.environ.get(var):
+            return int(os.environ[var])
+    node_list = os.environ.get("DS_NODE_LIST", "")
+    if node_list:
+        hosts = node_list.split(",")
+        candidates = {socket.gethostname(), socket.gethostname().split(".")[0]}
+        try:
+            candidates.add(socket.gethostbyname(socket.gethostname()))
+        except OSError:
+            pass
+        for rank, host in enumerate(hosts):
+            if host in candidates:
+                return rank
+        raise RuntimeError(
+            f"cannot infer node rank: DS_NODE_LIST={node_list} does not contain this "
+            f"host (known identities: {sorted(candidates)}); use IPs/hostnames in the "
+            f"hostfile that the nodes recognize, or the ssh launcher which assigns "
+            f"explicit ranks")
+    if default < 0:
+        raise RuntimeError("node rank not determinable: no scheduler rank env "
+                           "(OMPI_COMM_WORLD_RANK/PMI_RANK/SLURM_NODEID), no DS_NODE_LIST, "
+                           "and no explicit --node_rank")
+    return default
 
 
 def parse_args(args=None):
@@ -62,10 +99,21 @@ def build_child_env(node_rank: int, nnodes: int, master_addr: str, master_port: 
 
 def main(args=None):
     args = parse_args(args)
-    env = build_child_env(args.node_rank, args.nnodes, args.master_addr, args.master_port,
+    if args.node_rank >= 0:
+        # explicit rank (SSHRunner assigns these per host); an inherited
+        # scheduler rank env must not silently override it
+        node_rank = args.node_rank
+        for var in ("OMPI_COMM_WORLD_RANK", "PMI_RANK", "SLURM_NODEID"):
+            val = os.environ.get(var)
+            if val and int(val) != node_rank:
+                logger.warning(f"{var}={val} disagrees with explicit --node_rank "
+                               f"{node_rank}; using --node_rank")
+    else:
+        node_rank = infer_node_rank(default=-1)
+    env = build_child_env(node_rank, args.nnodes, args.master_addr, args.master_port,
                           args.num_chips)
     cmd = [sys.executable, args.user_script] + args.user_args
-    logger.info(f"node {args.node_rank}/{args.nnodes}: spawning {' '.join(cmd)}")
+    logger.info(f"node {node_rank}/{args.nnodes}: spawning {' '.join(cmd)}")
     child = subprocess.Popen(cmd, env=env, start_new_session=True)
 
     def handler(signum, frame):

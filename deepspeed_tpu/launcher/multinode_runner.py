@@ -59,7 +59,7 @@ class PDSHRunner(MultiNodeRunner):
         node_list = ",".join(active_resources.keys())
         cmd_to_run = (f"{exports} cd {os.path.abspath('.')}; "
                       f"DS_NODE_LIST={node_list} DS_WORLD_INFO={self.world_info_base64} "
-                      + " ".join(map(quote, self._node_payload(0, len(active_resources)))))
+                      + " ".join(map(quote, self._node_payload(-1, len(active_resources)))))
         return ["pdsh", "-S", "-f", "1024", "-w", hosts, cmd_to_run]
 
 
@@ -78,8 +78,8 @@ class OpenMPIRunner(MultiNodeRunner):
                 mpirun += ["-x", var]
         if self.args.launcher_args:
             mpirun += self.args.launcher_args.split()
-        # under MPI the node rank comes from OMPI_COMM_WORLD_RANK
-        return mpirun + self._node_payload(0, nnodes)
+        # node_rank=-1: launch.py infers the rank from OMPI_COMM_WORLD_RANK
+        return mpirun + self._node_payload(-1, nnodes)
 
 
 class MPICHRunner(OpenMPIRunner):
@@ -102,7 +102,8 @@ class SlurmRunner(MultiNodeRunner):
             srun += ["--nodelist", ",".join(active_resources.keys())]
         if self.args.launcher_args:
             srun += self.args.launcher_args.split()
-        return srun + self._node_payload(0, nnodes)
+        # node_rank=-1: launch.py infers the rank from SLURM_NODEID
+        return srun + self._node_payload(-1, nnodes)
 
 
 class SSHRunner(MultiNodeRunner):

@@ -391,10 +391,18 @@ class DeepSpeedEngine:
         def qdq(p):
             if not jnp.issubdtype(p.dtype, jnp.floating) or p.ndim < 2:
                 return p
+            # per-output-channel groups (reference CUDAQuantizer per-channel
+            # scales): flax kernels put the reduction dim first, so one group
+            # = one trailing-axes element's column of length shape[0]. For a
+            # DenseGeneral qkv kernel [in, 3, heads, head_dim] that is a
+            # separate scale per (proj, head, channel) — never mixing heads
+            # or q/k/v in one group.
+            pt = jnp.moveaxis(p, 0, -1)  # [out..., in] — groups contiguous in memory
+            q = fake_quantize(pt, num_bits=8, num_groups=pt.size // pt.shape[-1])
+            q = jnp.moveaxis(q, -1, 0)
             # straight-through estimator: quantization error is outside the
             # gradient path (the reference quantizes the all-gather payload
             # outside autograd — identity gradient)
-            q = fake_quantize(p, num_bits=8, num_groups=p.shape[0])
             return p + jax.lax.stop_gradient(q - p)
 
         return jax.tree.map(qdq, params)
@@ -467,11 +475,14 @@ class DeepSpeedEngine:
 
             updates, new_opt = self.optimizer.update(grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
-            if fp16:
-                # overflow → skip update (reference stage step-skip semantics)
-                keep = lambda new, old: jax.tree.map(lambda n, o: jnp.where(overflow, o, n), new, old)
-                new_params = keep(new_params, state.params)
-                new_opt = keep(new_opt, state.opt_state)
+            # overflow → skip update (reference stage step-skip semantics).
+            # Applied in every dtype mode: for bf16/fp32 `overflow` is a
+            # non-finite grad norm, and letting that update through would
+            # poison the params while metrics claim the step was skipped
+            # (the offload path already skips — keep the two paths agreeing)
+            keep = lambda new, old: jax.tree.map(lambda n, o: jnp.where(overflow, o, n), new, old)
+            new_params = keep(new_params, state.params)
+            new_opt = keep(new_opt, state.opt_state)
             new_ls = self._ls_update(state.loss_scale, overflow)
             new_state = TrainState(step=state.step + 1, params=new_params, opt_state=new_opt,
                                    loss_scale=new_ls)
@@ -513,17 +524,16 @@ class DeepSpeedEngine:
             scale = state.loss_scale.loss_scale if fp16 else jnp.float32(1.0)
             grads = jax.tree.map(lambda g: g / (n_micro * scale), grads)
             grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
-            overflow = has_overflow(grads) if fp16 else jnp.zeros([], bool)
             gnorm = _global_norm(grads)
+            overflow = has_overflow(grads) if fp16 else ~jnp.isfinite(gnorm)
             if clip > 0:
                 factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
                 grads = jax.tree.map(lambda g: g * factor, grads)
             updates, new_opt = self.optimizer.update(grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
-            if fp16:
-                keep = lambda new, old: jax.tree.map(lambda n, o: jnp.where(overflow, o, n), new, old)
-                new_params = keep(new_params, state.params)
-                new_opt = keep(new_opt, state.opt_state)
+            keep = lambda new, old: jax.tree.map(lambda n, o: jnp.where(overflow, o, n), new, old)
+            new_params = keep(new_params, state.params)
+            new_opt = keep(new_opt, state.opt_state)
             new_ls = self._ls_update(state.loss_scale, overflow)
             new_state = TrainState(step=state.step + 1, params=new_params, opt_state=new_opt, loss_scale=new_ls)
             return new_state, {"grad_norm": gnorm, "overflow": overflow, "loss_scale": new_ls.loss_scale}

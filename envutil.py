@@ -1,0 +1,30 @@
+"""Shared helpers for running CPU-pinned JAX subprocesses from the repo-root
+driver entry points (``bench.py``, ``__graft_entry__.py``).
+
+Kept dependency-free (no jax, no deepspeed_tpu import) so parent processes
+can orchestrate without touching any accelerator backend.
+"""
+
+import os
+
+# env vars that make the session's sitecustomize force-register a tunneled
+# TPU ("axon") backend; any CPU-pinned child must have them scrubbed or a
+# hung tunnel hangs the child at backend init
+_TPU_PLUGIN_VARS = ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE")
+
+
+def cpu_subprocess_env(n_virtual_devices: int = 0) -> dict:
+    """A copy of os.environ pinned to the CPU platform with the TPU-tunnel
+    plugin disabled; optionally forcing ``n_virtual_devices`` XLA host
+    devices (0 = leave XLA_FLAGS alone)."""
+    env = dict(os.environ)
+    for var in _TPU_PLUGIN_VARS:
+        env.pop(var, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_virtual_devices:
+        flags = env.get("XLA_FLAGS", "")
+        flags = " ".join(f for f in flags.split()
+                         if "xla_force_host_platform_device_count" not in f)
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_virtual_devices}").strip()
+    return env
