@@ -124,9 +124,8 @@ def _run(mode, env, timeout):
         )
         return p.returncode, p.stdout, p.stderr
     except subprocess.TimeoutExpired as e:
-        def _txt(b):
-            return b.decode(errors="replace") if isinstance(b, bytes) else (b or "")
-        return 124, _txt(e.stdout), _txt(e.stderr)
+        from envutil import to_text
+        return 124, to_text(e.stdout), to_text(e.stderr)
 
 
 def _last_json_line(text):
@@ -155,7 +154,8 @@ def main():
             break
         errors.append(f"probe attempt {attempt + 1}: rc={rc} "
                       f"{(err or out).strip().splitlines()[-1] if (err or out).strip() else 'no output'}")
-        time.sleep(5)
+        if attempt == 0:
+            time.sleep(5)
 
     # 2) real benchmark on the accelerator
     if accel_ok:

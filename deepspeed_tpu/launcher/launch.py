@@ -34,6 +34,8 @@ def infer_node_rank(default: int = 0) -> int:
     node_list = os.environ.get("DS_NODE_LIST", "")
     if node_list:
         hosts = node_list.split(",")
+        if len(hosts) == 1:
+            return 0  # unambiguous regardless of how the host is spelled
         candidates = {socket.gethostname(), socket.gethostname().split(".")[0]}
         try:
             candidates.add(socket.gethostbyname(socket.gethostname()))

@@ -263,10 +263,35 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     def _accumulate_grads(self, params, batch, rng, scale, grad_shardings, gas, clip, fp16):
         """The shared fwd+bwd core: GAS microbatch scan, 1/gas averaging,
-        (optional) qgZ QDQ, ZeRO reduction constraint, clipping, overflow.
+        quantized or full-precision ZeRO reduction, clipping, overflow.
         Used by the fused on-device step AND the offload grads-only step so
         the two paths cannot drift."""
         keys = jax.random.split(rng, gas)
+
+        if getattr(self, "_use_qcomm", False):
+            # ZeRO++ real quantized collectives: the whole gather→scan→reduce
+            # runs as one shard_map over (data, fsdp) with int8/int4 payloads
+            # on the wire (qcomm.py; reference coalesced_collectives.py:31,
+            # partition_parameters.py:628)
+            from deepspeed_tpu.runtime.zero.qcomm import qcomm_accumulate
+            zc = self.config.zero_config
+            fn = qcomm_accumulate(
+                self._loss_for, self.mesh, self.plan.param_specs, self.plan.grad_specs,
+                batch, self._batch_spec(with_gas_dim=True), gas=gas,
+                quantized_weights=bool(zc.zero_quantized_weights),
+                quantized_gradients=bool(zc.zero_quantized_gradients),
+                wire_dtype=self.compute_dtype)
+            self._qcomm_tracing = True
+            try:
+                loss_mean, grads = fn(params, batch, keys, scale)
+            finally:
+                self._qcomm_tracing = False
+            gnorm = _global_norm(grads)
+            overflow = has_overflow(grads) if fp16 else ~jnp.isfinite(gnorm)
+            if clip > 0:
+                factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                grads = jax.tree.map(lambda g: g * factor, grads)
+            return loss_mean, grads, gnorm, overflow
 
         def micro(acc, xs):
             mb, key = xs
@@ -431,7 +456,11 @@ class DeepSpeedEngine:
         return jax.tree.unflatten(treedef, [qdq((i, g)) for i, g in enumerate(leaves)])
 
     def _loss_for(self, params, mb, key, scale, train: bool = True):
-        if self.config.zero_config.zero_quantized_weights:
+        if self.config.zero_config.zero_quantized_weights and not getattr(self, "_qcomm_tracing", False):
+            # QDQ numerics apply everywhere EXCEPT inside the qcomm trace,
+            # where the gather itself carries the int8 payload
+            # (qcomm.quantized_allgather) — the forward/backward shim path
+            # keeps its QDQ weight numerics either way
             params = self._quantize_gathered_weights(params)
         cparams = _cast_floating(params, self.compute_dtype)
         ids = mb["input_ids"] if isinstance(mb, dict) else mb
@@ -458,6 +487,21 @@ class DeepSpeedEngine:
         clip = cfg.gradient_clipping
         fp16 = self.fp16_enabled
         grad_shardings = self.plan.grad_shardings()
+
+        # ZeRO++ quantized comm: real int8/int4 wire payloads need the
+        # explicit shard_map path, which composes with pure-DP meshes only;
+        # other topologies keep the QDQ numerics simulation
+        zc = cfg.zero_config
+        want_qcomm = bool(zc.zero_quantized_gradients or zc.zero_quantized_weights)
+        mcfg = getattr(self.module, "config", None)
+        has_moe = mcfg is not None and getattr(mcfg, "moe_num_experts", 0) > 0
+        pure_dp = all(self.mesh.shape[a] == 1 for a in ("pipe", "tensor", "sequence", "expert"))
+        dp_world = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
+        self._use_qcomm = (want_qcomm and pure_dp and dp_world > 1 and not has_moe
+                           and not getattr(self, "_offload_enabled", False))
+        if want_qcomm and not self._use_qcomm:
+            log_dist("ZeRO++ quantized communication requires a pure-DP mesh without "
+                     "MoE/offload; falling back to QDQ numerics (no wire-byte savings)")
         mesh = self.mesh
 
         if getattr(self, "_offload_enabled", False):

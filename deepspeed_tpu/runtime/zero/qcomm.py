@@ -1,0 +1,249 @@
+"""ZeRO++ quantized communication — collectives that really move fewer bytes.
+
+TPU-native redesign of the reference's compressed-communication stack:
+
+* quantized weight all-gather (``zero_quantized_weights`` — reference
+  ``runtime/zero/partition_parameters.py:628`` ``CUDAQuantizer`` wrapping the
+  stage-3 param all-gather): each device int8-quantizes its local fsdp param
+  shard and the *int8 codes + per-group fp32 scales* ride the all-gather —
+  ~2× fewer wire bytes than a bf16 gather, ~4× vs fp32.
+* qgZ hierarchical quantized gradient reduction (``zero_quantized_gradients``
+  — reference ``runtime/comm/coalesced_collectives.py:31``
+  ``all_to_all_quant_reduce`` + ``csrc/quantization/quant_reduce.cu``):
+  int8 all-to-all + mean over the fast ``fsdp`` (intra-node/ICI-near) axis,
+  then a two-phase packed-int4 exchange over the slow ``data`` axis
+  (scatter-reduce + gather, the shape of the reference's
+  ``compressed_allreduce`` two-phase design, ``runtime/comm/nccl.py:51``).
+
+Everything here runs inside one ``jax.shard_map`` over the DP axes so the
+quantize → exchange → dequantize pipeline is explicit SPMD: the wire payload
+is the int8/int4-packed array itself, not a QDQ simulation. The engine uses
+this path for the whole gradient-accumulation step when quantized comm is
+enabled on a pure-DP mesh (tensor/sequence/pipe/expert all 1); other meshes
+fall back to the numerics-only QDQ path.
+"""
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.ops.quantizer.core import (divisor_groups, pack_int4, quantize, unpack_int4)
+from deepspeed_tpu.parallel.topology import DATA_AXIS, FSDP_AXIS
+
+DEFAULT_GROUP_SIZE = 2048
+
+
+def _axis_dim(spec: P, axis_name: str) -> Optional[int]:
+    """Index of the array dim that ``spec`` shards over ``axis_name``."""
+    for d, entry in enumerate(spec):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        if axis_name in names:
+            return d
+    return None
+
+
+# ---------------------------------------------------------------------------
+# inside-shard_map leaf ops
+# ---------------------------------------------------------------------------
+
+def quantized_allgather(shard: jax.Array, dim: int, axis: str, axis_size: int,
+                        group_size: int = DEFAULT_GROUP_SIZE) -> jax.Array:
+    """All-gather a param shard along ``dim`` over mesh axis ``axis`` with an
+    int8 payload (+fp32 grouped scales). Returns the full fp32 leaf."""
+    groups = divisor_groups(shard.size, group_size)
+    q, params = quantize(shard, num_bits=8, symmetric=True, num_groups=groups)
+    qg = jax.lax.all_gather(q, axis)                 # [K, groups, gsz] int8 on the wire
+    sg = jax.lax.all_gather(params.scale, axis)      # [K, groups, 1] fp32 (1/gsz of payload)
+    vals = qg.astype(jnp.float32) * sg               # dequantize
+    vals = vals.reshape((axis_size,) + shard.shape)
+    # shard k is block k along `dim`: splice the gathered blocks back in place
+    full = jnp.moveaxis(vals, 0, dim)
+    shape = list(shard.shape)
+    shape[dim] = shard.shape[dim] * axis_size
+    return full.reshape(shape)
+
+
+def _a2a_mean_int8(chunks: jax.Array, axis: str, axis_size: int,
+                   group_size: int, rng: Optional[jax.Array]) -> jax.Array:
+    """[K, m] partials → int8 all-to-all over ``axis`` → mean. Returns [m]:
+    this device's chunk averaged over the axis group."""
+    m = chunks.shape[-1]
+    gpc = divisor_groups(m, group_size)
+    q, params = quantize(chunks, num_bits=8, symmetric=True, num_groups=axis_size * gpc,
+                         stochastic_rounding=rng is not None, rng=rng)
+    q = q.reshape(axis_size, m)
+    scale = params.scale.reshape(axis_size, gpc)
+    q = jax.lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=False)
+    scale = jax.lax.all_to_all(scale, axis, split_axis=0, concat_axis=0, tiled=False)
+    vals = q.reshape(axis_size, gpc, -1).astype(jnp.float32) * scale[..., None]
+    return vals.reshape(axis_size, m).mean(axis=0)
+
+
+def _compressed_allreduce_int4(v: jax.Array, axis: str, axis_size: int,
+                               group_size: int, rng: Optional[jax.Array]) -> jax.Array:
+    """Two-phase packed-int4 mean-allreduce of flat ``v`` over ``axis``
+    (reference ``compressed_allreduce`` two-phase gather/scatter,
+    ``runtime/comm/nccl.py:51``; int4 per qgZ's inter-node hop). Wire bytes:
+    2 × n/2 int4-packed + scales ≈ n bytes vs 4n fp32."""
+    n = v.shape[-1]
+    pad = (-n) % (2 * axis_size)
+    vp = jnp.pad(v, (0, pad))
+    m = vp.shape[-1] // axis_size
+    chunks = vp.reshape(axis_size, m)
+    # phase 1: int4 scatter-reduce (all_to_all + local mean)
+    gpc = divisor_groups(m, group_size)
+    k1, k2 = jax.random.split(rng) if rng is not None else (None, None)
+    q, params = quantize(chunks, num_bits=4, symmetric=True, num_groups=axis_size * gpc,
+                         stochastic_rounding=k1 is not None, rng=k1)
+    qp = pack_int4(q.reshape(axis_size, m))
+    scale = params.scale.reshape(axis_size, gpc)
+    qp = jax.lax.all_to_all(qp, axis, split_axis=0, concat_axis=0, tiled=False)
+    scale = jax.lax.all_to_all(scale, axis, split_axis=0, concat_axis=0, tiled=False)
+    vals = unpack_int4(qp).reshape(axis_size, gpc, -1).astype(jnp.float32) * scale[..., None]
+    u = vals.reshape(axis_size, m).mean(axis=0)  # my chunk, averaged over the axis
+    # phase 2: int4 all-gather of the reduced chunks
+    q2, params2 = quantize(u, num_bits=4, symmetric=True, num_groups=gpc,
+                           stochastic_rounding=k2 is not None, rng=k2)
+    qp2 = pack_int4(q2.reshape(1, m))[0]
+    g_q = jax.lax.all_gather(qp2, axis)            # [K, m/2] packed int4
+    g_s = jax.lax.all_gather(params2.scale.reshape(gpc), axis)  # [K, gpc]
+    vals2 = unpack_int4(g_q).reshape(axis_size, gpc, -1).astype(jnp.float32) * g_s[..., None]
+    out = vals2.reshape(axis_size * m)
+    return out[:n] if pad else out
+
+
+def quantized_grad_reduce(g: jax.Array, spec: P, *,
+                          fsdp_axis: str, fsdp_size: int,
+                          data_axis: str, data_size: int,
+                          group_size: int = DEFAULT_GROUP_SIZE,
+                          rng: Optional[jax.Array] = None) -> jax.Array:
+    """Hierarchical qgZ reduction of one full-size per-device grad leaf down
+    to this device's shard (per ``spec``), averaged over the whole DP world.
+
+    Hop 1: int8 all-to-all-mean over ``fsdp`` along the leaf's sharded dim.
+    Hop 2: two-phase packed-int4 mean-allreduce over ``data`` (result is
+    bitwise identical across the data axis, as the out-spec's replication
+    requires). Leaves without an fsdp dim skip hop 1 and, when small, skip
+    quantization entirely (grouped scales would dominate the payload).
+    """
+    dim = _axis_dim(spec, fsdp_axis)
+    if dim is not None and fsdp_size > 1:
+        moved = jnp.moveaxis(g, dim, 0)
+        lead = moved.shape[0]
+        chunks = moved.reshape(fsdp_size, -1)
+        shard_flat = _a2a_mean_int8(chunks, fsdp_axis, fsdp_size, group_size,
+                                    None if rng is None else jax.random.fold_in(rng, 0))
+        shard_shape = (lead // fsdp_size,) + moved.shape[1:]
+        local = jnp.moveaxis(shard_flat.reshape(shard_shape), 0, dim)
+    else:
+        # replicated-over-fsdp leaf: plain mean (these are the small leaves —
+        # biases/norms — where quantization overhead beats the savings)
+        local = jax.lax.pmean(g, fsdp_axis) if fsdp_size > 1 else g
+    if data_size > 1:
+        if local.size >= 4 * group_size:
+            flat = _compressed_allreduce_int4(
+                local.reshape(-1), data_axis, data_size, group_size,
+                None if rng is None else jax.random.fold_in(rng, 1))
+            local = flat.reshape(local.shape)
+        else:
+            local = jax.lax.pmean(local, data_axis)
+    return local
+
+
+# ---------------------------------------------------------------------------
+# engine-facing builder
+# ---------------------------------------------------------------------------
+
+def qcomm_accumulate(loss_for, mesh, param_specs, grad_specs, batch, batch_spec, *,
+                     gas: int,
+                     quantized_weights: bool,
+                     quantized_gradients: bool,
+                     wire_dtype=jnp.bfloat16,
+                     fsdp_axis: str = FSDP_AXIS,
+                     data_axis: str = DATA_AXIS,
+                     group_size: int = DEFAULT_GROUP_SIZE,
+                     stochastic_rounding: bool = True):
+    """Build the shard_map'd gradient-accumulation function for quantized
+    communication and return it.
+
+    ``loss_for(params, mb, key, scale) -> (scaled_loss, loss)`` is traced
+    per-device: params enter as local fsdp shards, are (quantized-)gathered
+    to full leaves, the GAS microbatch scan runs on the local batch shard,
+    and gradients leave as fsdp shards reduced with real int8/int4 payloads.
+
+    Returns ``fn(params, batch, keys, scale) -> (loss_mean, grad_shards)``
+    where ``keys`` is ``jax.random.split(rng, gas)``.
+    """
+    fsdp_size = mesh.shape[fsdp_axis]
+    data_size = mesh.shape[data_axis]
+    param_flat, param_treedef = jax.tree_util.tree_flatten(param_specs, is_leaf=lambda x: isinstance(x, P))
+    grad_flat = jax.tree_util.tree_flatten(grad_specs, is_leaf=lambda x: isinstance(x, P))[0]
+
+    batch_in_specs = jax.tree.map(lambda x: P(*batch_spec[:x.ndim]), batch)
+
+    def body(param_shards, local_batch, keys, scale):
+        dp_idx = jax.lax.axis_index((data_axis, fsdp_axis))
+
+        def gather(shard, spec):
+            dim = _axis_dim(spec, fsdp_axis)
+            if dim is None or fsdp_size == 1:
+                return shard
+            if quantized_weights:
+                return quantized_allgather(shard, dim, fsdp_axis, fsdp_size, group_size)
+            # unquantized gather rides the wire at the engine's compute dtype
+            # (what GSPMD would emit after sinking the cast below the gather);
+            # fp32 compute keeps full precision on the wire
+            gathered = jax.lax.all_gather(shard.astype(wire_dtype), fsdp_axis)
+            vals = jnp.moveaxis(gathered, 0, dim)
+            shape = list(shard.shape)
+            shape[dim] = shard.shape[dim] * fsdp_size
+            return vals.reshape(shape).astype(shard.dtype)
+
+        p_flat = jax.tree_util.tree_flatten(param_shards)[0]
+        full_flat = [gather(s, spec) for s, spec in zip(p_flat, param_flat)]
+        full_params = jax.tree_util.tree_unflatten(param_treedef, full_flat)
+
+        def micro(acc, xs):
+            mb, key = xs
+            key = jax.random.fold_in(key, dp_idx)  # decorrelate dropout across DP shards
+            (_, loss), grads = jax.value_and_grad(loss_for, has_aux=True)(full_params, mb, key, scale)
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+            return jax.tree.map(jnp.add, acc, grads), loss
+
+        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), full_params)
+        grads, losses = jax.lax.scan(micro, zeros, (local_batch, keys))
+        grads = jax.tree.map(lambda g: g / (gas * scale), grads)
+
+        g_flat = jax.tree_util.tree_flatten(grads)[0]
+        out_flat = []
+        for i, (g, spec) in enumerate(zip(g_flat, grad_flat)):
+            if quantized_gradients:
+                key = jax.random.fold_in(keys[0], 1000 + i) if stochastic_rounding else None
+                out_flat.append(quantized_grad_reduce(
+                    g, spec, fsdp_axis=fsdp_axis, fsdp_size=fsdp_size,
+                    data_axis=data_axis, data_size=data_size,
+                    group_size=group_size, rng=key))
+            else:
+                # quantized weights only: grads still reduce in full precision
+                dim = _axis_dim(spec, fsdp_axis)
+                g = jax.lax.pmean(g, data_axis) if data_size > 1 else g
+                if dim is not None and fsdp_size > 1:
+                    moved = jnp.moveaxis(g, dim, 0)
+                    red = jax.lax.psum_scatter(moved, fsdp_axis, scatter_dimension=0,
+                                               tiled=True) / fsdp_size
+                    g = jnp.moveaxis(red, 0, dim)
+                elif fsdp_size > 1:
+                    g = jax.lax.pmean(g, fsdp_axis)
+                out_flat.append(g)
+        grad_shards = jax.tree_util.tree_unflatten(param_treedef, out_flat)
+        loss = jax.lax.pmean(losses.mean(), (data_axis, fsdp_axis))
+        return loss, grad_shards
+
+    in_specs = (param_specs, batch_in_specs, P(), P())
+    out_specs = (P(), grad_specs)
+    return jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                         check_vma=False)

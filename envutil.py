@@ -13,6 +13,13 @@ import os
 _TPU_PLUGIN_VARS = ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE")
 
 
+def to_text(maybe_bytes) -> str:
+    """Normalize subprocess.TimeoutExpired stdout/stderr (bytes | str | None)."""
+    if isinstance(maybe_bytes, bytes):
+        return maybe_bytes.decode(errors="replace")
+    return maybe_bytes or ""
+
+
 def cpu_subprocess_env(n_virtual_devices: int = 0) -> dict:
     """A copy of os.environ pinned to the CPU platform with the TPU-tunnel
     plugin disabled; optionally forcing ``n_virtual_devices`` XLA host
