@@ -169,6 +169,22 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # configuration
     # ------------------------------------------------------------------
+    def _onebit_comm_eligible(self) -> bool:
+        """The real 1-bit compressed collective needs replicated params/opt
+        state (stage 0) on a pure-DP multi-device mesh without MoE/offload."""
+        if (self.config.optimizer_name != C.ONEBIT_ADAM_OPTIMIZER
+                or self.client_optimizer is not None):
+            return False
+        off = self.config.zero_config.offload_optimizer
+        if off is not None and getattr(off, "device", "none") not in (None, "none"):
+            return False
+        mcfg = getattr(self.module, "config", None)
+        if mcfg is not None and getattr(mcfg, "moe_num_experts", 0) > 0:
+            return False
+        pure_dp = all(self.mesh.shape[a] == 1 for a in ("pipe", "tensor", "sequence", "expert"))
+        dp_world = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
+        return pure_dp and dp_world > 1 and self.config.zero_optimization_stage == 0
+
     def _configure_optimizer(self) -> optax.GradientTransformation:
         """Reference ``_configure_basic_optimizer`` (``engine.py:1225``):
         config name → built-in optimizer; a client-supplied optax transform
@@ -188,6 +204,11 @@ class DeepSpeedEngine:
             return fused_adam(lr=lr, adam_w_mode=adam_w_mode, **params)
         if name in (C.ONEBIT_ADAM_OPTIMIZER, C.ZERO_ONE_ADAM_OPTIMIZER, C.ONEBIT_LAMB_OPTIMIZER):
             from deepspeed_tpu.runtime.fp16.onebit import get_onebit_optimizer
+            if name == C.ONEBIT_ADAM_OPTIMIZER and self._onebit_comm_eligible():
+                # the engine's compressed-collective step owns post-freeze
+                # compression; the transform skips its internal QDQ and the
+                # dead full-size error-feedback tree
+                params["external_comm"] = True
             return get_onebit_optimizer(name, lr=lr, **params)
         if name == C.LAMB_OPTIMIZER:
             return fused_lamb(lr=lr, **params)
@@ -314,6 +335,105 @@ class DeepSpeedEngine:
             factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
             grads = jax.tree.map(lambda g: g * factor, grads)
         return losses.mean(), grads, gnorm, overflow
+
+    def _build_onebit_step_fn(self, batch):
+        """Compression-phase 1-bit Adam step: one shard_map over the DP axes
+        where each device computes LOCAL gradients, updates the shared
+        momentum with them, and the only cross-device traffic is the
+        two-phase 1-bit compressed momentum allreduce
+        (``runtime/comm/compressed.py``; reference ``nccl.py:51`` +
+        ``fp16/onebit/adam.py:307``). Variance is frozen (post-freeze_step
+        semantics); error-feedback buffers are per-device."""
+        import jax.flatten_util
+
+        from deepspeed_tpu.runtime.comm.compressed import compressed_allreduce
+
+        ob = self._onebit_cfg
+        b1, _ = ob["betas"]
+        eps, wd, lr = ob["eps"], ob["weight_decay"], ob["lr"]
+        gas = self.config.gradient_accumulation_steps
+        fp16 = self.fp16_enabled
+        mesh = self.mesh
+        dp_axes = ("data", "fsdp")
+        world = mesh.shape["data"] * mesh.shape["fsdp"]
+        n_flat = sum(int(np.prod(s)) for s in jax.tree.leaves(
+            self.plan.param_shapes, is_leaf=lambda x: isinstance(x, tuple)))
+        m_chunk = ((n_flat + world * 8 - 1) // (world * 8)) * 8
+
+        err_sharding = NamedSharding(mesh, P(dp_axes))
+        if self._onebit_errors is None:
+            zeros = jax.jit(lambda: (jnp.zeros((world, n_flat), jnp.float32),
+                                     jnp.zeros((world, m_chunk), jnp.float32)),
+                            out_shardings=(err_sharding, err_sharding))
+            self._onebit_errors = zeros()
+
+        batch_spec = self._batch_spec(with_gas_dim=True)
+        batch_in_specs = jax.tree.map(lambda x: P(*batch_spec[:x.ndim]), batch)
+
+        def body(params, opt_state, ew, es, local_batch, keys, scale):
+            dp_idx = jax.lax.axis_index(dp_axes)
+
+            def micro(acc, xs):
+                mb, key = xs
+                key = jax.random.fold_in(key, dp_idx)
+                (_, loss), grads = jax.value_and_grad(self._loss_for, has_aux=True)(params, mb, key, scale)
+                grads = _cast_floating(grads, jnp.float32)
+                return jax.tree.map(jnp.add, acc, grads), loss
+
+            zeros_g = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            grads, losses = jax.lax.scan(micro, zeros_g, (local_batch, keys))
+            flat_g, unravel = jax.flatten_util.ravel_pytree(
+                jax.tree.map(lambda g: g / (gas * scale), grads))
+            local_bad = ~jnp.isfinite(jnp.sum(jnp.abs(flat_g)))
+            overflow = jax.lax.pmax(local_bad.astype(jnp.int32), dp_axes).astype(bool)
+
+            # count reverts on overflow-skipped steps (the baseline path
+            # reverts the whole opt_state; schedules must not drift)
+            count = jnp.where(overflow, opt_state.count, opt_state.count + 1)
+            step_lr = lr(count) if callable(lr) else lr
+            flat_m, _ = jax.flatten_util.ravel_pytree(opt_state.exp_avg)
+            flat_v, _ = jax.flatten_util.ravel_pytree(opt_state.exp_avg_sq)
+            flat_p, _ = jax.flatten_util.ravel_pytree(params)
+
+            m_local = b1 * flat_m + (1 - b1) * flat_g
+            m_avg, ew_new, es_new = compressed_allreduce(m_local, ew[0], es[0], dp_axes, world)
+            upd = m_avg / (jnp.sqrt(flat_v) + eps)
+            if wd > 0.0:
+                upd = upd + wd * flat_p
+            flat_p_new = flat_p - step_lr * upd
+
+            keep = lambda new, old: jnp.where(overflow, old, new)
+            flat_p_new = keep(flat_p_new, flat_p)
+            m_avg = keep(m_avg, flat_m)
+            ew_new = keep(ew_new, ew[0])
+            es_new = keep(es_new, es[0])
+
+            new_params = unravel(flat_p_new)
+            new_opt = opt_state._replace(count=count, exp_avg=unravel(m_avg))
+            loss = jax.lax.pmean(losses.mean(), dp_axes)
+            gnorm = jnp.sqrt(jnp.sum(jnp.square(m_avg)))  # compressed-momentum norm
+            return new_params, new_opt, ew_new[None], es_new[None], loss, gnorm, overflow
+
+        p_specs = jax.tree.map(lambda _: P(), self.state.params)
+        opt_specs = jax.tree.map(lambda _: P(), self.state.opt_state)
+        in_specs = (p_specs, opt_specs, P(dp_axes), P(dp_axes), batch_in_specs, P(), P())
+        out_specs = (p_specs, opt_specs, P(dp_axes), P(dp_axes), P(), P(), P())
+        smapped = jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                                check_vma=False)
+
+        def step(state, errors, device_batch, rng):
+            scale = state.loss_scale.loss_scale if fp16 else jnp.float32(1.0)
+            keys = jax.random.split(rng, gas)
+            new_params, new_opt, ew, es, loss, gnorm, overflow = smapped(
+                state.params, state.opt_state, errors[0], errors[1], device_batch, keys, scale)
+            new_ls = self._ls_update(state.loss_scale, overflow)
+            new_state = TrainState(step=state.step + 1, params=new_params, opt_state=new_opt,
+                                   loss_scale=new_ls)
+            metrics = {"loss": loss, "grad_norm": gnorm, "overflow": overflow,
+                       "loss_scale": new_ls.loss_scale}
+            return new_state, (ew, es), metrics
+
+        self._onebit_step_fn = jax.jit(step, donate_argnums=(0, 1))
 
     def _build_offload_step_fns(self, grad_shardings):
         """Device side of the offload path: fwd+bwd+clip only; the update
@@ -502,6 +622,33 @@ class DeepSpeedEngine:
         if want_qcomm and not self._use_qcomm:
             log_dist("ZeRO++ quantized communication requires a pure-DP mesh without "
                      "MoE/offload; falling back to QDQ numerics (no wire-byte savings)")
+
+        # 1-bit Adam compressed collective (reference compressed_allreduce,
+        # runtime/comm/nccl.py:51): after freeze_step the DP exchange becomes
+        # packed sign bits of the momentum — needs replicated params/opt
+        # state (stage 0) on a pure-DP mesh
+        self._onebit_cfg = None
+        self._onebit_step_fn = None
+        self._onebit_errors = None
+        if cfg.optimizer_name == C.ONEBIT_ADAM_OPTIMIZER and self.client_optimizer is None:
+            op = dict(cfg.optimizer_params or {})
+            if self._onebit_comm_eligible():
+                self._onebit_cfg = dict(
+                    # the schedule (when configured) must keep driving the lr
+                    # through the compression phase
+                    lr=self.lr_scheduler if self.lr_scheduler is not None else op.get("lr", 1e-3),
+                    betas=tuple(op.get("betas", (0.9, 0.999))),
+                    eps=op.get("eps", 1e-8), weight_decay=op.get("weight_decay", 0.0),
+                    freeze_step=int(op.get("freeze_step", 100000)))
+                log_dist(f"1-bit Adam compressed collective active after "
+                         f"freeze_step={self._onebit_cfg['freeze_step']} (1-bit wire payload)")
+                if clip > 0:
+                    log_dist("warning: gradient_clipping is not applied during the 1-bit "
+                             "compression phase (local gradients are never globally reduced; "
+                             "matches reference 1-bit Adam semantics)")
+            else:
+                log_dist("1-bit Adam compressed collective requires a pure-DP mesh at "
+                         "ZeRO stage 0; using error-feedback numerics without comm savings")
         mesh = self.mesh
 
         if getattr(self, "_offload_enabled", False):
@@ -657,6 +804,13 @@ class DeepSpeedEngine:
         rng = jax.random.fold_in(self._base_rng, self.global_steps)
         if getattr(self, "_host_opt", None) is not None:
             _, metrics = self._offload_train_batch(device_batch, rng)
+        elif (self._onebit_cfg is not None
+              and self.global_steps >= self._onebit_cfg["freeze_step"]):
+            # compression phase: momentum rides the 1-bit collective
+            if self._onebit_step_fn is None:
+                self._build_onebit_step_fn(device_batch)
+            self.state, self._onebit_errors, metrics = self._onebit_step_fn(
+                self.state, self._onebit_errors, device_batch, rng)
         else:
             self.state, metrics = self._train_step_fn(self.state, device_batch, rng)
         self.global_steps += 1

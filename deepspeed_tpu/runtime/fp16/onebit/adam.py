@@ -5,14 +5,15 @@ Reference ``runtime/fp16/onebit/adam.py:307``: run vanilla Adam for a
 the *sign* of the momentum with an error-feedback buffer (compensation for
 the quantization error), cutting DP gradient traffic ~32×.
 
-TPU design: the optimizer semantics live here as an optax transform carried
-in the sharded train state. In the compression phase the momentum update is
-``sign(m + e) * scale`` with ``e`` the carried compensation error — this is
-mathematically the all-reduced compressed momentum when gradients are
-already mean-reduced by the engine (the engine reduces grads before the
-optimizer, so compression here reproduces the reference's post-allreduce
-server-averaged momentum; a shard_map sign-compressed collective variant
-is the comm-bound optimization path).
+TPU design: two cooperating pieces.
+
+* This optax transform carries the optimizer semantics (warmup, frozen
+  variance, error-feedback compression numerics) for any mesh/stage.
+* On pure-DP stage-0 meshes the ENGINE switches, at ``freeze_step``, to a
+  shard_map step (``engine._build_onebit_step_fn``) whose only cross-device
+  traffic is the two-phase 1-bit compressed momentum allreduce
+  (``runtime/comm/compressed.py`` — packed sign bits + per-chunk scales on
+  the wire, the reference's ~32× DP-traffic cut).
 """
 
 from typing import Any, NamedTuple, Tuple
@@ -36,7 +37,13 @@ def onebit_adam(lr=1e-3,
                 weight_decay: float = 0.0,
                 cuda_aware: bool = False,
                 comm_backend_name: str = "ici",
+                external_comm: bool = False,
                 **_ignored) -> optax.GradientTransformation:
+    """``external_comm=True``: the engine owns the compression phase via the
+    real 1-bit collective (``engine._build_onebit_step_fn``), so this
+    transform only needs exact warmup-Adam semantics — it skips the internal
+    QDQ compression and allocates no error-feedback buffers (a full
+    parameter-size fp32 tree otherwise carried dead through every step)."""
     b1, b2 = betas
 
     def init(params):
@@ -44,7 +51,7 @@ def onebit_adam(lr=1e-3,
         return OnebitAdamState(count=jnp.zeros([], jnp.int32),
                                exp_avg=zeros(),
                                exp_avg_sq=zeros(),
-                               error_feedback=zeros())
+                               error_feedback=() if external_comm else zeros())
 
     def update(grads, state, params=None):
         assert params is not None
@@ -56,6 +63,18 @@ def onebit_adam(lr=1e-3,
         # variance updates only during warmup (then frozen)
         exp_avg_sq = jax.tree.map(
             lambda v, g: jnp.where(warmup, b2 * v + (1 - b2) * jnp.square(g), v), state.exp_avg_sq, grads)
+
+        if external_comm:
+            # exact Adam with frozen variance; engine handles compression
+            def _direction_ext(m, v, p):
+                upd = m / (jnp.sqrt(v) + eps)
+                if weight_decay > 0.0:
+                    upd = upd + weight_decay * p
+                return -step_lr * upd
+
+            updates = jax.tree.map(_direction_ext, exp_avg, exp_avg_sq, params)
+            return updates, OnebitAdamState(count=count, exp_avg=exp_avg,
+                                            exp_avg_sq=exp_avg_sq, error_feedback=())
 
         def _compressed(m, e):
             # sign compression with error feedback: scale preserves l1 mass

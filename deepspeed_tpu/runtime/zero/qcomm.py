@@ -192,8 +192,17 @@ def qcomm_accumulate(loss_for, mesh, param_specs, grad_specs, batch, batch_spec,
             dim = _axis_dim(spec, fsdp_axis)
             if dim is None or fsdp_size == 1:
                 return shard
-            if quantized_weights:
+            # matrix-shaped floating leaves only — 1-D bias/norm params stay
+            # exact (same exemption as the QDQ fallback,
+            # engine._quantize_gathered_weights), gathered in full precision
+            if quantized_weights and shard.ndim >= 2 and jnp.issubdtype(shard.dtype, jnp.floating):
                 return quantized_allgather(shard, dim, fsdp_axis, fsdp_size, group_size)
+            if shard.ndim < 2 or not jnp.issubdtype(shard.dtype, jnp.floating):
+                gathered = jax.lax.all_gather(shard, fsdp_axis)
+                vals = jnp.moveaxis(gathered, 0, dim)
+                shape = list(shard.shape)
+                shape[dim] = shard.shape[dim] * fsdp_size
+                return vals.reshape(shape)
             # unquantized gather rides the wire at the engine's compute dtype
             # (what GSPMD would emit after sinking the cast below the gather);
             # fp32 compute keeps full precision on the wire

@@ -9,6 +9,10 @@ platform flags. This must run before the first ``import jax`` anywhere.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: the session may preset a TPU platform
+# the persistent-cache AOT loader logs a giant spurious machine-feature
+# mismatch (XLA's prefer-no-scatter tuning flags are not real CPU features);
+# keep stderr readable
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -17,19 +17,25 @@ from deepspeed_tpu.parallel.topology import MeshTopology
 
 _DTYPE_BYTES = {"f64": 8, "s64": 8, "u64": 8, "f32": 4, "s32": 4, "u32": 4,
                 "bf16": 2, "f16": 2, "s16": 2, "u16": 2, "s8": 1, "u8": 1, "pred": 1}
-_COLL_RE = re.compile(
-    r"(\w+)\[([\d,]*)\]\S*\s+(?:all-to-all|all-gather|all-reduce|reduce-scatter)\(")
+_OP_RE = re.compile(r"=\s+(.*?)\s+(?:all-to-all|all-gather|all-reduce|reduce-scatter"
+                    r"|collective-permute)(?:-start)?\(")
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
 
 
 def collective_payload_bytes(hlo_text: str) -> int:
-    """Sum result-payload bytes of every collective op in optimized HLO."""
+    """Sum result-payload bytes of every collective op in optimized HLO.
+    Handles both array-typed and tuple-typed (coalesced) collectives."""
     total = 0
-    for dtype, dims in _COLL_RE.findall(hlo_text):
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d)
-        total += n * _DTYPE_BYTES.get(dtype, 4)
+    for line in hlo_text.splitlines():
+        m = _OP_RE.search(line)
+        if not m:
+            continue
+        for dtype, dims in _SHAPE_RE.findall(m.group(1)):
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            total += n * _DTYPE_BYTES.get(dtype, 4)
     return total
 
 
