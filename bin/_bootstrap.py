@@ -1,0 +1,10 @@
+"""Shared path shim for the bin/ scripts: allow running from a source
+checkout without installation (bin/ itself is sys.path[0] when a script
+runs, so `import _bootstrap` resolves here)."""
+
+import os
+import sys
+
+_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if os.path.isdir(os.path.join(_repo, "deepspeed_tpu")) and _repo not in sys.path:
+    sys.path.insert(0, _repo)

@@ -2,4 +2,11 @@
 
 from deepspeed_tpu.ops.op_builder.builder import AsyncIOBuilder, CPUAdamBuilder, OpBuilder
 
-__all__ = ["OpBuilder", "CPUAdamBuilder", "AsyncIOBuilder"]
+# registry for ds_report's compatibility matrix (reference ALL_OPS,
+# op_builder/all_ops.py)
+ALL_BUILDERS = {
+    CPUAdamBuilder.NAME: CPUAdamBuilder,
+    AsyncIOBuilder.NAME: AsyncIOBuilder,
+}
+
+__all__ = ["OpBuilder", "CPUAdamBuilder", "AsyncIOBuilder", "ALL_BUILDERS"]

@@ -802,6 +802,10 @@ class DeepSpeedEngine:
         self.timers(TRAIN_BATCH_TIMER).start()
         device_batch = self._shard_batch(batch, with_gas_dim=True)
         rng = jax.random.fold_in(self._base_rng, self.global_steps)
+        fp_cfg = self.config.flops_profiler_config
+        profiling_now = fp_cfg.enabled and self.global_steps + 1 == fp_cfg.profile_step
+        if profiling_now:
+            t_profile = time.time()
         if getattr(self, "_host_opt", None) is not None:
             _, metrics = self._offload_train_batch(device_batch, rng)
         elif (self._onebit_cfg is not None
@@ -816,8 +820,20 @@ class DeepSpeedEngine:
         self.global_steps += 1
         self.global_samples += self.config.train_batch_size
         self.micro_steps += self.config.gradient_accumulation_steps
+        if profiling_now:
+            jax.block_until_ready(metrics["loss"])
+            step_latency = time.time() - t_profile
         self.timers(TRAIN_BATCH_TIMER).stop()
         self.tput_timer.stop(global_step=True)
+        if profiling_now:
+            # reference hooks the profiler at flops_profiler_profile_step
+            # (engine.py:1721,2121); here the compiled step IS the profile.
+            # Runs after the timers close so profiler-induced (re)compiles
+            # don't pollute the step's recorded throughput.
+            from deepspeed_tpu.profiling.flops_profiler.profiler import profile_engine_step
+            profile_engine_step(self, device_batch, rng,
+                                step_latency_s=step_latency,
+                                output_file=fp_cfg.output_file)
         self._post_step(metrics)
         return metrics["loss"]
 

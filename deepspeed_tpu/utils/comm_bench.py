@@ -1,0 +1,75 @@
+"""Collective micro-benchmark backing ``bin/ds_bench`` (the reference's
+``bin/ds_bench`` drives the DeepSpeedExamples communication benchmark:
+allreduce/allgather bandwidth sweeps over message sizes).
+
+Sweeps ``psum`` / ``all_gather`` / ``psum_scatter`` over the available mesh
+and prints achieved algorithmic bandwidth per size. On a CPU test mesh this
+validates the harness; on a TPU slice the numbers are ICI bandwidth.
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+
+def run_bench(op: str = "all_reduce", sizes=None, trials: int = 5, warmup: int = 2,
+              dtype: str = "float32"):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devices = jax.devices()
+    n = len(devices)
+    mesh = Mesh(np.array(devices), ("dp",))
+    sizes = sizes or [2**p for p in range(12, 27, 2)]  # 4KB .. 512MB fp32 elems
+    jdtype = jnp.dtype(dtype)
+    print(f"# ds_bench op={op} devices={n} backend={jax.default_backend()} dtype={dtype}")
+    print(f"{'bytes':>14} {'time_ms':>10} {'alg_GBps':>10} {'bus_GBps':>10}")
+
+    for numel in sizes:
+        x = jnp.ones((n, numel), jdtype)
+        if op == "all_reduce":
+            fn = jax.shard_map(lambda a: jax.lax.psum(a, "dp"), mesh=mesh,
+                               in_specs=P("dp"), out_specs=P("dp"))
+            bus_factor = 2 * (n - 1) / n
+        elif op == "all_gather":
+            fn = jax.shard_map(lambda a: jax.lax.all_gather(a, "dp"), mesh=mesh,
+                               in_specs=P("dp"), out_specs=P("dp"))
+            bus_factor = (n - 1) / n
+        elif op == "reduce_scatter":
+            fn = jax.shard_map(lambda a: jax.lax.psum_scatter(a[0], "dp", tiled=True)[None],
+                               mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+            bus_factor = (n - 1) / n
+        else:
+            raise ValueError(f"unknown op {op!r}")
+        fn_jit = jax.jit(fn)
+        for _ in range(warmup):
+            jax.block_until_ready(fn_jit(x))
+        t0 = time.perf_counter()
+        for _ in range(trials):
+            out = fn_jit(x)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / trials
+        nbytes = numel * jdtype.itemsize
+        alg_bw = nbytes / dt / 1e9
+        print(f"{nbytes:>14,} {dt * 1e3:>10.3f} {alg_bw:>10.2f} {alg_bw * bus_factor:>10.2f}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="DeepSpeed-TPU collective micro-benchmark")
+    p.add_argument("--op", default="all_reduce",
+                   choices=["all_reduce", "all_gather", "reduce_scatter"])
+    p.add_argument("--trials", type=int, default=5)
+    p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--dtype", default="float32")
+    p.add_argument("--maxsize", type=int, default=26, help="max message size = 2^N elements")
+    args = p.parse_args(argv)
+    run_bench(op=args.op, sizes=[2**q for q in range(12, args.maxsize + 1, 2)],
+              trials=args.trials, warmup=args.warmup, dtype=args.dtype)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())
