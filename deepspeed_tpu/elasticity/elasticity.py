@@ -3,9 +3,13 @@
 ``:126``, ``compute_elastic_config`` ``:233``).
 
 Same algorithm, TPU vocabulary: "gpus" → chips, node = TPU host (the v0.2
-granularity constraint maps to chips-per-host). Elastic *recovery* is the
-checkpoint-reshape path (``deepspeed_tpu/checkpoint``): resharding a saved
-state onto a different mesh is how a TPU job resumes at a new world size.
+granularity constraint maps to chips-per-host). Wiring: ``DeepSpeedConfig``
+applies the elastic plan to the batch triangle when the block is enabled
+(``runtime/config.py:_apply_elastic_config``), and ``bin/ds_elastic``
+explores valid chip counts offline. Elastic *recovery* is the
+checkpoint-reshape path (orbax cross-topology restore,
+``runtime/checkpoint_engine/orbax_engine.py``): resharding a saved state
+onto a different mesh is how a TPU job resumes at a new world size.
 """
 
 import math

@@ -96,6 +96,10 @@ class DeepSpeedConfig:
         self.mesh_config = MeshConfig(**self._param_dict.get(C.MESH, {}))
         self._raw_batch_triangle = (self.train_batch_size, self.train_micro_batch_size_per_gpu,
                                     self.gradient_accumulation_steps)
+        # what the USER wrote, before any elastic override — re-resolving at
+        # a new world size must validate/recompute against this, not against
+        # a previously-applied elastic plan
+        self._user_batch_triangle = self._raw_batch_triangle
         if dp_world_size is not None:
             self.resolve_batch_for_dp(dp_world_size)
         else:
@@ -192,6 +196,11 @@ class DeepSpeedConfig:
         """Re-run the triangle for an explicit DP world size (used when an
         explicit MeshTopology overrides the config's mesh block)."""
         self.dp_world_size = dp_world_size
+        if self.elasticity_enabled():
+            # elastic training overrides the batch triangle from the
+            # elasticity block (reference runtime/config.py elasticity
+            # handling → elasticity/elasticity.py:233 compute_elastic_config)
+            self._apply_elastic_config(dp_world_size)
         train_batch, micro_batch, grad_acc = self._raw_batch_triangle
 
         if train_batch is not None and micro_batch is not None and grad_acc is not None:
@@ -217,6 +226,29 @@ class DeepSpeedConfig:
         self.train_micro_batch_size_per_gpu = micro_batch
         self.gradient_accumulation_steps = grad_acc
         self._batch_assertion()
+
+    def elasticity_enabled(self) -> bool:
+        return bool(self.elasticity_config.get("enabled", False))
+
+    def _apply_elastic_config(self, dp_world_size: int):
+        """Resolve the elastic batch plan for the current chip count and
+        override the batch triangle (reference config.py + ds_elastic)."""
+        from deepspeed_tpu.elasticity import ElasticityConfigError, compute_elastic_config
+        from deepspeed_tpu.version import __version__
+
+        explicit = [v for v in self._user_batch_triangle if v is not None]
+        if explicit and not self.elasticity_config.get("ignore_non_elastic_batch_info", False):
+            raise ElasticityConfigError(
+                "elasticity is enabled but train_batch_size/micro_batch/gas are also set; "
+                "remove them or set elasticity.ignore_non_elastic_batch_info "
+                "(reference elasticity/elasticity.py same check)")
+        final_batch, valid, micro = compute_elastic_config(
+            {"elasticity": self.elasticity_config}, __version__,
+            world_size=dp_world_size, return_microbatch=True)
+        gas = final_batch // (micro * dp_world_size)
+        logger.info(f"elasticity: world={dp_world_size} -> train_batch={final_batch} "
+                    f"micro={micro} gas={gas} (valid chip counts: {sorted(valid)[:8]}...)")
+        self._raw_batch_triangle = (final_batch, micro, gas)
 
     def _batch_assertion(self):
         train_batch = self.train_batch_size
