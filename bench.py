@@ -45,23 +45,30 @@ def run_child():
     import deepspeed_tpu
     from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
 
+    import jax.numpy as jnp
+
     model_name = os.environ.get("BENCH_MODEL", "350m")
     micro_bs = int(os.environ.get("BENCH_MICRO_BS", "4"))
     seq = int(os.environ.get("BENCH_SEQ", "1024"))
     steps = int(os.environ.get("BENCH_STEPS", "10"))
+    remat = os.environ.get("BENCH_REMAT", "0") == "1"
 
     n_dev = jax.device_count()
     attn = os.environ.get("BENCH_ATTN", "flash" if jax.default_backend() in ("tpu", "axon") else "xla")
-    cfg_model = get_gpt2_config(model_name, n_positions=seq, remat=True, attention_backend=attn)
+    # compute in bf16 end-to-end: without an explicit dtype the flax modules
+    # force fp32 compute even though the engine casts params to bf16
+    cfg_model = get_gpt2_config(model_name, n_positions=seq, remat=remat,
+                                attention_backend=attn, dtype=jnp.bfloat16)
     model = GPT2LMHeadModel(cfg_model)
 
+    zero_stage = int(os.environ.get("BENCH_ZERO", "1" if n_dev > 1 else "0"))
     ds_config = {
         "train_batch_size": micro_bs * n_dev,
         "gradient_accumulation_steps": 1,
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.01}},
         "bf16": {"enabled": True},
         "gradient_clipping": 1.0,
-        "zero_optimization": {"stage": 1 if n_dev > 1 else 0},
+        "zero_optimization": {"stage": zero_stage},
         "steps_per_print": 10**9,
     }
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds_config)

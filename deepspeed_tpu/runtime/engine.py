@@ -162,6 +162,12 @@ class DeepSpeedEngine:
         self._eval_step_fn = None
         self._micro_grad_fn = None
         self._apply_grads_fn = None
+        # defaults live here (not in _build_step_fns) because subclasses
+        # override _build_step_fns but the base train_batch reads these
+        self._onebit_cfg = None
+        self._onebit_step_fn = None
+        self._onebit_errors = None
+        self._use_qcomm = False
 
         log_dist(f"DeepSpeedEngine: zero_stage={config.zero_optimization_stage} "
                  f"dtype={self.compute_dtype.__name__} mesh={dict(self.mesh.shape)}")
