@@ -1,0 +1,69 @@
+"""Autotuning configuration (reference ``autotuning/config.py`` +
+``autotuning/constants.py``).
+
+Field names mirror the reference's ``"autotuning"`` config block so user
+configs port unchanged; TPU-only knobs (``mem_budget_bytes``, ``measure``,
+``remat``) are additive.
+"""
+
+from typing import Any, Dict, List, Optional, Union
+
+from pydantic import BaseModel, ConfigDict
+
+AUTOTUNING = "autotuning"
+
+AUTOTUNING_METRIC_LATENCY = "latency"
+AUTOTUNING_METRIC_THROUGHPUT = "throughput"
+AUTOTUNING_METRIC_FLOPS = "flops"
+
+AUTOTUNING_TUNER_GRIDSEARCH = "gridsearch"
+AUTOTUNING_TUNER_RANDOM = "random"
+AUTOTUNING_TUNER_MODELBASED = "model_based"
+
+
+class DeepSpeedAutotuningConfig(BaseModel):
+    """Typed ``"autotuning"`` block (reference ``DeepSpeedAutotuningConfig``,
+    ``autotuning/config.py:11``)."""
+
+    enabled: bool = False
+    fast: bool = True
+    results_dir: str = "autotuning_results"
+    exps_dir: str = "autotuning_exps"
+    overwrite: bool = True
+    start_profile_step: int = 3
+    end_profile_step: int = 5
+    metric: str = AUTOTUNING_METRIC_THROUGHPUT
+    tuner_type: str = AUTOTUNING_TUNER_GRIDSEARCH
+    tuner_early_stopping: int = 5
+    tuner_num_trials: int = 50
+    mp_size: int = 1
+    max_train_batch_size: Optional[int] = None
+    min_train_batch_size: int = 1
+    max_train_micro_batch_size_per_gpu: int = 1024
+    min_train_micro_batch_size_per_gpu: int = 1
+    num_tuning_micro_batch_sizes: int = 3
+    # reference-only knobs, accepted so ported configs don't fail validation
+    # (process-launch experiment plumbing has no TPU analog)
+    arg_mappings: Optional[Dict[str, Any]] = None
+    metric_path: Optional[str] = None
+    model_info: Optional[Dict[str, Any]] = None
+    model_info_path: Optional[str] = None
+    # which ZeRO stages to explore; "all" or explicit list. The reference
+    # derives this from the user config's zero stage (autotuner.py:432).
+    zero_stages: Union[str, List[int]] = "all"
+
+    # ---- TPU-native knobs (no reference analog) ----
+    # Device memory budget for pruning compiled candidates; default = the
+    # device's bytes_limit. Tests set a small budget to exercise pruning.
+    mem_budget_bytes: Optional[int] = None
+    # measure=False ranks purely on the XLA roofline cost model (compile
+    # only — no buffers are allocated, usable without idle hardware time)
+    measure: bool = True
+    # how many compile-survivors get real timed steps
+    top_k: int = 3
+
+    model_config = ConfigDict(extra="ignore")
+
+
+def get_autotuning_config(param_dict: Dict[str, Any]) -> DeepSpeedAutotuningConfig:
+    return DeepSpeedAutotuningConfig(**(param_dict.get(AUTOTUNING) or {}))

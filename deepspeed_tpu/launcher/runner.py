@@ -22,7 +22,8 @@ from typing import Dict
 from deepspeed_tpu.utils.logging import logger
 
 DLTS_HOSTFILE = "/job/hostfile"
-EXPORT_ENVS = ["PYTHONPATH", "PATH", "JAX_PLATFORMS", "TPU_CHIPS_PER_HOST_BOUNDS", "XLA_FLAGS"]
+EXPORT_ENVS = ["PYTHONPATH", "PATH", "JAX_PLATFORMS", "TPU_CHIPS_PER_HOST_BOUNDS", "XLA_FLAGS",
+               "DS_AUTOTUNING"]
 
 
 def parse_args(args=None):
@@ -44,6 +45,10 @@ def parse_args(args=None):
     parser.add_argument("--launcher_args", type=str, default="")
     parser.add_argument("--force_multi", action="store_true")
     parser.add_argument("--elastic_training", action="store_true")
+    parser.add_argument("--autotuning", type=str, default="", choices=["", "tune", "run"],
+                        help="Run the autotuner before training: 'tune' writes the optimal "
+                             "config and exits; 'run' continues training under it "
+                             "(reference runner.py:358)")
     parser.add_argument("user_script", type=str, help="training script to launch")
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
     return parser.parse_args(args)
@@ -125,6 +130,9 @@ def encode_world_info(resource_pool: Dict[str, int]) -> str:
 
 def main(args=None):
     args = parse_args(args)
+    if args.autotuning:
+        # the in-process tuner engages at the engine's first batch
+        os.environ["DS_AUTOTUNING"] = args.autotuning
     resource_pool = fetch_hostfile(args.hostfile)
 
     if not resource_pool:

@@ -43,6 +43,18 @@ class BF16Config(DeepSpeedConfigModel):
     enabled: bool = False
 
 
+class HybridEngineConfig(DeepSpeedConfigModel):
+    """RLHF train+serve engine knobs (reference ``runtime/config.py:523``).
+    ``pin_parameters``/``tp_gather_partition_size`` are accepted for config
+    parity; XLA owns buffer pinning and gather granularity on TPU."""
+    enabled: bool = False
+    max_out_tokens: int = 512
+    inference_tp_size: int = 1
+    release_inference_cache: bool = False
+    pin_parameters: bool = True
+    tp_gather_partition_size: int = 8
+
+
 class ActivationCheckpointingConfig(DeepSpeedConfigModel):
     """Reference ``runtime/activation_checkpointing/config.py`` keys. On TPU
     rematerialization is `jax.checkpoint` policies; partition_activations
@@ -106,6 +118,12 @@ class DeepSpeedConfig:
             self._resolve_batch_size(world_size)
         self._do_sanity_check()
 
+    @property
+    def raw_dict(self):
+        """The user's config dict as parsed (autotuning re-derives candidate
+        configs from this, not from the resolved fields)."""
+        return self._param_dict
+
     # ------------------------------------------------------------------
     def _initialize_params(self, param_dict):
         self.train_batch_size = get_scalar_param(param_dict, C.TRAIN_BATCH_SIZE, C.TRAIN_BATCH_SIZE_DEFAULT)
@@ -168,6 +186,7 @@ class DeepSpeedConfig:
         self.flops_profiler_config = get_flops_profiler_config(param_dict)
         self.comms_config = DeepSpeedCommsConfig(param_dict)
         self.checkpoint_config = CheckpointConfig(**param_dict.get(C.CHECKPOINT, {}))
+        self.hybrid_engine_config = HybridEngineConfig(**param_dict.get("hybrid_engine", {}))
         self.autotuning_config = param_dict.get(C.AUTOTUNING, {})
         self.elasticity_config = param_dict.get(C.ELASTICITY, {})
         self.compression_config = param_dict.get(C.COMPRESSION_TRAINING, {})

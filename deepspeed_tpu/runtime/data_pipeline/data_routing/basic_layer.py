@@ -1,0 +1,57 @@
+"""Random-LTD (random layer token drop, arXiv:2211.11586) — reference
+``data_pipeline/data_routing/basic_layer.py:14`` ``RandomLayerTokenDrop``.
+
+TPU formulation: the reserved sequence length is a *static* argument (XLA
+needs static shapes), so the scheduler's ``seq_per_step`` granularity doubles
+as the recompile bucket. The gather is ``jnp.take_along_axis`` and the
+scatter is a functional ``.at[].set`` — the analogs of the reference's
+``GatherTokens``/``ScatterTokens`` custom autograd ops, with the VJP coming
+for free from JAX.
+"""
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+def gpt_sample_tokens(rng: jax.Array, batch: int, seq: int, reserved: int) -> jax.Array:
+    """Per-sample sorted random token indices (reference
+    ``ops/random_ltd/dropping_utils.py`` ``gpt_sample_tokens``; sorted keeps
+    causal attention valid on the kept subsequence)."""
+    keys = jax.random.split(rng, batch)
+    idx = jax.vmap(lambda k: jax.random.choice(k, seq, (reserved,), replace=False))(keys)
+    return jnp.sort(idx, axis=-1)
+
+
+class RandomLayerTokenDrop(nn.Module):
+    """Wrap a transformer layer so only ``reserved_length`` random tokens
+    pass through it during training; the rest skip the layer unchanged."""
+
+    layer: nn.Module
+    rng_collection: str = "random_ltd"
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True, *, reserved_length: int = -1,
+                 sampled_indices=None, **kwargs):
+        full_len = x.shape[1]
+        if deterministic or reserved_length < 0 or reserved_length >= full_len:
+            return self.layer(x, deterministic, **kwargs)
+
+        if sampled_indices is None:
+            # layer 0 samples; later layers reuse via sampled_indices
+            # (reference basic_layer.py:77-87 shares indices across layers)
+            rng = self.make_rng(self.rng_collection)
+            sampled_indices = gpt_sample_tokens(rng, x.shape[0], full_len, reserved_length)
+
+        part = jnp.take_along_axis(x, sampled_indices[:, :, None], axis=1)
+        out = self.layer(part, deterministic, **kwargs)
+        aux = None
+        if isinstance(out, tuple):
+            out, aux = out[0], out[1:]
+        b = jnp.arange(x.shape[0])[:, None]
+        x = x.at[b, sampled_indices].set(out.astype(x.dtype))
+        if aux is not None:
+            return (x,) + aux
+        return x

@@ -1,0 +1,139 @@
+"""Memory-mapped indexed token dataset (reference
+``data_pipeline/data_sampling/indexed_dataset.py:617`` ``MMapIndexedDataset``).
+
+Same capability — O(1) random access to variable-length token sequences from
+two flat files without loading them — but a fresh, minimal format rather
+than the Megatron binary layout the reference inherits:
+
+``<prefix>.bin``  raw tokens, back to back.
+``<prefix>.idx``  header (magic, version, dtype code, count) + ``sizes``
+                  (u32 per sequence) + ``pointers`` (u64 element offsets).
+
+Reads are ``np.memmap`` slices — the OS page cache is the shard buffer,
+which is the right model for a TPU host feeding ``device_put``.
+"""
+
+import os
+import struct
+from typing import Sequence, Union
+
+import numpy as np
+
+_MAGIC = b"DSTPUIDX"
+_VERSION = 1
+
+# stable on-disk dtype codes (reference ``dtypes`` table indexed_dataset.py:117)
+_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32, 5: np.int64,
+           6: np.float32, 7: np.float64, 8: np.uint16, 9: np.uint32}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def find_fit_int_dtype(low: int, high: int):
+    """Smallest integer dtype covering [low, high] (reference
+    ``data_sampling/utils.py`` helper of the same name)."""
+    for dt in (np.uint8, np.uint16, np.uint32) if low >= 0 else ():
+        if high <= np.iinfo(dt).max:
+            return dt
+    for dt in (np.int8, np.int16, np.int32, np.int64):
+        if np.iinfo(dt).min <= low and high <= np.iinfo(dt).max:
+            return dt
+    raise ValueError(f"no integer dtype fits [{low}, {high}]")
+
+
+def data_file_path(prefix: str) -> str:
+    return prefix + ".bin"
+
+
+def index_file_path(prefix: str) -> str:
+    return prefix + ".idx"
+
+
+class MMapIndexedDatasetBuilder:
+    """Streaming writer (reference ``MMapIndexedDatasetBuilder``
+    indexed_dataset.py:570)."""
+
+    def __init__(self, out_file_prefix: str, dtype=np.int32):
+        self._prefix = out_file_prefix
+        self._dtype = np.dtype(dtype)
+        assert self._dtype in _DTYPE_CODES, f"unsupported dtype {dtype}"
+        self._bin = open(data_file_path(out_file_prefix), "wb")
+        self._sizes = []
+
+    def add_item(self, tokens: Union[Sequence[int], np.ndarray]) -> None:
+        arr = np.asarray(tokens, dtype=self._dtype)
+        assert arr.ndim == 1, "items are 1-D token sequences"
+        self._bin.write(arr.tobytes(order="C"))
+        self._sizes.append(len(arr))
+
+    def merge_file_(self, other_prefix: str) -> None:
+        """Append another dataset with the same dtype (reference :595)."""
+        other = MMapIndexedDataset(other_prefix)
+        assert other._dtype == self._dtype, "dtype mismatch in merge"
+        with open(data_file_path(other_prefix), "rb") as f:
+            while True:
+                chunk = f.read(1 << 22)
+                if not chunk:
+                    break
+                self._bin.write(chunk)
+        self._sizes.extend(other.sizes.tolist())
+
+    def finalize(self) -> None:
+        self._bin.close()
+        sizes = np.asarray(self._sizes, dtype=np.uint32)
+        pointers = np.zeros(len(sizes) + 1, dtype=np.uint64)
+        np.cumsum(sizes, out=pointers[1:])
+        with open(index_file_path(self._prefix), "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<IBQ", _VERSION, _DTYPE_CODES[self._dtype], len(sizes)))
+            f.write(sizes.tobytes(order="C"))
+            f.write(pointers.tobytes(order="C"))
+
+
+class MMapIndexedDataset:
+    """Zero-copy random-access reader (reference ``MMapIndexedDataset``
+    indexed_dataset.py:420)."""
+
+    def __init__(self, path_prefix: str):
+        self._prefix = path_prefix
+        with open(index_file_path(path_prefix), "rb") as f:
+            magic = f.read(len(_MAGIC))
+            assert magic == _MAGIC, f"{index_file_path(path_prefix)}: bad magic {magic!r}"
+            version, code, count = struct.unpack("<IBQ", f.read(13))
+            assert version == _VERSION, f"unsupported index version {version}"
+            self._dtype = np.dtype(_DTYPES[code])
+            offset = f.tell()
+        self._sizes = np.memmap(index_file_path(path_prefix), dtype=np.uint32,
+                                mode="r", offset=offset, shape=(count,))
+        self._pointers = np.memmap(index_file_path(path_prefix), dtype=np.uint64,
+                                   mode="r", offset=offset + 4 * count, shape=(count + 1,))
+        self._data = np.memmap(data_file_path(path_prefix), dtype=self._dtype, mode="r")
+
+    def __len__(self) -> int:
+        return len(self._sizes)
+
+    @property
+    def sizes(self) -> np.ndarray:
+        return self._sizes
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    def __getitem__(self, idx):
+        if isinstance(idx, (int, np.integer)):
+            start, end = int(self._pointers[idx]), int(self._pointers[idx + 1])
+            return np.asarray(self._data[start:end])
+        if isinstance(idx, slice):
+            return [self[i] for i in range(*idx.indices(len(self)))]
+        raise TypeError(f"index must be int or slice, got {type(idx)}")
+
+    def get(self, idx: int, offset: int = 0, length: int = None) -> np.ndarray:
+        """Sub-sequence read without touching the rest (reference :512)."""
+        start = int(self._pointers[idx]) + offset
+        stop = int(self._pointers[idx + 1]) if length is None else start + length
+        return np.asarray(self._data[start:stop])
+
+    @staticmethod
+    def exists(path_prefix: str) -> bool:
+        return (os.path.exists(index_file_path(path_prefix))
+                and os.path.exists(data_file_path(path_prefix)))

@@ -74,6 +74,17 @@ def _cast_floating(tree, dtype):
     return jax.tree.map(lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p, tree)
 
 
+def _truncate_seq(batch, seqlen: int):
+    """Host-side truncation of every [batch, seq, ...] leaf to ``seqlen``
+    tokens (curriculum learning, seqlen metric)."""
+    def trunc(x):
+        x = np.asarray(x)
+        if x.ndim >= 2 and x.shape[1] > seqlen:
+            return x[:, :seqlen]
+        return x
+    return jax.tree.map(trunc, batch)
+
+
 def _global_norm(tree):
     leaves = [jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree.leaves(tree)]
     return jnp.sqrt(sum(leaves))
@@ -168,6 +179,23 @@ class DeepSpeedEngine:
         self._onebit_step_fn = None
         self._onebit_errors = None
         self._use_qcomm = False
+        self._offload_enabled = False
+        self._autotune = None  # (mode, raw config dict), set by entry.initialize
+
+        # -- curriculum learning (reference legacy surface,
+        #    _configure_curriculum_scheduler_legacy engine.py:1283): for the
+        #    seqlen metric the engine truncates batches itself — on TPU the
+        #    difficulty IS the static sequence length, so the schedule's
+        #    difficulty_step doubles as the recompile bucket
+        cl_cfg = (config.raw_dict or {}).get("curriculum_learning", {})
+        self.curriculum_scheduler = None
+        self.curriculum_metric = None
+        if cl_cfg.get("enabled", False):
+            from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+            self.curriculum_scheduler = CurriculumScheduler(cl_cfg)
+            self.curriculum_metric = cl_cfg.get("curriculum_type", "seqlen")
+            log_dist(f"curriculum learning enabled: metric={self.curriculum_metric} "
+                     f"schedule={cl_cfg.get('schedule_type')}")
 
         log_dist(f"DeepSpeedEngine: zero_stage={config.zero_optimization_stage} "
                  f"dtype={self.compute_dtype.__name__} mesh={dict(self.mesh.shape)}")
@@ -230,33 +258,61 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # state init (≅ zero.Init sharded construction, partition_parameters.py)
     # ------------------------------------------------------------------
-    def initialize_state(self, example_batch, rng: Optional[jax.Array] = None):
-        """Build the sharded TrainState directly into its final placement:
-        params are *initialized shard-by-shard on their owning devices*
-        (jit with out_shardings), never materialized replicated — the TPU
-        answer to ``zero.Init`` construction-time partitioning."""
+    def _maybe_autotune(self, example_batch):
+        """``--autotuning tune|run`` (reference ``launcher/runner.py:358``):
+        engages on the first batch, when shapes are known. ``tune`` writes
+        results and exits; ``run`` adopts the optimal config and trains on."""
+        if not self._autotune:
+            return
+        mode, raw_cfg = self._autotune
+        self._autotune = None
+        from deepspeed_tpu.autotuning import Autotuner
+        tuner = Autotuner(model=self.module, config=raw_cfg,
+                          example_batch=example_batch, topology=self.topology)
+        best = tuner.tune()
+        tuner.print_tuning_results()
+        if mode == "tune":
+            # experiments only — results are on disk for the real launch
+            # (reference exits after tuning in this mode); exit even with no
+            # winner, or the user pays for an unrequested training run
+            raise SystemExit(0 if best is not None else 1)
+        if best is None:
+            log_dist("autotuning: no runnable candidate; keeping the user config")
+            return
+        log_dist(f"autotuning: adopting {best.name} "
+                 f"(train_batch_size={best.config['train_batch_size']})")
+        self.config = DeepSpeedConfig(best.config, dp_world_size=self.topology.data_parallel_size)
+        self.optimizer = self._configure_optimizer()
+        # everything that captured the old batch triangle must follow it
+        self.tput_timer.batch_size = self.config.train_batch_size
+        if self.training_dataloader is not None:
+            self.training_dataloader = self.deepspeed_io(
+                self.training_dataloader.dataset,
+                collate_fn=getattr(self.training_dataloader, "collate_fn", None))
+            self._train_iter = None  # drop any iterator over the old loader
+
+    def _prepare_plan(self, example_batch, rng):
+        """Shared planning core for ``initialize_state`` (concrete) and
+        ``abstract_state`` (costing): ZeRO plan, shardings, offload
+        detection — identical semantics in both paths by construction.
+        Returns ``(init_params_fn, abstract_params, abstract_opt_state)``."""
         # re-pin the process-global topology: another engine constructed since
         # may have repointed it, and model layers (ring attention, MoE
         # dispatch) resolve the mesh through get_topology() at trace time
         from deepspeed_tpu.parallel.topology import set_topology
         set_topology(self.topology)
-        if self.state is not None:
-            return
-        rng = rng if rng is not None else self._base_rng
         example_ids = self._example_ids(example_batch)
 
         def init_params(key):
             variables = self.module.init(key, example_ids, deterministic=True)
             return nn.meta.unbox(variables["params"])
 
-        abstract_vars = jax.eval_shape(lambda k: self.module.init(k, example_ids, deterministic=True), rng)
-        self.plan = build_plan(abstract_vars["params"], self.config.zero_config, self.topology)
+        # the plan needs the BOXED abstract params — flax logical-axis
+        # metadata (nn.Partitioned) is what maps params onto mesh axes
+        aboxed = jax.eval_shape(lambda k: self.module.init(k, example_ids, deterministic=True), rng)
+        self.plan = build_plan(aboxed["params"], self.config.zero_config, self.topology)
         param_shardings = self.plan.param_shardings()
-
-        if self._initial_params is not None:
-            params = jax.device_put(nn.meta.unbox(self._initial_params), param_shardings)
-        else:
-            params = jax.jit(init_params, out_shardings=param_shardings)(rng)
+        aparams = jax.eval_shape(init_params, rng)
 
         off = self.config.zero_config.offload_optimizer
         self._offload_enabled = off is not None and getattr(off, "device", "none") not in (None, "none")
@@ -265,10 +321,41 @@ class DeepSpeedEngine:
             if self.fp16_enabled:
                 raise NotImplementedError("offload_optimizer with fp16 loss scaling is not "
                                           "supported; use bf16 or fp32")
-            opt_state, opt_shardings = {}, {}
+            aopt, opt_shardings = {}, {}
         else:
-            opt_shapes = jax.eval_shape(self.optimizer.init, params)
-            opt_shardings = self.plan.optstate_shardings(opt_shapes)
+            aopt = jax.eval_shape(self.optimizer.init, aparams)
+            opt_shardings = self.plan.optstate_shardings(aopt)
+
+        repl = NamedSharding(self.mesh, P())
+        self.state_shardings = TrainState(step=repl,
+                                          params=param_shardings,
+                                          opt_state=opt_shardings,
+                                          loss_scale=jax.tree.map(lambda _: repl, self._ls_state0))
+        return init_params, aparams, aopt
+
+    def initialize_state(self, example_batch, rng: Optional[jax.Array] = None):
+        """Build the sharded TrainState directly into its final placement:
+        params are *initialized shard-by-shard on their owning devices*
+        (jit with out_shardings), never materialized replicated — the TPU
+        answer to ``zero.Init`` construction-time partitioning."""
+        self._maybe_autotune(example_batch)
+        if self.state is not None:
+            from deepspeed_tpu.parallel.topology import set_topology
+            set_topology(self.topology)
+            return
+        rng = rng if rng is not None else self._base_rng
+        init_params, _, _ = self._prepare_plan(example_batch, rng)
+        param_shardings = self.state_shardings.params
+        opt_shardings = self.state_shardings.opt_state
+
+        if self._initial_params is not None:
+            params = jax.device_put(nn.meta.unbox(self._initial_params), param_shardings)
+        else:
+            params = jax.jit(init_params, out_shardings=param_shardings)(rng)
+
+        if self._offload_enabled:
+            opt_state = {}
+        else:
             opt_state = jax.jit(self.optimizer.init, out_shardings=opt_shardings)(params)
 
         repl = NamedSharding(self.mesh, P())
@@ -278,11 +365,41 @@ class DeepSpeedEngine:
                                 opt_state=opt_state,
                                 loss_scale=ls_state)
         self._setup_offload_optimizer()
-        self.state_shardings = TrainState(step=repl,
-                                          params=param_shardings,
-                                          opt_state=opt_shardings,
-                                          loss_scale=jax.tree.map(lambda _: repl, self._ls_state0))
         self._build_step_fns()
+
+    def abstract_state(self, example_batch, rng: Optional[jax.Array] = None) -> TrainState:
+        """The TrainState as a ``ShapeDtypeStruct`` pytree — plan, shardings
+        and step functions are built but NO device memory is allocated. The
+        autotuner's entry point: candidates are compiled and costed from
+        this without paying per-candidate HBM."""
+        rng = rng if rng is not None else self._base_rng
+        _, aparams, aopt = self._prepare_plan(example_batch, rng)
+        als = jax.tree.map(lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.asarray(x).dtype),
+                           self._ls_state0)
+        abstract = TrainState(step=jax.ShapeDtypeStruct((), jnp.int32),
+                              params=aparams, opt_state=aopt, loss_scale=als)
+        self._build_step_fns()
+        return abstract
+
+    def lower_train_step(self, example_batch):
+        """AOT-lower the fused train step against abstract state/batch; the
+        result's ``.compile()`` exposes XLA ``memory_analysis()`` and
+        ``cost_analysis()`` — the TPU replacement for the reference
+        autotuner's experiment launches (``autotuning/autotuner.py:1052``)."""
+        abstract = self.abstract_state(example_batch)
+        if self._offload_enabled:
+            raise NotImplementedError("lower_train_step covers the on-device step only "
+                                      "(offload_optimizer candidates cannot be costed abstractly)")
+        gas = self.config.gradient_accumulation_steps
+
+        def leaf(x):
+            x = np.asarray(x)
+            assert x.shape[0] % gas == 0, f"global batch {x.shape[0]} not divisible by GAS {gas}"
+            return jax.ShapeDtypeStruct((gas, x.shape[0] // gas) + x.shape[1:], x.dtype)
+
+        abatch = jax.tree.map(leaf, example_batch)
+        arng = jax.ShapeDtypeStruct(self._base_rng.shape, self._base_rng.dtype)
+        return self._train_step_fn.lower(abstract, abatch, arng)
 
     # ------------------------------------------------------------------
     # ZeRO-Offload / ZeRO-Infinity: optimizer states off-device
@@ -803,7 +920,22 @@ class DeepSpeedEngine:
             if it is None:
                 raise ValueError("train_batch needs a batch or a data iterator")
             batch = next(it)
+        # the autotuner must cost candidates at the FULL sequence length, not
+        # the curriculum's warm-up difficulty — tune before truncating
+        self._maybe_autotune(batch)
+        if self.curriculum_scheduler is not None and self.curriculum_metric == "seqlen":
+            seqlen = self.curriculum_scheduler.update_difficulty(self.global_steps + 1)
+            batch = _truncate_seq(batch, seqlen)
         self.initialize_state(batch)
+        leaves = jax.tree.leaves(batch)
+        if (leaves and np.ndim(leaves[0]) > 0 and jax.process_count() == 1
+                and np.shape(leaves[0])[0] != self.config.train_batch_size
+                and not getattr(self, "_warned_batch_mismatch", False)):
+            self._warned_batch_mismatch = True
+            logger.warning(f"train_batch received {np.shape(leaves[0])[0]} samples but "
+                           f"config.train_batch_size={self.config.train_batch_size} "
+                           f"(autotuning run mode changes the batch triangle — feed "
+                           f"engine.train_batch_size samples); sample accounting will drift")
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         device_batch = self._shard_batch(batch, with_gas_dim=True)
@@ -952,6 +1084,8 @@ class DeepSpeedEngine:
             "skipped_steps": self.skipped_steps,
             "client_state": client_state or {},
         }
+        if self.curriculum_scheduler is not None:
+            meta["curriculum_state"] = self.curriculum_scheduler.get_state()
         engine.save(self.state, tag, metadata=meta)
         if getattr(self, "_host_opt", None) is not None and dist.get_rank() == 0:
             # offloaded optimizer state (host masters + moments bookkeeping)
@@ -1000,4 +1134,6 @@ class DeepSpeedEngine:
         self.global_samples = meta.get("global_samples", 0)
         self.micro_steps = meta.get("micro_steps", 0)
         self.skipped_steps = meta.get("skipped_steps", 0)
+        if self.curriculum_scheduler is not None and "curriculum_state" in meta:
+            self.curriculum_scheduler.set_state(meta["curriculum_state"])
         return load_dir, meta.get("client_state", {})

@@ -50,6 +50,24 @@ def initialize(args=None,
     ds_config = DeepSpeedConfig(config,
                                 dp_world_size=topology.data_parallel_size if topology is not None else None)
     from deepspeed_tpu.runtime.pipe.module import PipelineModule
+    if ds_config.hybrid_engine_config.enabled and not isinstance(model, PipelineModule):
+        # RLHF train+serve engine (reference __init__.py:151 dispatches
+        # DeepSpeedHybridEngine when config.hybrid_engine.enabled)
+        from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+        engine = DeepSpeedHybridEngine(model=model,
+                                       config=ds_config,
+                                       optimizer=optimizer,
+                                       loss_fn=loss_fn,
+                                       lr_scheduler=lr_scheduler,
+                                       topology=topology,
+                                       model_parameters=model_parameters,
+                                       training_data=training_data,
+                                       collate_fn=collate_fn)
+        import os as _os
+        if _os.environ.get("DS_AUTOTUNING") in ("tune", "run"):
+            log_dist("warning: --autotuning is not supported for the hybrid engine; "
+                     "the flag is ignored")
+        return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
     if isinstance(model, PipelineModule):
         # reference dispatches PipelineEngine for PipelineModule models
         # (__init__.py:158)
@@ -73,6 +91,21 @@ def initialize(args=None,
                                  model_parameters=model_parameters,
                                  training_data=training_data,
                                  collate_fn=collate_fn)
+
+    # --autotuning tune|run (reference launcher/runner.py:358): the tuner
+    # needs real batch shapes, so it engages on the engine's first
+    # initialize_state — see DeepSpeedEngine._maybe_autotune
+    import os
+    mode = os.environ.get("DS_AUTOTUNING", "")
+    raw_cfg = ds_config.raw_dict
+    if not isinstance(model, PipelineModule):
+        from deepspeed_tpu.autotuning.config import get_autotuning_config
+        at = get_autotuning_config(raw_cfg)
+        if mode in ("tune", "run") or at.enabled:
+            engine._autotune = (mode or "run", dict(raw_cfg))
+    elif mode in ("tune", "run"):
+        log_dist("warning: --autotuning is not supported for PipelineModule models; "
+                 "the flag is ignored")
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 
 

@@ -1,0 +1,180 @@
+"""Hybrid (RLHF) engine: one engine that trains under ZeRO and serves
+``generate()`` (reference ``runtime/hybrid_engine.py:32``
+``DeepSpeedHybridEngine``).
+
+The reference juggles two weight layouts in place — it gathers ZeRO-3
+partitions into inference containers before each generate and re-partitions
+after (``hybrid_engine.py:138-160``), swapping module forwards
+(``_zero3_forward`` :363). The TPU formulation is simpler and safer: the
+flax param pytree is the *shared format* of both engines, so serving is one
+``jax.device_put`` of the live training params into the inference TP
+layout (XLA inserts the gather collectives). Training state is never
+mutated by generation — train → generate → train is bit-identical to never
+generating (tested), which the reference cannot guarantee.
+
+LoRA: ``fuse_lora_weight``/``unfuse_lora_weight`` (reference :141,:148)
+fold adapter pairs into the *inference copy* of each kernel
+(``kernel + lora_b @ lora_a * scaling``); the training copy keeps the
+adapters separate.
+"""
+
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine, _cast_floating
+from deepspeed_tpu.utils.logging import log_dist
+
+LORA_A = "lora_a"   # [rank, in]
+LORA_B = "lora_b"   # [out, rank]
+LORA_SCALING = "lora_scaling"
+
+
+def fuse_lora_params(params, fuse: bool = True):
+    """Return a params pytree where every ``{kernel, lora_a, lora_b}``
+    subtree has the adapter folded into (``fuse=True``) or stripped out of
+    the kernel copy. Pure function — input tree untouched."""
+    def visit(node):
+        if isinstance(node, dict):
+            node = {k: visit(v) for k, v in node.items()}
+            if LORA_A in node and LORA_B in node and "kernel" in node:
+                a, b = node[LORA_A], node[LORA_B]
+                scale = node.get(LORA_SCALING, 1.0)
+                if fuse:
+                    # flax kernels are [in, out]; delta = (b @ a).T
+                    delta = (b @ a).T.astype(node["kernel"].dtype) * scale
+                    node = dict(node, kernel=node["kernel"] + delta)
+            return node
+        return node
+    return visit(params)
+
+
+class DeepSpeedHybridEngine(DeepSpeedEngine):
+    """Trains like ``DeepSpeedEngine``; adds ``generate()`` backed by a
+    cached ``InferenceEngine`` view over the live training params."""
+
+    def __init__(self, model, config, **kwargs):
+        super().__init__(model=model, config=config, **kwargs)
+        self.he_config = config.hybrid_engine_config
+        self._infer_engine = None
+        self._infer_params_stale = True
+        self.is_lora_fused = False
+        # perf bookkeeping (reference hybrid_engine.py:55-63)
+        self._generate_latency = 0.0
+        self._training_latency = 0.0
+        self._iters = 0
+        self._gather_latency = 0.0
+
+    # ------------------------------------------------------------------
+    def train_batch(self, batch=None, data_iter=None):
+        t0 = time.perf_counter()
+        loss = super().train_batch(batch=batch, data_iter=data_iter)
+        self._training_latency += time.perf_counter() - t0
+        self._iters += 1
+        self._infer_params_stale = True
+        return loss
+
+    # ------------------------------------------------------------------
+    def _build_inference_engine(self):
+        from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+        from deepspeed_tpu.inference.engine import InferenceEngine
+        from deepspeed_tpu.parallel.topology import MeshTopology
+
+        tp = max(1, self.he_config.inference_tp_size)
+        n = jax.device_count()
+        assert n % tp == 0, f"inference_tp_size {tp} must divide device count {n}"
+        topo = MeshTopology(tensor=tp, data=n // tp, fsdp=1)
+        icfg = DeepSpeedInferenceConfig(
+            dtype=self.compute_dtype,
+            max_out_tokens=self.he_config.max_out_tokens,
+            tensor_parallel={"tp_size": tp},
+            replace_with_kernel_inject=False,
+        )
+        params = self._inference_params_value()
+        engine = InferenceEngine(self.module, icfg, params=params, topology=topo)
+        log_dist(f"hybrid engine: inference view ready (tp={tp}, "
+                 f"max_out_tokens={self.he_config.max_out_tokens})")
+        return engine
+
+    def _inference_params_value(self):
+        """The live training params, LoRA-fused if requested, cast to the
+        serving dtype (the reference's gather+fuse, ``:138-160``)."""
+        params = self.state.params
+        if self.is_lora_fused:
+            params = fuse_lora_params(params, fuse=True)
+        return _cast_floating(params, self.compute_dtype)
+
+    def _refresh_inference_params(self):
+        t0 = time.perf_counter()
+        values = self._inference_params_value()
+        # reshard train-layout -> inference-TP layout; XLA emits the
+        # all-gathers (the reference's explicit partition gathering)
+        specs = self._infer_engine.params  # current placement template
+        self._infer_engine.params = jax.tree.map(
+            lambda v, old: jax.device_put(v, old.sharding), values, specs)
+        self._infer_params_stale = False
+        self._gather_latency += time.perf_counter() - t0
+
+    # ------------------------------------------------------------------
+    def generate(self, input_ids, **kwargs):
+        """Serve from the current training weights (reference
+        ``hybrid_engine.py:174``)."""
+        assert self.state is not None, \
+            "initialize_state / train_batch must run before generate()"
+        t0 = time.perf_counter()
+        from deepspeed_tpu.parallel.topology import set_topology
+        if self._infer_engine is None:
+            self._infer_engine = self._build_inference_engine()
+            self._infer_params_stale = False
+        elif self._infer_params_stale:
+            self._refresh_inference_params()
+        set_topology(self._infer_engine.topology)
+        try:
+            out = self._infer_engine.generate(input_ids, **kwargs)
+        finally:
+            # training resumes on the training mesh
+            set_topology(self.topology)
+        self._generate_latency += time.perf_counter() - t0
+        return out
+
+    def infer_forward(self, input_ids):
+        """Logits from the inference view (no cache)."""
+        assert self.state is not None
+        if self._infer_engine is None:
+            self._infer_engine = self._build_inference_engine()
+            self._infer_params_stale = False
+        elif self._infer_params_stale:
+            self._refresh_inference_params()
+        return self._infer_engine.forward(input_ids)
+
+    # ------------------------------------------------------------------
+    # LoRA surface (reference :141-160)
+    # ------------------------------------------------------------------
+    def fuse_lora_weight(self):
+        self.is_lora_fused = True
+        self._infer_params_stale = True
+
+    def unfuse_lora_weight(self):
+        self.is_lora_fused = False
+        self._infer_params_stale = True
+
+    unfuse_lora_weight_non_pinned = unfuse_lora_weight
+
+    def release_inference_cache(self):
+        """Reference frees the inference KV workspace (:161); XLA owns the
+        cache buffers inside the jitted generate, so dropping the engine's
+        compiled fns is the whole job."""
+        if self._infer_engine is not None:
+            self._infer_engine._gen_fn = None
+            self._infer_engine._gen_key = None
+
+    def hybrid_stats(self) -> Dict[str, float]:
+        """(reference prints these in ``generate`` every N iters)"""
+        return {"generate_latency_s": self._generate_latency,
+                "training_latency_s": self._training_latency,
+                "gather_latency_s": self._gather_latency,
+                "iters": self._iters}
