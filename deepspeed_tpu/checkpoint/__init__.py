@@ -1,0 +1,15 @@
+"""Checkpoint tools (reference ``deepspeed/checkpoint`` + ``utils/zero_to_fp32.py``):
+offline fp32/bf16 consolidation and the universal (HP-fragment) format."""
+
+from deepspeed_tpu.checkpoint.universal_checkpoint import (ds_to_universal,
+                                                           load_universal_fragments,
+                                                           load_universal_into_state,
+                                                           universal_metadata)
+from deepspeed_tpu.checkpoint.zero_to_fp32 import (convert_zero_checkpoint_to_fp32_state_dict,
+                                                   get_fp32_state_dict_from_zero_checkpoint,
+                                                   load_state_dict_from_npz)
+
+__all__ = ["convert_zero_checkpoint_to_fp32_state_dict",
+           "get_fp32_state_dict_from_zero_checkpoint", "load_state_dict_from_npz",
+           "ds_to_universal", "load_universal_fragments", "load_universal_into_state",
+           "universal_metadata"]

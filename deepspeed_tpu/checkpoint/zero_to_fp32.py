@@ -1,0 +1,149 @@
+"""Offline consolidation of a ZeRO checkpoint into plain fp32 (or bf16)
+weights — no mesh, no engine, no devices (reference
+``deepspeed/utils/zero_to_fp32.py``; ``save_16bit_model`` analog of
+reference ``runtime/engine.py:3376``).
+
+The reference stitches flattened rank-partitioned fragments back together
+(``_get_fp32_state_dict_from_zero_checkpoint`` zero_to_fp32.py:190, with
+per-rank ``parse_optim_states`` :141). Orbax already stores every array as
+one logical tensorstore, so consolidation is a host-side read + dtype cast;
+what this module adds is the *offline deployment format*: a single ``.npz``
+of ``path/to/param`` → array that plain flax/numpy users can load with no
+deepspeed_tpu (or jax) at all.
+"""
+
+import argparse
+import json
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+WEIGHTS_NAME = "model_weights.npz"          # reference writes pytorch_model.bin
+
+
+def _latest_tag(checkpoint_dir: str) -> str:
+    latest = os.path.join(checkpoint_dir, "latest")
+    if not os.path.exists(latest):
+        raise FileNotFoundError(f"no 'latest' file in {checkpoint_dir}; pass tag= explicitly")
+    with open(latest) as f:
+        return f.read().strip()
+
+
+def _restore_numpy(checkpoint_dir: str, tag: Optional[str] = None) -> Dict:
+    """Whole TrainState as host numpy — no abstract tree, no mesh."""
+    import orbax.checkpoint as ocp
+    tag = tag or _latest_tag(checkpoint_dir)
+    path = os.path.join(os.path.abspath(checkpoint_dir), str(tag), "state")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"checkpoint state not found at {path}")
+    return ocp.StandardCheckpointer().restore(path)
+
+
+def _flatten(tree: Dict, prefix: str = "") -> Dict[str, np.ndarray]:
+    out = {}
+    for k, v in tree.items():
+        key = f"{prefix}/{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_flatten(v, key))
+        else:
+            out[key] = np.asarray(v)
+    return out
+
+
+def _unflatten(flat: Dict[str, np.ndarray]) -> Dict:
+    tree: Dict = {}
+    for key, v in flat.items():
+        parts = key.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return tree
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir: str,
+                                             tag: Optional[str] = None) -> Dict:
+    """Nested dict of fp32 numpy params (reference
+    ``get_fp32_state_dict_from_zero_checkpoint`` zero_to_fp32.py:500-ish
+    public entry)."""
+    state = _restore_numpy(checkpoint_dir, tag)
+    params = state["params"]
+    return {k: v for k, v in _unflatten({
+        p: a.astype(np.float32) if np.issubdtype(a.dtype, np.floating) else a
+        for p, a in _flatten(params).items()}).items()}
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir: str,
+                                               output_dir: str,
+                                               tag: Optional[str] = None,
+                                               save_dtype: str = "float32") -> str:
+    """Write the consolidated weights npz + manifest; returns the npz path
+    (reference ``convert_zero_checkpoint_to_fp32_state_dict``). Pass
+    ``save_dtype='bfloat16'`` for the ``save_16bit_model`` deployment
+    format."""
+    import ml_dtypes
+    state = _restore_numpy(checkpoint_dir, tag)
+    flat = _flatten(state["params"])
+    dt = ml_dtypes.bfloat16 if save_dtype in ("bfloat16", "bf16") else np.dtype(save_dtype)
+    cast = {k: (v.astype(dt) if np.issubdtype(v.dtype, np.floating) else v)
+            for k, v in flat.items()}
+    os.makedirs(output_dir, exist_ok=True)
+    out_path = os.path.join(output_dir, WEIGHTS_NAME)
+    save_npz(out_path, cast)
+    with open(os.path.join(output_dir, "manifest.json"), "w") as f:
+        json.dump({"dtype": str(save_dtype),
+                   "num_params": int(sum(int(np.prod(v.shape)) for v in cast.values())),
+                   "keys": sorted(cast.keys())}, f, indent=2)
+    return out_path
+
+
+def save_npz(out_path: str, flat: Dict[str, np.ndarray]) -> None:
+    """npz writer that survives bfloat16: numpy's npz can't represent it, so
+    bf16 leaves are stored as uint16 views with a dtype map under a reserved
+    key, reversed transparently by ``load_state_dict_from_npz``."""
+    import ml_dtypes
+    flat = {k: np.asarray(v) for k, v in flat.items()}
+    dtypes = {k: str(v.dtype) for k, v in flat.items()}
+    storable = {k: (v.view(np.uint16) if v.dtype == ml_dtypes.bfloat16 else v)
+                for k, v in flat.items()}
+    np.savez(out_path, __dtypes__=np.frombuffer(json.dumps(dtypes).encode(), np.uint8),
+             **storable)
+
+
+def load_state_dict_from_npz(path: str) -> Dict:
+    """Deployment-side loader: npz → nested param dict (plain numpy)."""
+    import ml_dtypes
+    if os.path.isdir(path):
+        path = os.path.join(path, WEIGHTS_NAME)
+    with np.load(path) as z:
+        dtypes = {}
+        if "__dtypes__" in z.files:
+            dtypes = json.loads(bytes(z["__dtypes__"]).decode())
+        flat = {}
+        for k in z.files:
+            if k == "__dtypes__":
+                continue
+            v = z[k]
+            if dtypes.get(k) == "bfloat16":
+                v = v.view(ml_dtypes.bfloat16)
+            flat[k] = v
+        return _unflatten(flat)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Consolidate a deepspeed_tpu ZeRO checkpoint into a plain "
+                    "fp32 (or bf16) weights npz (reference utils/zero_to_fp32.py)")
+    p.add_argument("checkpoint_dir", help="dir passed to engine.save_checkpoint")
+    p.add_argument("output_dir", help="where to write model_weights.npz")
+    p.add_argument("-t", "--tag", default=None, help="checkpoint tag (default: latest)")
+    p.add_argument("-d", "--dtype", default="float32", choices=["float32", "bfloat16", "float16"])
+    args = p.parse_args(argv)
+    out = convert_zero_checkpoint_to_fp32_state_dict(args.checkpoint_dir, args.output_dir,
+                                                     tag=args.tag, save_dtype=args.dtype)
+    print(out)
+
+
+if __name__ == "__main__":
+    main()

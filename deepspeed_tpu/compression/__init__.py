@@ -1,0 +1,11 @@
+"""Compression library (reference ``deepspeed/compression``): scheduled
+weight/activation quantization and sparse/row/head/channel pruning,
+functional over flax param pytrees."""
+
+from deepspeed_tpu.compression.compress import (build_compression_transform, export_compressed,
+                                                init_compression, load_compressed,
+                                                redundancy_clean)
+from deepspeed_tpu.compression.config import get_compression_config
+
+__all__ = ["init_compression", "redundancy_clean", "build_compression_transform",
+           "export_compressed", "load_compressed", "get_compression_config"]

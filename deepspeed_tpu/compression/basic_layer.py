@@ -1,0 +1,104 @@
+"""Functional compression primitives (reference
+``compression/basic_layer.py:121-611`` ``LinearLayer_Compress`` /
+``Embedding_Compress`` and ``compression/utils.py`` quantizers).
+
+The reference compresses by swapping ``nn.Linear`` for stateful modules
+that mutate their own weights in ``forward``. Flax params are immutable
+pytrees, so each technique here is a pure ``(weight, step) -> weight``
+transform; the engine composes them over the param tree inside the jitted
+training step (schedules are ``jnp.where`` gates on the step counter, so
+one compiled program covers the whole schedule)."""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.quantizer.core import divisor_groups
+
+
+def qdq_weight(w: jax.Array, bits, groups: int = 1, symmetric: bool = True) -> jax.Array:
+    """Quantize-dequantize at ``bits`` (traced scalar ok) with grouped scales
+    (reference ``WeightQuantization`` utils.py; STE gradient comes free from
+    the straight-through pattern)."""
+    flat = w.reshape(-1)
+    g = divisor_groups(flat.size, max(flat.size // max(groups, 1), 1))
+    grouped = flat.reshape(g, -1).astype(jnp.float32)
+    levels = 2.0 ** (bits - 1) - 1.0
+    if symmetric:
+        scale = jnp.max(jnp.abs(grouped), axis=-1, keepdims=True) / levels
+        scale = jnp.maximum(scale, 1e-12)
+        q = jnp.clip(jnp.round(grouped / scale), -levels - 1, levels)
+        dq = q * scale
+    else:
+        lo = jnp.min(grouped, axis=-1, keepdims=True)
+        hi = jnp.max(grouped, axis=-1, keepdims=True)
+        scale = jnp.maximum((hi - lo) / (2.0 * levels + 1.0), 1e-12)
+        q = jnp.clip(jnp.round((grouped - lo) / scale), 0, 2 * levels + 1)
+        dq = q * scale + lo
+    out = dq.reshape(w.shape).astype(w.dtype)
+    # straight-through estimator: gradient flows as if identity
+    return w + jax.lax.stop_gradient(out - w)
+
+
+def scheduled_bits(step, start_bits: int, target_bits: int, period: int):
+    """Bit-width schedule (reference ``quantization_period`` semantics,
+    basic_layer.py:159-170): halve from start toward target every
+    ``period`` steps past the offset (traced)."""
+    if start_bits <= target_bits:
+        return jnp.asarray(float(target_bits))
+    n_halvings = jnp.floor_divide(jnp.maximum(step, 0), max(period, 1))
+    bits = jnp.maximum(start_bits / (2.0 ** n_halvings.astype(jnp.float32)),
+                       float(target_bits))
+    return bits
+
+
+def sparse_prune_mask(w: jax.Array, dense_ratio: float, method: str = "l1") -> jax.Array:
+    """Unstructured magnitude mask keeping ``dense_ratio`` of entries
+    (reference ``SparsePruning_Compress`` l1/topk)."""
+    flat = jnp.abs(w.reshape(-1).astype(jnp.float32))
+    k = max(int(flat.size * dense_ratio), 1)
+    thresh = jnp.sort(flat)[-k]
+    return (jnp.abs(w) >= thresh.astype(w.dtype)).astype(w.dtype)
+
+
+def row_prune_mask(w: jax.Array, dense_ratio: float) -> jax.Array:
+    """Keep the highest-l1 output rows (flax kernel [in, out] → axis 1;
+    reference ``LinearLayer_Compress.row_pruning`` prunes torch rows
+    [out, in] → the same output neurons)."""
+    scores = jnp.sum(jnp.abs(w.astype(jnp.float32)), axis=tuple(range(w.ndim - 1)))
+    k = max(int(scores.size * dense_ratio), 1)
+    thresh = jnp.sort(scores)[-k]
+    keep = (scores >= thresh).astype(w.dtype)
+    return jnp.broadcast_to(keep, w.shape)
+
+
+def head_prune_mask(w: jax.Array, dense_ratio: float, num_heads: int) -> jax.Array:
+    """Keep the highest-l1 heads: the output dim splits into ``num_heads``
+    blocks (reference ``head_pruning`` on attention projections)."""
+    out_dim = w.shape[-1]
+    assert out_dim % num_heads == 0, f"out dim {out_dim} not divisible by {num_heads} heads"
+    per = out_dim // num_heads
+    blocks = w.reshape(-1, num_heads, per)
+    scores = jnp.sum(jnp.abs(blocks.astype(jnp.float32)), axis=(0, 2))
+    k = max(int(num_heads * dense_ratio), 1)
+    thresh = jnp.sort(scores)[-k]
+    keep = (scores >= thresh).astype(w.dtype)                     # [heads]
+    return jnp.broadcast_to(keep[None, :, None], blocks.shape).reshape(w.shape)
+
+
+def channel_prune_mask(w: jax.Array, dense_ratio: float) -> jax.Array:
+    """Keep the highest-l1 INPUT channels (flax kernel axis 0)."""
+    scores = jnp.sum(jnp.abs(w.astype(jnp.float32)), axis=tuple(range(1, w.ndim)))
+    k = max(int(scores.size * dense_ratio), 1)
+    thresh = jnp.sort(scores)[-k]
+    keep = (scores >= thresh).astype(w.dtype)
+    return jnp.broadcast_to(keep.reshape((-1,) + (1,) * (w.ndim - 1)), w.shape)
+
+
+def quantize_activation(x: jax.Array, bits: int = 8, symmetric: bool = True,
+                        rng: Optional[jax.Array] = None) -> jax.Array:
+    """Dynamic-range activation QDQ (reference ``QuantAct``
+    basic_layer.py:548): per-tensor scale, STE gradient. Use inside model
+    code (flax has no module-swap hook; ``ActivationQuantizer`` wraps it)."""
+    return qdq_weight(x, float(bits), groups=1, symmetric=symmetric)

@@ -1,0 +1,232 @@
+"""Compression entry points (reference ``compression/compress.py:100``
+``init_compression`` and ``:148`` ``redundancy_clean``).
+
+Functional formulation: ``build_compression_transform`` compiles the config
+into one pure ``(params, step) -> params`` function; ``init_compression``
+installs it on an engine (applied to the compute params inside the jitted
+step, so the schedule gates are ``jnp.where`` on the live step counter —
+no recompiles as techniques activate); ``redundancy_clean`` bakes the
+end-state compression into the weights for export, and
+``export_compressed`` writes genuinely smaller int8 checkpoints.
+"""
+
+import fnmatch
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.compression import basic_layer as BL
+from deepspeed_tpu.compression.config import (ACTIVATION_QUANTIZATION, CHANNEL_PRUNING,
+                                              DIFFERENT_GROUPS, HEAD_PRUNING, ROW_PRUNING,
+                                              SHARED_PARAMETERS, SPARSE_PRUNING,
+                                              WEIGHT_QUANTIZATION, get_compression_config)
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+def _match(path: str, patterns: List[str]) -> bool:
+    """Reference patterns are torch dotted module names; tree paths are
+    slash-joined — match both spellings, on SEGMENT boundaries (a bare
+    substring check would let "h_1" also select h_10/h_11)."""
+    bounded = "/" + path + "/"
+    for pat in patterns:
+        p = pat.replace(".", "/")
+        if any(ch in p for ch in "*?["):
+            if fnmatch.fnmatch(path, p) or fnmatch.fnmatch(bounded, f"*/{p}/*"):
+                return True
+        elif f"/{p}/" in bounded:
+            return True
+    return False
+
+
+def _param_paths(params) -> List[Tuple[str, Any]]:
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    out = []
+    for kp, leaf in flat:
+        parts = [str(getattr(p, "key", getattr(p, "name", getattr(p, "idx", p)))) for p in kp]
+        out.append(("/".join(parts), leaf))
+    return out
+
+
+class CompressionSpec:
+    """Config resolved against a concrete param tree: an ordered rule list
+    ``(path, technique, group_params, shared)`` for the matrix-shaped leaves
+    each group's module patterns select (the analog of the reference's
+    ``layer_added_compress_methods``, compress.py:60)."""
+
+    def __init__(self, config: Dict[str, Any], params):
+        self.config = config
+        self.rules: Dict[str, List[Tuple[str, Dict, Dict]]] = {}
+        n = 0
+        for tech in (WEIGHT_QUANTIZATION, SPARSE_PRUNING, ROW_PRUNING, HEAD_PRUNING,
+                     CHANNEL_PRUNING):
+            shared = config[tech][SHARED_PARAMETERS]
+            if not shared.get("enabled", False):
+                continue
+            for gname, group in config[tech][DIFFERENT_GROUPS].items():
+                for path, leaf in _param_paths(params):
+                    if leaf.ndim < 2 or not jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+                        continue
+                    if not path.endswith("kernel") and "embedding" not in path and "wte" not in path:
+                        continue
+                    if _match(path, group["modules"]):
+                        self.rules.setdefault(path, []).append((tech, group["params"], shared))
+                        n += 1
+        log_dist(f"compression: {n} (param, technique) rules across "
+                 f"{len(self.rules)} params")
+
+    def transform(self) -> Callable:
+        """One pure fn(params, step) -> params applying every rule with its
+        schedule gate."""
+        rules = self.rules
+
+        def apply(params, step):
+            step = jnp.asarray(step)
+            flat = jax.tree_util.tree_flatten_with_path(params)
+            leaves = []
+            for kp, leaf in flat[0]:
+                parts = [str(getattr(p, "key", getattr(p, "name", getattr(p, "idx", p)))) for p in kp]
+                path = "/".join(parts)
+                for tech, gp, shared in rules.get(path, ()):
+                    offset = int(shared.get("schedule_offset", 0))
+                    active = step >= offset
+                    if tech == WEIGHT_QUANTIZATION:
+                        bits = BL.scheduled_bits(step - offset, int(gp["start_bits"]),
+                                                 int(gp["target_bits"]),
+                                                 int(gp["quantization_period"]))
+                        sym = shared.get("quantization_type", "symmetric") == "symmetric"
+                        new = BL.qdq_weight(leaf, bits, groups=int(shared.get("quantize_groups", 1)),
+                                            symmetric=sym)
+                    elif tech == SPARSE_PRUNING:
+                        new = leaf * BL.sparse_prune_mask(leaf, float(gp["dense_ratio"]),
+                                                          shared.get("method", "l1"))
+                    elif tech == ROW_PRUNING:
+                        new = leaf * BL.row_prune_mask(leaf, float(gp["dense_ratio"]))
+                    elif tech == HEAD_PRUNING:
+                        heads = gp.get("num_heads") or shared.get("num_heads")
+                        if heads:
+                            new = leaf * BL.head_prune_mask(leaf, float(gp["dense_ratio"]),
+                                                            int(heads))
+                        else:
+                            logger.warning(f"head_pruning on {path}: num_heads not set; skipped")
+                            new = leaf
+                    else:  # CHANNEL_PRUNING
+                        new = leaf * BL.channel_prune_mask(leaf, float(gp["dense_ratio"]))
+                    leaf = jnp.where(active, new, leaf)
+                leaves.append(leaf)
+            return jax.tree_util.tree_unflatten(flat[1], leaves)
+
+        return apply
+
+
+def build_compression_transform(params, ds_config: Dict[str, Any]) -> Optional[Callable]:
+    """Resolve the config against ``params``; None when nothing is enabled."""
+    spec = CompressionSpec(get_compression_config(ds_config), params)
+    return spec.transform() if spec.rules else None
+
+
+def init_compression(model_or_engine, deepspeed_config=None, teacher_model=None, mpu=None):
+    """Install compression on an engine (reference ``init_compression``
+    compress.py:100 swaps modules in place; here the engine's jitted step
+    transforms the compute params). Returns its argument for API parity."""
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    if isinstance(model_or_engine, DeepSpeedEngine):
+        engine = model_or_engine
+        if engine.global_steps > 0:
+            raise RuntimeError("init_compression must run before the first train_batch "
+                               "(rebuilding the step mid-run would discard live "
+                               "optimizer side-state)")
+        raw = deepspeed_config if isinstance(deepspeed_config, dict) else engine.config.raw_dict
+        engine._compression_config = raw
+        engine._compression_pending = True
+        # force a rebuild so the compression hook lands in the step program
+        engine._train_step_fn = None
+        if engine.state is not None:
+            engine._build_step_fns()
+        log_dist("compression installed on engine (applies inside the jitted step)")
+        return engine
+    raise TypeError("init_compression expects a DeepSpeedEngine; for raw flax params use "
+                    "build_compression_transform(params, ds_config)")
+
+
+def redundancy_clean(params, deepspeed_config: Dict[str, Any], step: Optional[int] = None):
+    """Bake the end-state compression into the weights (reference
+    ``redundancy_clean`` compress.py:148 makes masks/quantization permanent
+    for deployment). ``step`` defaults to past every schedule offset."""
+    transform = build_compression_transform(params, deepspeed_config)
+    if transform is None:
+        return params
+    if step is None:
+        cfg = get_compression_config(deepspeed_config)
+        step = 1 + max(int(cfg[t][SHARED_PARAMETERS].get("schedule_offset", 0))
+                       for t in (WEIGHT_QUANTIZATION, SPARSE_PRUNING, ROW_PRUNING,
+                                 HEAD_PRUNING, CHANNEL_PRUNING))
+        # weight quantization must land at target_bits: jump past every period
+        step += 10 ** 9
+    return jax.jit(lambda p: transform(p, jnp.asarray(step)))(params)
+
+
+def export_compressed(params, deepspeed_config: Dict[str, Any], output_dir: str) -> str:
+    """Write a deployment checkpoint where weight-quantized kernels are
+    stored as REAL int8 codes + scales (smaller file, not QDQ-fp32) and
+    pruning is baked in. Returns the npz path."""
+    from deepspeed_tpu.checkpoint.zero_to_fp32 import _flatten, save_npz
+    from deepspeed_tpu.ops.quantizer.core import divisor_groups, quantize
+
+    cleaned = jax.device_get(redundancy_clean(params, deepspeed_config))
+    cfg = get_compression_config(deepspeed_config)
+    wq = cfg[WEIGHT_QUANTIZATION]
+    spec = CompressionSpec(cfg, params)
+    q_paths = {p for p, rules in spec.rules.items()
+               if any(t == WEIGHT_QUANTIZATION for t, _, _ in rules)}
+    target_bits = {p: int(gp["target_bits"]) for p, rules in spec.rules.items()
+                   for t, gp, _ in rules if t == WEIGHT_QUANTIZATION}
+
+    flat = _flatten(cleaned)
+    out = {}
+    for path, arr in flat.items():
+        if path in q_paths and target_bits.get(path, 8) <= 8:
+            groups = divisor_groups(arr.size, 2048)
+            q, qp = quantize(jnp.asarray(arr), num_bits=8, symmetric=True, num_groups=groups)
+            out[path + ".int8"] = np.asarray(q, np.int8)
+            out[path + ".scale"] = np.asarray(qp.scale, np.float32)
+            out[path + ".shape"] = np.asarray(arr.shape, np.int64)
+        else:
+            out[path] = np.asarray(arr)
+    os.makedirs(output_dir, exist_ok=True)
+    out_path = os.path.join(output_dir, "compressed_weights.npz")
+    save_npz(out_path, out)
+    with open(os.path.join(output_dir, "compression_manifest.json"), "w") as f:
+        json.dump({"int8_params": sorted(q_paths)}, f, indent=2)
+    return out_path
+
+
+def load_compressed(path: str):
+    """Inverse of ``export_compressed``: nested fp32 param dict."""
+    from deepspeed_tpu.checkpoint.zero_to_fp32 import _unflatten
+    if os.path.isdir(path):
+        path = os.path.join(path, "compressed_weights.npz")
+    from deepspeed_tpu.checkpoint.zero_to_fp32 import load_state_dict_from_npz
+    flat_nested = load_state_dict_from_npz(path)
+    # re-flatten to find .int8 triplets
+    from deepspeed_tpu.checkpoint.zero_to_fp32 import _flatten
+    flat = _flatten(flat_nested)
+    out = {}
+    for k, v in flat.items():
+        if k.endswith(".int8"):
+            base = k[:-5]
+            scale = flat[base + ".scale"]
+            shape = tuple(int(x) for x in flat[base + ".shape"])
+            vals = (v.astype(np.float32).reshape(scale.shape[0], -1)
+                    * scale.reshape(scale.shape[0], -1))
+            out[base] = vals.reshape(shape)
+        elif k.endswith(".scale") or k.endswith(".shape"):
+            continue
+        else:
+            out[k] = v
+    return _unflatten(out)

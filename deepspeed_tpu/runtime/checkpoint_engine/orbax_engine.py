@@ -34,6 +34,10 @@ class OrbaxCheckpointEngine(CheckpointEngine):
     def save(self, state, tag, metadata: Optional[dict] = None):
         path = self._path(tag)
         self._ckptr.save(os.path.join(path, "state"), state, force=True)
+        # StandardCheckpointer finalizes asynchronously; without this a
+        # process exit right after save_checkpoint() leaves a torn
+        # *.orbax-checkpoint-tmp that restore reports as "not found"
+        self._ckptr.wait_until_finished()
         if metadata is not None and jax.process_index() == 0:
             with open(os.path.join(path, "metadata.json"), "w") as f:
                 json.dump(metadata, f)

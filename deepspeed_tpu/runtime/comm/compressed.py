@@ -23,6 +23,13 @@ def _pad_to(x: jax.Array, multiple: int) -> Tuple[jax.Array, int]:
     return (jnp.pad(x, (0, pad)) if pad else x), pad
 
 
+def padded_chunk_size(n: int, world: int) -> int:
+    """Per-device server-chunk length for an ``n``-element flat buffer over
+    ``world`` devices: divisible by 8 for packbits. Shared by every
+    compressed-collective caller so error-buffer shapes cannot drift."""
+    return ((n + world * 8 - 1) // (world * 8)) * 8
+
+
 def _compress_chunks(chunks: jax.Array):
     """[k, m] → packed sign bits [k, m/8] u8, per-chunk l1 scale [k], and the
     decompressed representation (what receivers will reconstruct)."""

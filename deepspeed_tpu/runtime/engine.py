@@ -180,7 +180,12 @@ class DeepSpeedEngine:
         self._onebit_errors = None
         self._use_qcomm = False
         self._offload_enabled = False
+        self._zeroone_runner = None
         self._autotune = None  # (mode, raw config dict), set by entry.initialize
+        # compression-in-forward (set via compression.init_compression)
+        self._compression_pending = False
+        self._compression_config = None
+        self._compression_transform = None
 
         # -- curriculum learning (reference legacy surface,
         #    _configure_curriculum_scheduler_legacy engine.py:1283): for the
@@ -203,10 +208,11 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # configuration
     # ------------------------------------------------------------------
-    def _onebit_comm_eligible(self) -> bool:
-        """The real 1-bit compressed collective needs replicated params/opt
-        state (stage 0) on a pure-DP multi-device mesh without MoE/offload."""
-        if (self.config.optimizer_name != C.ONEBIT_ADAM_OPTIMIZER
+    def _compressed_comm_eligible(self, optimizer_name: str) -> bool:
+        """Real compressed collectives (1-bit Adam, 0/1 Adam) need replicated
+        params/opt state (stage 0) on a pure-DP multi-device mesh without
+        MoE/offload."""
+        if (self.config.optimizer_name != optimizer_name
                 or self.client_optimizer is not None):
             return False
         off = self.config.zero_config.offload_optimizer
@@ -218,6 +224,9 @@ class DeepSpeedEngine:
         pure_dp = all(self.mesh.shape[a] == 1 for a in ("pipe", "tensor", "sequence", "expert"))
         dp_world = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
         return pure_dp and dp_world > 1 and self.config.zero_optimization_stage == 0
+
+    def _onebit_comm_eligible(self) -> bool:
+        return self._compressed_comm_eligible(C.ONEBIT_ADAM_OPTIMIZER)
 
     def _configure_optimizer(self) -> optax.GradientTransformation:
         """Reference ``_configure_basic_optimizer`` (``engine.py:1225``):
@@ -238,10 +247,12 @@ class DeepSpeedEngine:
             return fused_adam(lr=lr, adam_w_mode=adam_w_mode, **params)
         if name in (C.ONEBIT_ADAM_OPTIMIZER, C.ZERO_ONE_ADAM_OPTIMIZER, C.ONEBIT_LAMB_OPTIMIZER):
             from deepspeed_tpu.runtime.fp16.onebit import get_onebit_optimizer
-            if name == C.ONEBIT_ADAM_OPTIMIZER and self._onebit_comm_eligible():
-                # the engine's compressed-collective step owns post-freeze
-                # compression; the transform skips its internal QDQ and the
-                # dead full-size error-feedback tree
+            if (name == C.ONEBIT_ADAM_OPTIMIZER and self._onebit_comm_eligible()) or \
+                    (name == C.ZERO_ONE_ADAM_OPTIMIZER
+                     and self._compressed_comm_eligible(C.ZERO_ONE_ADAM_OPTIMIZER)):
+                # the engine's compressed-collective step owns compression;
+                # the transform skips its internal QDQ and the dead
+                # full-size error-feedback tree
                 params["external_comm"] = True
             return get_onebit_optimizer(name, lr=lr, **params)
         if name == C.LAMB_OPTIMIZER:
@@ -405,12 +416,21 @@ class DeepSpeedEngine:
     # ZeRO-Offload / ZeRO-Infinity: optimizer states off-device
     # (reference stage_1_and_2 cpu_offload / stage3 + swap_tensor; SURVEY §7.3)
     # ------------------------------------------------------------------
-    def _accumulate_grads(self, params, batch, rng, scale, grad_shardings, gas, clip, fp16):
+    def _accumulate_grads(self, params, batch, rng, scale, grad_shardings, gas, clip, fp16,
+                          params_transform=None):
         """The shared fwd+bwd core: GAS microbatch scan, 1/gas averaging,
         quantized or full-precision ZeRO reduction, clipping, overflow.
         Used by the fused on-device step AND the offload grads-only step so
-        the two paths cannot drift."""
+        the two paths cannot drift. ``params_transform`` (compression-in-
+        forward) runs INSIDE the grad closure so masks gate gradients and
+        the quantization STE applies."""
         keys = jax.random.split(rng, gas)
+        loss_for = self._loss_for
+        if params_transform is not None:
+            base_loss_for = loss_for
+
+            def loss_for(p, mb, key, scale, train=True):
+                return base_loss_for(params_transform(p), mb, key, scale, train=train)
 
         if getattr(self, "_use_qcomm", False):
             # ZeRO++ real quantized collectives: the whole gather→scan→reduce
@@ -439,7 +459,7 @@ class DeepSpeedEngine:
 
         def micro(acc, xs):
             mb, key = xs
-            (_, loss), grads = jax.value_and_grad(self._loss_for, has_aux=True)(params, mb, key, scale)
+            (_, loss), grads = jax.value_and_grad(loss_for, has_aux=True)(params, mb, key, scale)
             grads = _cast_floating(grads, jnp.float32)
             return jax.tree.map(jnp.add, acc, grads), loss
 
@@ -479,9 +499,10 @@ class DeepSpeedEngine:
         mesh = self.mesh
         dp_axes = ("data", "fsdp")
         world = mesh.shape["data"] * mesh.shape["fsdp"]
+        from deepspeed_tpu.runtime.comm.compressed import padded_chunk_size
         n_flat = sum(int(np.prod(s)) for s in jax.tree.leaves(
             self.plan.param_shapes, is_leaf=lambda x: isinstance(x, tuple)))
-        m_chunk = ((n_flat + world * 8 - 1) // (world * 8)) * 8
+        m_chunk = padded_chunk_size(n_flat, world)
 
         err_sharding = NamedSharding(mesh, P(dp_axes))
         if self._onebit_errors is None:
@@ -738,31 +759,40 @@ class DeepSpeedEngine:
         want_qcomm = bool(zc.zero_quantized_gradients or zc.zero_quantized_weights)
         mcfg = getattr(self.module, "config", None)
         has_moe = mcfg is not None and getattr(mcfg, "moe_num_experts", 0) > 0
-        pure_dp = all(self.mesh.shape[a] == 1 for a in ("pipe", "tensor", "sequence", "expert"))
+        # tensor axes compose: the qcomm shard_map is manual over (data,
+        # fsdp) only and GSPMD keeps owning the TP collectives inside
+        # (qcomm.py axis_names); pipe/expert/sequence still fall back
+        dp_compat = all(self.mesh.shape[a] == 1 for a in ("pipe", "sequence", "expert"))
         dp_world = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
-        self._use_qcomm = (want_qcomm and pure_dp and dp_world > 1 and not has_moe
+        self._use_qcomm = (want_qcomm and dp_compat and dp_world > 1 and not has_moe
                            and not getattr(self, "_offload_enabled", False))
         if want_qcomm and not self._use_qcomm:
-            log_dist("ZeRO++ quantized communication requires a pure-DP mesh without "
-                     "MoE/offload; falling back to QDQ numerics (no wire-byte savings)")
+            log_dist("ZeRO++ quantized communication requires a DP(+TP) mesh without "
+                     "pipe/sequence/expert axes or MoE/offload; falling back to QDQ "
+                     "numerics (no wire-byte savings)")
 
         # 1-bit Adam compressed collective (reference compressed_allreduce,
         # runtime/comm/nccl.py:51): after freeze_step the DP exchange becomes
         # packed sign bits of the momentum — needs replicated params/opt
         # state (stage 0) on a pure-DP mesh
+        # shared hyperparameter parsing for the compressed-comm optimizers:
+        # the schedule (when configured) must keep driving the lr through
+        # the compression phase
+        def compressed_opt_params():
+            op = dict(cfg.optimizer_params or {})
+            return op, dict(
+                lr=self.lr_scheduler if self.lr_scheduler is not None else op.get("lr", 1e-3),
+                betas=tuple(op.get("betas", (0.9, 0.999))),
+                eps=op.get("eps", 1e-8), weight_decay=op.get("weight_decay", 0.0))
+
+        # (a rebuild, e.g. init_compression, must not zero live 1-bit error
+        # feedback — __init__ owns the _onebit_errors default)
         self._onebit_cfg = None
         self._onebit_step_fn = None
-        self._onebit_errors = None
         if cfg.optimizer_name == C.ONEBIT_ADAM_OPTIMIZER and self.client_optimizer is None:
-            op = dict(cfg.optimizer_params or {})
             if self._onebit_comm_eligible():
-                self._onebit_cfg = dict(
-                    # the schedule (when configured) must keep driving the lr
-                    # through the compression phase
-                    lr=self.lr_scheduler if self.lr_scheduler is not None else op.get("lr", 1e-3),
-                    betas=tuple(op.get("betas", (0.9, 0.999))),
-                    eps=op.get("eps", 1e-8), weight_decay=op.get("weight_decay", 0.0),
-                    freeze_step=int(op.get("freeze_step", 100000)))
+                op, base = compressed_opt_params()
+                self._onebit_cfg = dict(base, freeze_step=int(op.get("freeze_step", 100000)))
                 log_dist(f"1-bit Adam compressed collective active after "
                          f"freeze_step={self._onebit_cfg['freeze_step']} (1-bit wire payload)")
                 if clip > 0:
@@ -772,7 +802,55 @@ class DeepSpeedEngine:
             else:
                 log_dist("1-bit Adam compressed collective requires a pure-DP mesh at "
                          "ZeRO stage 0; using error-feedback numerics without comm savings")
+
+        # 0/1 Adam: the real interval/local-step schedule (runtime/zeroone.py).
+        # A rebuild keeps the live runner — its buffers ARE optimizer state.
+        if (self._zeroone_runner is None
+                and cfg.optimizer_name == C.ZERO_ONE_ADAM_OPTIMIZER
+                and self.client_optimizer is None
+                and self._compressed_comm_eligible(C.ZERO_ONE_ADAM_OPTIMIZER)):
+            from deepspeed_tpu.runtime.zeroone import ZeroOneRunner
+            op, base = compressed_opt_params()
+            zo_cfg = dict(
+                base,
+                var_freeze_step=int(op.get("var_freeze_step", 100000)),
+                var_update_scaler=int(op.get("var_update_scaler", 16)),
+                local_step_scaler=int(op.get("local_step_scaler", 32678)),
+                local_step_clipper=int(op.get("local_step_clipper", 16)))
+            self._zeroone_runner = ZeroOneRunner(self, zo_cfg)
+            log_dist(f"0/1 Adam engine schedule active: var_freeze_step="
+                     f"{zo_cfg['var_freeze_step']} (1-bit grad wire + collective-free "
+                     f"local steps after freeze)")
+            if clip > 0:
+                log_dist("warning: gradient_clipping is not applied by the 0/1 Adam "
+                         "schedule (local gradients are never globally reduced; matches "
+                         "reference 0/1 Adam semantics)")
+            if fp16:
+                log_dist("warning: 0/1 Adam runs without dynamic loss scaling; "
+                         "use bf16 or fp32 compute")
+        elif cfg.optimizer_name == C.ZERO_ONE_ADAM_OPTIMIZER and self.client_optimizer is None:
+            log_dist("0/1 Adam compressed schedule requires a pure-DP mesh at ZeRO "
+                     "stage 0; using interval numerics without comm savings")
         mesh = self.mesh
+
+        # compression-in-forward: resolve the config against the real param
+        # tree once shapes are known (compression.init_compression)
+        if self._compression_pending and self.state is not None:
+            from deepspeed_tpu.compression.compress import build_compression_transform
+            self._compression_transform = build_compression_transform(
+                self.state.params, self._compression_config)
+            self._compression_pending = False
+            if self._compression_transform is not None and self._use_qcomm:
+                log_dist("warning: compression-in-forward does not compose with the "
+                         "qcomm shard_map path; disabling quantized collectives")
+                self._use_qcomm = False
+            if self._compression_transform is not None and (
+                    getattr(self, "_offload_enabled", False)
+                    or self._zeroone_runner is not None
+                    or cfg.optimizer_name == C.ONEBIT_ADAM_OPTIMIZER):
+                logger.warning("compression-in-forward only applies on the fused "
+                               "train_batch path; offload/1-bit/0-1 Adam steps run "
+                               "uncompressed")
 
         if getattr(self, "_offload_enabled", False):
             self._build_offload_step_fns(grad_shardings)
@@ -784,8 +862,11 @@ class DeepSpeedEngine:
 
         def train_step(state: TrainState, batch, rng):
             scale = state.loss_scale.loss_scale if fp16 else jnp.float32(1.0)
+            ctrans = self._compression_transform
+            pt = (lambda p: ctrans(p, state.step)) if ctrans is not None else None
             losses, grads, gnorm, overflow = self._accumulate_grads(
-                state.params, batch, rng, scale, grad_shardings, gas, clip, fp16)
+                state.params, batch, rng, scale, grad_shardings, gas, clip, fp16,
+                params_transform=pt)
 
             updates, new_opt = self.optimizer.update(grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
@@ -817,12 +898,17 @@ class DeepSpeedEngine:
             donate_argnums=(0,),
         )
 
-        def eval_step(params, mb):
+        def eval_step(params, mb, step):
+            # eval must score the same network training optimizes: the
+            # compression transform (when installed) applies here too
+            if self._compression_transform is not None:
+                params = self._compression_transform(params, step)
             _, loss = self._loss_for(params, mb, jax.random.PRNGKey(0), jnp.float32(1.0), train=False)
             return loss
 
         self._eval_step_fn = jax.jit(eval_step,
-                                     in_shardings=(self.state_shardings.params, None),
+                                     in_shardings=(self.state_shardings.params, None,
+                                                   NamedSharding(mesh, P())),
                                      out_shardings=NamedSharding(mesh, P()))
 
         # shim path: per-microbatch grads + deferred apply
@@ -946,6 +1032,9 @@ class DeepSpeedEngine:
             t_profile = time.time()
         if getattr(self, "_host_opt", None) is not None:
             _, metrics = self._offload_train_batch(device_batch, rng)
+        elif self._zeroone_runner is not None:
+            # 0/1 Adam owns the whole schedule (dense/1-bit/local/sync)
+            metrics = self._zeroone_runner.step(device_batch, rng)
         elif (self._onebit_cfg is not None
               and self.global_steps >= self._onebit_cfg["freeze_step"]):
             # compression phase: momentum rides the 1-bit collective
@@ -978,7 +1067,7 @@ class DeepSpeedEngine:
     def eval_batch(self, batch):
         self.initialize_state(batch)
         device_batch = self._shard_batch(batch, with_gas_dim=False)
-        return self._eval_step_fn(self.state.params, device_batch)
+        return self._eval_step_fn(self.state.params, device_batch, self.state.step)
 
     # -- torch-style shims (reference engine.py:1709/1850/2051) ----------
     def forward(self, batch):
@@ -1087,6 +1176,10 @@ class DeepSpeedEngine:
         if self.curriculum_scheduler is not None:
             meta["curriculum_state"] = self.curriculum_scheduler.get_state()
         engine.save(self.state, tag, metadata=meta)
+        if self._zeroone_runner is not None and dist.get_rank() == 0:
+            # pending local updates (u) + error feedback are optimizer state
+            np.save(os.path.join(save_dir, tag, "zeroone_state.npy"),
+                    self._zeroone_runner.state_dict(), allow_pickle=True)
         if getattr(self, "_host_opt", None) is not None and dist.get_rank() == 0:
             # offloaded optimizer state (host masters + moments bookkeeping)
             np.save(os.path.join(save_dir, tag, "host_optimizer.npy"),
@@ -1097,6 +1190,44 @@ class DeepSpeedEngine:
                 f.write(tag)
         dist.barrier()
         return True
+
+    def save_16bit_model(self, save_dir, output_file=None):
+        """Consolidated bf16 deployment weights from the LIVE params
+        (reference ``engine.py:3376`` ``save_16bit_model`` →
+        pytorch_model.bin; here an npz any flax/numpy user can read)."""
+        assert self.state is not None, "nothing to save: state not initialized"
+        from deepspeed_tpu.checkpoint.zero_to_fp32 import WEIGHTS_NAME, _flatten, save_npz
+        cast = _cast_floating(self.state.params, jnp.bfloat16)
+        if jax.process_count() > 1:
+            # shards span processes: consolidate before fetching
+            from jax.experimental import multihost_utils
+            params = multihost_utils.process_allgather(cast)
+        else:
+            params = jax.device_get(cast)
+        os.makedirs(save_dir, exist_ok=True)
+        out = os.path.join(save_dir, output_file or WEIGHTS_NAME)
+        if dist.get_rank() == 0:
+            save_npz(out, _flatten(params))
+        dist.barrier()
+        log_dist(f"saved 16-bit model weights -> {out}")
+        return out
+
+    def load_universal(self, universal_dir):
+        """Resume from a universal (HP-fragment) checkpoint, tolerating a
+        changed param tree (reference ``--load-universal`` path,
+        ``universal_checkpoint.py:12``)."""
+        assert self.state is not None, ("initialize_state must run before load_universal "
+                                        "so the target tree and shardings are known")
+        from deepspeed_tpu.checkpoint.universal_checkpoint import (load_universal_into_state,
+                                                                   universal_metadata)
+        abstract = jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self.state)
+        self.state = load_universal_into_state(universal_dir, abstract, self.state_shardings)
+        meta = universal_metadata(universal_dir)
+        self.global_steps = meta.get("global_steps", 0)
+        self.global_samples = meta.get("global_samples", 0)
+        self.micro_steps = meta.get("micro_steps", 0)
+        self.skipped_steps = meta.get("skipped_steps", 0)
+        return meta.get("client_state", {})
 
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False):
@@ -1115,6 +1246,11 @@ class DeepSpeedEngine:
                                      load_optimizer_states=load_optimizer_states,
                                      load_module_only=load_module_only)
         self.state = restored
+        if self._zeroone_runner is not None and load_optimizer_states:
+            zo_path = os.path.join(load_dir, tag, "zeroone_state.npy")
+            if os.path.exists(zo_path):
+                self._zeroone_runner.load_state_dict(
+                    np.load(zo_path, allow_pickle=True).item())
         if getattr(self, "_host_opt", None) is not None:
             host_path = os.path.join(load_dir, tag, "host_optimizer.npy")
             if os.path.exists(host_path):

@@ -8,12 +8,15 @@ sign+scale Pallas/ICI path where beneficial.
 """
 
 from deepspeed_tpu.runtime.fp16.onebit.adam import onebit_adam, OnebitAdam
+from deepspeed_tpu.runtime.fp16.onebit.zoadam import zero_one_adam, ZeroOneAdam
 
 
 def get_onebit_optimizer(name: str, **kwargs):
     name = name.lower()
-    if name in ("onebitadam", "zerooneadam"):
+    if name == "onebitadam":
         return onebit_adam(**kwargs)
+    if name == "zerooneadam":
+        return zero_one_adam(**kwargs)
     if name == "onebitlamb":
         from deepspeed_tpu.runtime.fp16.onebit.lamb import onebit_lamb
         return onebit_lamb(**kwargs)

@@ -1,0 +1,114 @@
+"""0/1 Adam (arXiv:2202.06009) — reference ``runtime/fp16/onebit/zoadam.py``.
+
+Two cooperating pieces, mirroring the 1-bit Adam split:
+
+* This optax transform carries the NUMERICS for any mesh: variance updates
+  on an exponentially-growing interval (``var_interval`` doubles every
+  ``var_update_scaler`` updates, ref zoadam.py:265-270), momentum
+  sign-compression with error feedback after ``var_freeze_step``. Counters
+  live in the optimizer state, so the schedule is checkpoint-exact.
+* On pure-DP stage-0 meshes the ENGINE runs the real thing
+  (``runtime/zeroone.py``): 1-bit compressed gradient allreduces during
+  warmup's off-interval steps, and *local steps with no collective at all*
+  between momentum syncs after the freeze — the feature the algorithm
+  exists for (ref zoadam.py:240-260 toggles ``enable_backward_allreduce``
+  and accumulates updates in ``momentum_accumulator``).
+"""
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class ZeroOneAdamState(NamedTuple):
+    count: jax.Array
+    exp_avg: Any
+    exp_avg_sq: Any
+    error_feedback: Any
+    var_interval: jax.Array    # steps between variance updates (doubles)
+    var_counter: jax.Array     # updates since the last interval doubling
+
+
+def zero_one_adam(lr=1e-3,
+                  betas: Tuple[float, float] = (0.9, 0.999),
+                  eps: float = 1e-8,
+                  weight_decay: float = 0.0,
+                  var_freeze_step: int = 100000,
+                  var_update_scaler: int = 16,
+                  local_step_scaler: int = 32678,
+                  local_step_clipper: int = 16,
+                  cuda_aware: bool = False,
+                  comm_backend_name: str = "ici",
+                  external_comm: bool = False,
+                  **_ignored) -> optax.GradientTransformation:
+    """Transform-level 0/1 Adam. ``external_comm=True`` (the engine's real
+    compressed path) keeps plain state and exact math — the engine owns
+    intervals, local steps and the wire format."""
+    b1, b2 = betas
+
+    def init(params):
+        zeros = lambda: jax.tree.map(jnp.zeros_like, params)
+        return ZeroOneAdamState(count=jnp.zeros([], jnp.int32),
+                                exp_avg=zeros(),
+                                exp_avg_sq=zeros(),
+                                error_feedback=() if external_comm else zeros(),
+                                var_interval=jnp.ones([], jnp.int32),
+                                var_counter=jnp.zeros([], jnp.int32))
+
+    def update(grads, state, params=None):
+        assert params is not None
+        count = state.count + 1
+        step_lr = lr(count) if callable(lr) else lr
+        frozen = count > var_freeze_step
+        on_interval = (count % state.var_interval) == 0
+        do_var = on_interval & ~frozen
+
+        exp_avg_sq = jax.tree.map(
+            lambda v, g: jnp.where(do_var, b2 * v + (1 - b2) * jnp.square(g), v),
+            state.exp_avg_sq, grads)
+
+        # interval schedule (ref zoadam.py:265-270): after var_update_scaler
+        # on-interval updates, the interval doubles
+        var_counter = jnp.where(do_var, state.var_counter + 1, state.var_counter)
+        roll = var_counter >= var_update_scaler
+        var_interval = jnp.where(do_var & roll, state.var_interval * 2, state.var_interval)
+        var_counter = jnp.where(do_var & roll, 0, var_counter)
+
+        exp_avg = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state.exp_avg, grads)
+
+        if external_comm:
+            momentum, err = exp_avg, ()
+        else:
+            # post-freeze: sign-compressed momentum w/ error feedback (QDQ
+            # numerics; wire savings live in the engine path)
+            def _compressed(m, e):
+                corrected = m + e
+                scale = jnp.mean(jnp.abs(corrected))
+                comp = jnp.sign(corrected) * scale
+                return comp, corrected - comp
+
+            pairs = jax.tree.map(_compressed, exp_avg, state.error_feedback)
+            comp = jax.tree.map(lambda t: t[0], pairs, is_leaf=lambda x: isinstance(x, tuple))
+            new_e = jax.tree.map(lambda t: t[1], pairs, is_leaf=lambda x: isinstance(x, tuple))
+            momentum = jax.tree.map(lambda m, c: jnp.where(frozen, c, m), exp_avg, comp)
+            err = jax.tree.map(lambda e0, e1: jnp.where(frozen, e1, e0),
+                               state.error_feedback, new_e)
+
+        def _direction(m, v, p):
+            upd = m / (jnp.sqrt(v) + eps)
+            if weight_decay > 0.0:
+                upd = upd + weight_decay * p
+            return -step_lr * upd
+
+        updates = jax.tree.map(_direction, momentum, exp_avg_sq, params)
+        return updates, ZeroOneAdamState(count=count, exp_avg=exp_avg, exp_avg_sq=exp_avg_sq,
+                                         error_feedback=err, var_interval=var_interval,
+                                         var_counter=var_counter)
+
+    return optax.GradientTransformation(init, update)
+
+
+def ZeroOneAdam(params=None, **kwargs):
+    return zero_one_adam(**kwargs)

@@ -15,12 +15,14 @@ TPU-native redesign of the reference's compressed-communication stack:
   (scatter-reduce + gather, the shape of the reference's
   ``compressed_allreduce`` two-phase design, ``runtime/comm/nccl.py:51``).
 
-Everything here runs inside one ``jax.shard_map`` over the DP axes so the
-quantize → exchange → dequantize pipeline is explicit SPMD: the wire payload
-is the int8/int4-packed array itself, not a QDQ simulation. The engine uses
-this path for the whole gradient-accumulation step when quantized comm is
-enabled on a pure-DP mesh (tensor/sequence/pipe/expert all 1); other meshes
-fall back to the numerics-only QDQ path.
+Everything here runs inside one ``jax.shard_map`` that is MANUAL over the
+DP axes only (``axis_names={data, fsdp}``): the quantize → exchange →
+dequantize pipeline is explicit SPMD with the int8/int4-packed array itself
+as the wire payload, while tensor/sequence mesh axes stay in GSPMD's hands
+— the compiler keeps inserting the TP psums / SP collectives it owns, in
+full precision, exactly as the reference's qgZ composes with
+megatron-style MP (``coalesced_collectives.py`` reduces over DP groups
+only). Pipe/expert meshes still fall back to the numerics-only QDQ path.
 """
 
 from typing import Any, Optional
@@ -183,7 +185,20 @@ def qcomm_accumulate(loss_for, mesh, param_specs, grad_specs, batch, batch_spec,
     param_flat, param_treedef = jax.tree_util.tree_flatten(param_specs, is_leaf=lambda x: isinstance(x, P))
     grad_flat = jax.tree_util.tree_flatten(grad_specs, is_leaf=lambda x: isinstance(x, P))[0]
 
-    batch_in_specs = jax.tree.map(lambda x: P(*batch_spec[:x.ndim]), batch)
+    def drop_auto_axes(spec: P) -> P:
+        """Manual-axis view of a spec: entries for auto (GSPMD-owned) axes
+        are invisible to the shard_map boundary."""
+        out = []
+        for entry in spec:
+            if entry is None:
+                out.append(None)
+                continue
+            names = entry if isinstance(entry, tuple) else (entry,)
+            kept = tuple(n for n in names if n in (data_axis, fsdp_axis))
+            out.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+        return P(*out)
+
+    batch_in_specs = jax.tree.map(lambda x: drop_auto_axes(P(*batch_spec[:x.ndim])), batch)
 
     def body(param_shards, local_batch, keys, scale):
         dp_idx = jax.lax.axis_index((data_axis, fsdp_axis))
@@ -252,7 +267,11 @@ def qcomm_accumulate(loss_for, mesh, param_specs, grad_specs, batch, batch_spec,
         loss = jax.lax.pmean(losses.mean(), (data_axis, fsdp_axis))
         return loss, grad_shards
 
-    in_specs = (param_specs, batch_in_specs, P(), P())
-    out_specs = (P(), grad_specs)
+    manual_in_param = jax.tree.map(drop_auto_axes, param_specs,
+                                   is_leaf=lambda x: isinstance(x, P))
+    manual_out_grad = jax.tree.map(drop_auto_axes, grad_specs,
+                                   is_leaf=lambda x: isinstance(x, P))
+    in_specs = (manual_in_param, batch_in_specs, P(), P())
+    out_specs = (P(), manual_out_grad)
     return jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                         check_vma=False)
+                         axis_names={data_axis, fsdp_axis}, check_vma=False)

@@ -1,0 +1,369 @@
+"""Engine-side 0/1 Adam: the real compressed/local-step communication
+schedule (reference ``runtime/fp16/onebit/zoadam.py``; paper
+arXiv:2202.06009).
+
+Four compiled programs over a pure-DP mesh, chosen per step by a
+host-side schedule that is a pure function of the step count (so resume
+from a checkpoint replays it exactly):
+
+* phase 1 (t <= var_freeze_step)
+  - on variance-interval steps: ``p1_dense`` — dense mean-allreduce of the
+    gradient, momentum+variance update (ref zoadam.py:205-209).
+  - otherwise: ``p1_cgrad`` — the gradient crosses the wire as PACKED SIGN
+    BITS (1 bit/elem + per-chunk scales); variance untouched (ref :211-218).
+* phase 2 (t > var_freeze_step; variance frozen)
+  - local steps: ``p2_local`` — NO COLLECTIVE AT ALL. Each device advances
+    its own momentum/update accumulator ``u`` against the shared snapshot
+    params; replicas intentionally diverge (ref :240-247 accumulates into
+    ``momentum_accumulator`` with allreduce disabled).
+  - every local_step_interval steps: ``p2_sync`` — the accumulated update is
+    mapped to momentum space, 1-bit allreduced, and params/momentum are
+    re-synchronized (ref :248-260).
+
+Intervals: the variance interval doubles after every ``var_update_scaler``
+on-interval updates; the local-step interval doubles after every
+``local_step_scaler`` steps, clipped to ``local_step_clipper``
+(ref :265-270, :282-287).
+"""
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.flatten_util
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.runtime.comm.compressed import compressed_allreduce, padded_chunk_size
+from deepspeed_tpu.utils.logging import log_dist
+
+DP_AXES = ("data", "fsdp")
+
+
+def interval_at(step: int, scaler: int, clipper: Optional[int] = None) -> int:
+    """Interval in effect at 1-indexed ``step``: starts at 1, held for
+    ``scaler`` on-interval events, then doubles (pure function of step —
+    O(log step), checkpoint-exact)."""
+    if step <= 0:
+        return 1
+    interval, consumed = 1, 0
+    while True:
+        span = scaler * interval  # steps spent while this interval is active
+        if step <= consumed + span:
+            break
+        consumed += span
+        interval *= 2
+        if clipper is not None and interval >= clipper:
+            interval = clipper
+            break
+    return interval if clipper is None else min(interval, clipper)
+
+
+class ZeroOneRunner:
+    """Owns the four programs + flat per-device buffers for one engine."""
+
+    def __init__(self, engine, cfg: dict):
+        self.engine = engine
+        self.cfg = cfg
+        self.mesh = engine.mesh
+        self.world = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
+        self._p1_dense = None
+        self._p1_cgrad = None
+        self._p2_local = None
+        self._p2_sync = None
+        self._bufs = None          # (ew, es) phase-1 / reused in phase 2
+        self._p2_state = None      # (m_local, u) — allocated on freeze
+        self._lrs_since_sync = 0.0
+
+    # ------------------------------------------------------------------
+    def _step_lr(self, count: int) -> float:
+        lr = self.cfg["lr"]
+        return float(lr(count)) if callable(lr) else float(lr)
+
+    def _flat_size(self):
+        n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self.engine.state.params))
+        return n, padded_chunk_size(n, self.world)
+
+    def _ensure_error_bufs(self):
+        if self._bufs is not None:
+            return
+        n, m = self._flat_size()
+        sh = NamedSharding(self.mesh, P(DP_AXES))
+        zeros = jax.jit(lambda: (jnp.zeros((self.world, n), jnp.float32),
+                                 jnp.zeros((self.world, m), jnp.float32)),
+                        out_shardings=(sh, sh))
+        self._bufs = zeros()
+
+    def _ensure_p2_state(self):
+        """On entering phase 2: zero the error buffers (they switch from
+        gradient- to momentum-metric, ref zoadam.py:330-338) and seed every
+        device's local momentum with the shared one."""
+        if self._p2_state is not None:
+            return
+        n, m = self._flat_size()
+        sh = NamedSharding(self.mesh, P(DP_AXES))
+        flat_m, _ = jax.flatten_util.ravel_pytree(jax.device_get(self.engine.state.opt_state.exp_avg))
+        seed = jax.jit(lambda fm: (jnp.broadcast_to(fm[None, :], (self.world, n)),
+                                   jnp.zeros((self.world, n), jnp.float32)),
+                       out_shardings=(sh, sh))
+        self._p2_state = seed(jnp.asarray(flat_m))
+        zeros = jax.jit(lambda: (jnp.zeros((self.world, n), jnp.float32),
+                                 jnp.zeros((self.world, m), jnp.float32)),
+                        out_shardings=(sh, sh))
+        self._bufs = zeros()  # reinitialized: metric changed
+        log_dist("0/1 Adam: entering local-step phase (variance frozen, "
+                 "collectives only on sync steps)")
+
+    # ------------------------------------------------------------------
+    # checkpoint plumbing: the per-device buffers are real optimizer state
+    # (pending local updates live in u) — engine.save/load_checkpoint calls
+    # these so a phase-2 resume is exact
+    # ------------------------------------------------------------------
+    def state_dict(self) -> Optional[dict]:
+        def fetch(b):
+            if jax.process_count() > 1:
+                # the buffers span processes (P over the DP axes)
+                from jax.experimental import multihost_utils
+                return np.asarray(multihost_utils.process_allgather(b, tiled=True))
+            return np.asarray(jax.device_get(b))
+
+        out = {"lrs_since_sync": self._lrs_since_sync}
+        if self._bufs is not None:
+            out["ew"], out["es"] = (fetch(b) for b in self._bufs)
+        if self._p2_state is not None:
+            out["m_local"], out["u"] = (fetch(b) for b in self._p2_state)
+        return out
+
+    def load_state_dict(self, blob: dict) -> None:
+        sh = NamedSharding(self.mesh, P(DP_AXES))
+        self._lrs_since_sync = float(blob.get("lrs_since_sync", 0.0))
+        if "ew" in blob:
+            self._bufs = (jax.device_put(blob["ew"], sh), jax.device_put(blob["es"], sh))
+        if "m_local" in blob:
+            self._p2_state = (jax.device_put(blob["m_local"], sh),
+                              jax.device_put(blob["u"], sh))
+
+    # ------------------------------------------------------------------
+    # program builders (all shard_map over the DP axes on flat storage)
+    # ------------------------------------------------------------------
+    def _local_grads(self, params, local_batch, keys, scale, dp_idx):
+        eng = self.engine
+
+        def micro(acc, xs):
+            mb, key = xs
+            key = jax.random.fold_in(key, dp_idx)
+            (_, loss), grads = jax.value_and_grad(eng._loss_for, has_aux=True)(params, mb, key, scale)
+            return jax.tree.map(jnp.add, acc, jax.tree.map(lambda g: g.astype(jnp.float32), grads)), loss
+
+        gas = eng.config.gradient_accumulation_steps
+        zeros_g = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        grads, losses = jax.lax.scan(micro, zeros_g, (local_batch, keys))
+        flat_g, unravel = jax.flatten_util.ravel_pytree(
+            jax.tree.map(lambda g: g / (gas * scale), grads))
+        return flat_g, unravel, losses.mean()
+
+    def _common_specs(self, batch):
+        eng = self.engine
+        batch_spec = eng._batch_spec(with_gas_dim=True)
+        batch_in_specs = jax.tree.map(lambda x: P(*batch_spec[:x.ndim]), batch)
+        p_specs = jax.tree.map(lambda _: P(), eng.state.params)
+        opt_specs = jax.tree.map(lambda _: P(), eng.state.opt_state)
+        return batch_in_specs, p_specs, opt_specs
+
+    def _build_phase1(self, batch):
+        eng = self.engine
+        cfg = self.cfg
+        b1, b2 = cfg["betas"]
+        eps, wd = cfg["eps"], cfg["weight_decay"]
+        world = self.world
+        batch_in_specs, p_specs, opt_specs = self._common_specs(batch)
+
+        def dense_body(params, opt, local_batch, keys, scale, step_lr, do_var):
+            dp_idx = jax.lax.axis_index(DP_AXES)
+            flat_g, unravel, loss = self._local_grads(params, local_batch, keys, scale, dp_idx)
+            flat_g = jax.lax.pmean(flat_g, DP_AXES)
+            bad = ~jnp.isfinite(jnp.sum(jnp.abs(flat_g)))
+            flat_m, _ = jax.flatten_util.ravel_pytree(opt.exp_avg)
+            flat_v, _ = jax.flatten_util.ravel_pytree(opt.exp_avg_sq)
+            flat_p, _ = jax.flatten_util.ravel_pytree(params)
+
+            m = b1 * flat_m + (1 - b1) * flat_g
+            v = jnp.where(do_var, b2 * flat_v + (1 - b2) * jnp.square(flat_g), flat_v)
+            upd = m / (jnp.sqrt(v) + eps) + (wd * flat_p if wd > 0.0 else 0.0)
+            p_new = flat_p - step_lr * upd
+
+            keep = lambda new, old: jnp.where(bad, old, new)
+            count = jnp.where(bad, opt.count, opt.count + 1)
+            new_opt = opt._replace(count=count, exp_avg=unravel(keep(m, flat_m)),
+                                   exp_avg_sq=unravel(keep(v, flat_v)))
+            gnorm = jnp.sqrt(jnp.sum(jnp.square(flat_g)))
+            return unravel(keep(p_new, flat_p)), new_opt, jax.lax.pmean(loss, DP_AXES), gnorm, bad
+
+        def cgrad_body(params, opt, ew, es, local_batch, keys, scale, step_lr):
+            dp_idx = jax.lax.axis_index(DP_AXES)
+            flat_g, unravel, loss = self._local_grads(params, local_batch, keys, scale, dp_idx)
+            local_bad = ~jnp.isfinite(jnp.sum(jnp.abs(flat_g)))
+            bad = jax.lax.pmax(local_bad.astype(jnp.int32), DP_AXES).astype(bool)
+            # the only gradient-sized traffic: packed sign bits
+            g1, ew_new, es_new = compressed_allreduce(flat_g, ew[0], es[0], DP_AXES, world)
+            flat_m, _ = jax.flatten_util.ravel_pytree(opt.exp_avg)
+            flat_v, _ = jax.flatten_util.ravel_pytree(opt.exp_avg_sq)
+            flat_p, _ = jax.flatten_util.ravel_pytree(params)
+
+            m = b1 * flat_m + (1 - b1) * g1
+            upd = m / (jnp.sqrt(flat_v) + eps) + (wd * flat_p if wd > 0.0 else 0.0)
+            p_new = flat_p - step_lr * upd
+
+            keep = lambda new, old: jnp.where(bad, old, new)
+            count = jnp.where(bad, opt.count, opt.count + 1)
+            new_opt = opt._replace(count=count, exp_avg=unravel(keep(m, flat_m)))
+            gnorm = jnp.sqrt(jnp.sum(jnp.square(g1)))
+            return (unravel(keep(p_new, flat_p)), new_opt, keep(ew_new, ew[0])[None],
+                    keep(es_new, es[0])[None], jax.lax.pmean(loss, DP_AXES), gnorm, bad)
+
+        mesh = self.mesh
+        self._p1_dense = jax.jit(jax.shard_map(
+            dense_body, mesh=mesh,
+            in_specs=(p_specs, opt_specs, batch_in_specs, P(), P(), P(), P()),
+            out_specs=(p_specs, opt_specs, P(), P(), P()), check_vma=False))
+        self._p1_cgrad = jax.jit(jax.shard_map(
+            cgrad_body, mesh=mesh,
+            in_specs=(p_specs, opt_specs, P(DP_AXES), P(DP_AXES), batch_in_specs, P(), P(), P()),
+            out_specs=(p_specs, opt_specs, P(DP_AXES), P(DP_AXES), P(), P(), P()),
+            check_vma=False), donate_argnums=(2, 3))
+
+    def _build_phase2(self, batch):
+        eng = self.engine
+        cfg = self.cfg
+        b1, _ = cfg["betas"]
+        eps, wd = cfg["eps"], cfg["weight_decay"]
+        world = self.world
+        batch_in_specs, p_specs, opt_specs = self._common_specs(batch)
+
+        def local_core(params, opt, m_local, u, local_batch, keys, scale, step_lr):
+            dp_idx = jax.lax.axis_index(DP_AXES)
+            flat_p, unravel_p = jax.flatten_util.ravel_pytree(params)
+            p_eff_flat = flat_p + u[0]
+            p_eff = unravel_p(p_eff_flat)
+            flat_g, _, loss = self._local_grads(p_eff, local_batch, keys, scale, dp_idx)
+            bad = ~jnp.isfinite(jnp.sum(jnp.abs(flat_g)))
+            flat_v, _ = jax.flatten_util.ravel_pytree(opt.exp_avg_sq)
+
+            m_new = b1 * m_local[0] + (1 - b1) * flat_g
+            upd = m_new / (jnp.sqrt(flat_v) + eps) + (wd * p_eff_flat if wd > 0.0 else 0.0)
+            u_new = u[0] - step_lr * upd
+
+            keep = lambda new, old: jnp.where(bad, old, new)
+            return keep(m_new, m_local[0]), keep(u_new, u[0]), flat_p, flat_v, loss, bad
+
+        def local_body(params, opt, m_local, u, local_batch, keys, scale, step_lr):
+            m_new, u_new, _, _, loss, bad = local_core(params, opt, m_local, u,
+                                                      local_batch, keys, scale, step_lr)
+            # count advances on every device identically (host schedule
+            # depends on it); per-device overflow only skips that device's
+            # local update
+            new_opt = opt._replace(count=opt.count + 1)
+            unorm = jnp.sqrt(jnp.sum(jnp.square(u_new)))
+            # NOTE deliberately NO collective in this program — losses/norms
+            # come back per-device and are averaged on host
+            return new_opt, m_new[None], u_new[None], loss[None], unorm[None]
+
+        def sync_body(params, opt, m_local, u, ew, es, local_batch, keys, scale, step_lr, lrs):
+            m_new, u_new, flat_p, flat_v, loss, _ = local_core(params, opt, m_local, u,
+                                                               local_batch, keys, scale, step_lr)
+            # momentum-space re-sync (ref zoadam.py:248-260)
+            buf = u_new * (jnp.sqrt(flat_v) + eps)
+            buf_sync, ew_new, es_new = compressed_allreduce(buf, ew[0], es[0], DP_AXES, world)
+            # a zero-lr interval carries no update mass: dividing by the
+            # clamp would wipe (or explode) the momentum — keep the old one
+            flat_m_old, _ = jax.flatten_util.ravel_pytree(opt.exp_avg)
+            lr_ok = lrs > 1e-12
+            m_shared = jnp.where(lr_ok, -buf_sync / jnp.maximum(lrs, 1e-12), flat_m_old)
+            p_new = flat_p + buf_sync / (jnp.sqrt(flat_v) + eps)
+
+            _, unravel_p = jax.flatten_util.ravel_pytree(params)
+            _, unravel_m = jax.flatten_util.ravel_pytree(opt.exp_avg)
+            new_opt = opt._replace(count=opt.count + 1, exp_avg=unravel_m(m_shared))
+            gnorm = jnp.sqrt(jnp.sum(jnp.square(buf_sync)))
+            zeros_u = jnp.zeros_like(u_new)
+            return (unravel_p(p_new), new_opt, m_shared[None],
+                    zeros_u[None], ew_new[None], es_new[None],
+                    jax.lax.pmean(loss, DP_AXES), gnorm)
+
+        mesh = self.mesh
+        self._p2_local = jax.jit(jax.shard_map(
+            local_body, mesh=mesh,
+            in_specs=(p_specs, opt_specs, P(DP_AXES), P(DP_AXES), batch_in_specs, P(), P(), P()),
+            out_specs=(opt_specs, P(DP_AXES), P(DP_AXES), P(DP_AXES), P(DP_AXES)),
+            check_vma=False), donate_argnums=(2, 3))
+        self._p2_sync = jax.jit(jax.shard_map(
+            sync_body, mesh=mesh,
+            in_specs=(p_specs, opt_specs, P(DP_AXES), P(DP_AXES), P(DP_AXES), P(DP_AXES),
+                      batch_in_specs, P(), P(), P(), P()),
+            out_specs=(p_specs, opt_specs, P(DP_AXES), P(DP_AXES), P(DP_AXES), P(DP_AXES),
+                       P(), P()),
+            check_vma=False), donate_argnums=(2, 3, 4, 5))
+
+    # ------------------------------------------------------------------
+    def step(self, device_batch, rng):
+        """Run one global step; mutates engine.state; returns metrics."""
+        eng = self.engine
+        cfg = self.cfg
+        state = eng.state
+        t = int(jax.device_get(state.opt_state.count)) + 1  # 1-indexed step
+        step_lr = self._step_lr(t)
+        scale = jnp.float32(1.0)
+        keys = jax.random.split(rng, eng.config.gradient_accumulation_steps)
+        freeze = cfg["var_freeze_step"]
+
+        if t <= freeze:
+            if self._p1_dense is None:
+                self._build_phase1(device_batch)
+            var_interval = interval_at(t, cfg["var_update_scaler"])
+            if t % var_interval == 0:
+                new_params, new_opt, loss, gnorm, overflow = self._p1_dense(
+                    state.params, state.opt_state, device_batch, keys, scale,
+                    jnp.float32(step_lr), jnp.bool_(True))
+            else:
+                self._ensure_error_bufs()
+                ew, es = self._bufs
+                new_params, new_opt, ew, es, loss, gnorm, overflow = self._p1_cgrad(
+                    state.params, state.opt_state, ew, es, device_batch, keys, scale,
+                    jnp.float32(step_lr))
+                self._bufs = (ew, es)
+            eng.state = state._replace(step=state.step + 1, params=new_params, opt_state=new_opt)
+            self._lrs_since_sync = 0.0
+            return {"loss": loss, "grad_norm": gnorm, "overflow": overflow,
+                    "loss_scale": state.loss_scale.loss_scale}
+
+        # ---- phase 2: variance frozen; local steps + periodic 1-bit sync
+        self._ensure_p2_state()
+        if self._p2_local is None:
+            self._build_phase2(device_batch)
+        m_local, u = self._p2_state
+        ew, es = self._bufs
+        s = t - freeze
+        local_interval = interval_at(s, cfg["local_step_scaler"], cfg["local_step_clipper"])
+        self._lrs_since_sync += step_lr
+
+        if s % local_interval == 0:
+            new_params, new_opt, m_local, u, ew, es, loss, gnorm = self._p2_sync(
+                state.params, state.opt_state, m_local, u, ew, es, device_batch, keys, scale,
+                jnp.float32(step_lr), jnp.float32(self._lrs_since_sync))
+            eng.state = state._replace(step=state.step + 1, params=new_params, opt_state=new_opt)
+            self._p2_state = (m_local, u)
+            self._bufs = (ew, es)
+            self._lrs_since_sync = 0.0
+            overflow = jnp.bool_(False)
+        else:
+            new_opt, m_local, u, losses, unorms = self._p2_local(
+                state.params, state.opt_state, m_local, u, device_batch, keys, scale,
+                jnp.float32(step_lr))
+            eng.state = state._replace(step=state.step + 1, opt_state=new_opt)
+            self._p2_state = (m_local, u)
+            loss = jnp.mean(losses)
+            gnorm = jnp.mean(unorms)
+            overflow = jnp.bool_(False)
+        return {"loss": loss, "grad_norm": gnorm, "overflow": overflow,
+                "loss_scale": state.loss_scale.loss_scale}

@@ -1,0 +1,188 @@
+"""Compression library tests (reference ``tests/unit/compression/
+test_compression.py``): config parsing, technique primitives, scheduled
+engine training, redundancy_clean permanence, compressed export size."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.compression import (build_compression_transform, export_compressed,
+                                       get_compression_config, init_compression,
+                                       load_compressed, redundancy_clean)
+from deepspeed_tpu.compression import basic_layer as BL
+from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
+
+
+@pytest.fixture(autouse=True)
+def _clear_topology():
+    set_topology(None)
+    yield
+    set_topology(None)
+
+
+# ---------------------------------------------------------------------------
+# config parsing (reference compression/config.py)
+# ---------------------------------------------------------------------------
+def test_config_defaults_and_groups():
+    cfg = get_compression_config({
+        "compression_training": {
+            "weight_quantization": {
+                "shared_parameters": {"enabled": True, "schedule_offset": 5,
+                                      "quantize_groups": 4},
+                "different_groups": {
+                    "wq1": {"params": {"start_bits": 8, "target_bits": 4,
+                                       "quantization_period": 10},
+                            "modules": ["attn.c_attn"]},
+                },
+            },
+            "row_pruning": {
+                "shared_parameters": {"enabled": True, "schedule_offset": 3},
+                "different_groups": {"rp1": {"params": {"dense_ratio": 0.5},
+                                             "modules": ["mlp"]}},
+            },
+        },
+    })
+    wq = cfg["weight_quantization"]
+    assert wq["shared_parameters"]["enabled"] and wq["shared_parameters"]["quantize_groups"] == 4
+    assert wq["different_groups"]["wq1"]["params"]["target_bits"] == 4
+    assert cfg["row_pruning"]["different_groups"]["rp1"]["params"]["dense_ratio"] == 0.5
+    assert not cfg["sparse_pruning"]["shared_parameters"]["enabled"]
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+def test_qdq_weight_levels():
+    w = jnp.asarray(np.random.default_rng(0).normal(size=(64, 64)), jnp.float32)
+    dq = BL.qdq_weight(w, 4.0, groups=1)
+    # 4-bit symmetric: at most 16 distinct levels
+    assert len(np.unique(np.asarray(dq))) <= 16
+    # STE: gradient is identity
+    g = jax.grad(lambda x: BL.qdq_weight(x, 4.0).sum())(w)
+    np.testing.assert_allclose(np.asarray(g), 1.0)
+
+
+def test_scheduled_bits_halves():
+    bits = [float(BL.scheduled_bits(jnp.asarray(t), 8, 2, 10)) for t in (0, 9, 10, 20, 30, 100)]
+    assert bits[0] == 8.0 and bits[2] == 4.0 and bits[3] == 2.0 and bits[-1] == 2.0
+
+
+def test_row_prune_mask():
+    w = jnp.asarray(np.arange(1, 13, dtype=np.float32).reshape(3, 4))
+    mask = BL.row_prune_mask(w, dense_ratio=0.5)
+    kept_cols = np.asarray(mask[0])  # broadcast over rows
+    assert kept_cols.sum() == 2  # keep top-2 of 4 output columns
+    assert kept_cols[-1] == 1 and kept_cols[0] == 0  # largest-l1 columns kept
+
+
+def test_head_prune_mask():
+    w = np.ones((8, 12), np.float32)
+    w[:, 8:] = 10.0  # head 2 (of 3, 4 cols each) dominates
+    mask = np.asarray(BL.head_prune_mask(jnp.asarray(w), dense_ratio=1 / 3, num_heads=3))
+    assert mask[:, 8:].all() and not mask[:, :8].any()
+
+
+def test_sparse_prune_mask_ratio():
+    w = jnp.asarray(np.random.default_rng(1).normal(size=(32, 32)), jnp.float32)
+    mask = np.asarray(BL.sparse_prune_mask(w, dense_ratio=0.25))
+    assert abs(mask.mean() - 0.25) < 0.01
+
+
+# ---------------------------------------------------------------------------
+# transform + schedule gating
+# ---------------------------------------------------------------------------
+def _wq_config(offset=2, target_bits=4, modules=("*",)):
+    return {"compression_training": {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": offset},
+            "different_groups": {"wq1": {"params": {"start_bits": target_bits,
+                                                    "target_bits": target_bits},
+                                         "modules": list(modules)}},
+        },
+    }}
+
+
+def test_transform_schedule_gate():
+    params = {"layer": {"kernel": jnp.asarray(np.random.default_rng(2).normal(size=(16, 16)),
+                                              jnp.float32),
+                        "bias": jnp.zeros((16,))}}
+    fn = build_compression_transform(params, _wq_config(offset=5))
+    before = fn(params, jnp.asarray(0))
+    after = fn(params, jnp.asarray(5))
+    np.testing.assert_array_equal(np.asarray(before["layer"]["kernel"]),
+                                  np.asarray(params["layer"]["kernel"]))  # gated off
+    assert len(np.unique(np.asarray(after["layer"]["kernel"]))) <= 16  # 4-bit active
+    # bias untouched (only matrix kernels compress)
+    np.testing.assert_array_equal(np.asarray(after["layer"]["bias"]), 0.0)
+
+
+def test_engine_trains_with_compression():
+    cfg = get_gpt2_config("test", n_embd=64, n_head=4, n_positions=32)
+    ds = {"train_batch_size": 8,
+          "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+          "zero_optimization": {"stage": 1}}
+    ds.update(_wq_config(offset=2, target_bits=8))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2LMHeadModel(cfg), config=ds,
+                                               topology=MeshTopology(data=8))
+    init_compression(engine)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (8, 32)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch)) for _ in range(6)]
+    assert engine._compression_transform is not None
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+
+
+def test_redundancy_clean_and_export(tmp_path):
+    cfg_model = get_gpt2_config("test", n_embd=64, n_head=4, n_positions=32, n_layer=1)
+    model = GPT2LMHeadModel(cfg_model)
+    import flax.linen as nn
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = nn.meta.unbox(model.init(jax.random.PRNGKey(0), ids, deterministic=True))["params"]
+
+    ds = {"compression_training": {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {"wq": {"params": {"start_bits": 8, "target_bits": 8},
+                                        "modules": ["mlp"]}},
+        },
+        "row_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {"rp": {"params": {"dense_ratio": 0.5},
+                                        "modules": ["attn.c_proj"]}},
+        },
+    }}
+    cleaned = redundancy_clean(params, ds)
+    proj = np.asarray(cleaned["h_0"]["attn"]["c_proj"]["kernel"])
+    zero_cols = (np.abs(proj).sum(axis=0) == 0).mean()
+    assert abs(zero_cols - 0.5) < 0.1, f"row pruning not permanent: {zero_cols}"
+
+    out = export_compressed(params, ds, str(tmp_path / "deploy"))
+    assert os.path.exists(out)
+    manifest = json.load(open(tmp_path / "deploy" / "compression_manifest.json"))
+    assert any("mlp" in p for p in manifest["int8_params"])
+    # int8 storage beats a plain fp32 npz for the quantized leaves
+    from deepspeed_tpu.checkpoint.zero_to_fp32 import _flatten, save_npz
+    save_npz(str(tmp_path / "fp32.npz"), _flatten(jax.device_get(params)))
+    assert os.path.getsize(out) < os.path.getsize(tmp_path / "fp32.npz")
+
+    # loader round-trips: quantized leaves within int8 tolerance
+    loaded = load_compressed(str(tmp_path / "deploy"))
+    want = np.asarray(cleaned["h_0"]["mlp"]["c_fc"]["kernel"])
+    got = loaded["h_0"]["mlp"]["c_fc"]["kernel"]
+    err = np.abs(got - want).max() / (np.abs(want).max() + 1e-9)
+    assert err < 0.02, f"int8 round-trip error {err}"
+
+
+def test_activation_quantizer():
+    x = jnp.asarray(np.random.default_rng(3).normal(size=(4, 32)), jnp.float32)
+    q = BL.quantize_activation(x, bits=8)
+    assert np.abs(np.asarray(q) - np.asarray(x)).max() < 0.05
+    g = jax.grad(lambda v: BL.quantize_activation(v).sum())(x)
+    np.testing.assert_allclose(np.asarray(g), 1.0)
