@@ -1,16 +1,20 @@
 // Async host file I/O engine for NVMe offload (ZeRO-Infinity spill).
 // TPU-native counterpart of reference csrc/aio/ (deepspeed_py_aio_handle.cpp,
 // deepspeed_aio_common.cpp): a thread-pool handle with submit/wait semantics.
-// The reference drives libaio O_DIRECT; this engine uses a worker pool of
-// pread/pwrite (the reference's own fallback scheme) — same interface
-// contract: async submit, bounded queue, explicit wait.
+// Like the reference (deepspeed_aio_common.cpp:335 O_DIRECT regular_read_write),
+// the data path can bypass the page cache: O_DIRECT transfers through a
+// posix_memalign'd bounce buffer in aligned chunks, with the unaligned tail
+// finished on a buffered descriptor + fsync. Falls back to plain
+// pread/pwrite where the filesystem refuses O_DIRECT (tmpfs).
 //
 // C ABI for ctypes.
 
+#include <algorithm>
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <fcntl.h>
 #include <functional>
@@ -32,6 +36,7 @@ struct AioHandle {
     std::atomic<int64_t> inflight{0};
     std::atomic<int64_t> errors{0};
     bool stop = false;
+    bool direct = false;  // O_DIRECT data path (page-cache bypass)
 
     explicit AioHandle(int n_threads) {
         for (int i = 0; i < n_threads; ++i) {
@@ -80,42 +85,119 @@ struct AioHandle {
     }
 };
 
-bool write_all(const char* path, const void* buf, int64_t nbytes) {
-    int fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
-    if (fd < 0) return false;
-    const char* src = (const char*)buf;
+constexpr int64_t kAlign = 4096;           // O_DIRECT sector alignment
+constexpr int64_t kBounce = 8 * 1024 * 1024;  // bounce-buffer chunk
+
+bool write_all_buffered(int fd, const char* src, int64_t nbytes, off_t base) {
     int64_t left = nbytes;
     off_t off = 0;
     while (left > 0) {
-        ssize_t w = ::pwrite(fd, src + off, (size_t)left, off);
-        if (w <= 0) {
-            ::close(fd);
-            return false;
-        }
+        ssize_t w = ::pwrite(fd, src + off, (size_t)left, base + off);
+        if (w <= 0) return false;
         left -= w;
         off += w;
     }
-    ::close(fd);
     return true;
 }
 
-bool read_all(const char* path, void* buf, int64_t nbytes) {
-    int fd = ::open(path, O_RDONLY);
-    if (fd < 0) return false;
-    char* dst = (char*)buf;
+bool read_all_buffered(int fd, char* dst, int64_t nbytes, off_t base) {
     int64_t left = nbytes;
     off_t off = 0;
     while (left > 0) {
-        ssize_t r = ::pread(fd, dst + off, (size_t)left, off);
-        if (r <= 0) {
-            ::close(fd);
-            return false;
-        }
+        ssize_t r = ::pread(fd, dst + off, (size_t)left, base + off);
+        if (r <= 0) return false;
         left -= r;
         off += r;
     }
-    ::close(fd);
     return true;
+}
+
+bool write_all(const char* path, const void* buf, int64_t nbytes, bool use_direct) {
+    const char* src = (const char*)buf;
+#ifdef O_DIRECT
+    if (use_direct && nbytes >= kAlign) {
+        int dfd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC | O_DIRECT, 0644);
+        if (dfd >= 0) {
+            void* bounce = nullptr;
+            if (posix_memalign(&bounce, (size_t)kAlign, (size_t)kBounce) != 0) {
+                ::close(dfd);
+                return false;
+            }
+            int64_t aligned = (nbytes / kAlign) * kAlign;
+            bool ok = true;
+            for (off_t off = 0; ok && off < aligned;) {
+                int64_t n = std::min<int64_t>(kBounce, aligned - off);
+                std::memcpy(bounce, src + off, (size_t)n);
+                ssize_t w = ::pwrite(dfd, bounce, (size_t)n, off);
+                ok = (w == n);
+                off += n;
+            }
+            ::close(dfd);
+            free(bounce);
+            if (!ok) return false;
+            if (aligned < nbytes) {  // unaligned tail: buffered append + fsync
+                int fd = ::open(path, O_WRONLY, 0644);
+                if (fd < 0) return false;
+                bool tail_ok = write_all_buffered(fd, src + aligned, nbytes - aligned, aligned);
+                if (tail_ok) ::fsync(fd);
+                ::close(fd);
+                return tail_ok;
+            }
+            return true;
+        }
+        // open with O_DIRECT failed (e.g. tmpfs): buffered fallback below
+    }
+#else
+    (void)use_direct;
+#endif
+    int fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    if (fd < 0) return false;
+    bool ok = write_all_buffered(fd, src, nbytes, 0);
+    ::close(fd);
+    return ok;
+}
+
+bool read_all(const char* path, void* buf, int64_t nbytes, bool use_direct) {
+    char* dst = (char*)buf;
+#ifdef O_DIRECT
+    if (use_direct && nbytes >= kAlign) {
+        int dfd = ::open(path, O_RDONLY | O_DIRECT);
+        if (dfd >= 0) {
+            void* bounce = nullptr;
+            if (posix_memalign(&bounce, (size_t)kAlign, (size_t)kBounce) != 0) {
+                ::close(dfd);
+                return false;
+            }
+            int64_t aligned = (nbytes / kAlign) * kAlign;
+            bool ok = true;
+            for (off_t off = 0; ok && off < aligned;) {
+                int64_t n = std::min<int64_t>(kBounce, aligned - off);
+                ssize_t r = ::pread(dfd, bounce, (size_t)n, off);
+                ok = (r == n);
+                if (ok) std::memcpy(dst + off, bounce, (size_t)n);
+                off += n;
+            }
+            ::close(dfd);
+            free(bounce);
+            if (!ok) return false;
+            if (aligned < nbytes) {  // tail via buffered descriptor
+                int fd = ::open(path, O_RDONLY);
+                if (fd < 0) return false;
+                bool tail_ok = read_all_buffered(fd, dst + aligned, nbytes - aligned, aligned);
+                ::close(fd);
+                return tail_ok;
+            }
+            return true;
+        }
+    }
+#else
+    (void)use_direct;
+#endif
+    int fd = ::open(path, O_RDONLY);
+    if (fd < 0) return false;
+    bool ok = read_all_buffered(fd, dst, nbytes, 0);
+    ::close(fd);
+    return ok;
 }
 
 }  // namespace
@@ -127,6 +209,15 @@ void* aio_handle_create(int n_threads) {
     return new AioHandle(n_threads);
 }
 
+// reference aio_config single_submit/overlap_events knobs are owned by the
+// pool; use_direct selects the page-cache-bypassing path
+void* aio_handle_create2(int n_threads, int use_direct) {
+    if (n_threads < 1) n_threads = 1;
+    auto* h = new AioHandle(n_threads);
+    h->direct = use_direct != 0;
+    return h;
+}
+
 void aio_handle_destroy(void* h) { delete (AioHandle*)h; }
 
 // async write of nbytes from buf to path (buf must stay alive until wait)
@@ -134,7 +225,7 @@ void aio_pwrite_async(void* h, const char* path, const void* buf, int64_t nbytes
     auto* handle = (AioHandle*)h;
     std::string p(path);
     handle->submit([handle, p, buf, nbytes] {
-        if (!write_all(p.c_str(), buf, nbytes)) ++handle->errors;
+        if (!write_all(p.c_str(), buf, nbytes, handle->direct)) ++handle->errors;
     });
 }
 
@@ -143,7 +234,7 @@ void aio_pread_async(void* h, const char* path, void* buf, int64_t nbytes) {
     auto* handle = (AioHandle*)h;
     std::string p(path);
     handle->submit([handle, p, buf, nbytes] {
-        if (!read_all(p.c_str(), buf, nbytes)) ++handle->errors;
+        if (!read_all(p.c_str(), buf, nbytes, handle->direct)) ++handle->errors;
     });
 }
 
@@ -153,11 +244,11 @@ int aio_wait(void* h) { return ((AioHandle*)h)->wait(); }
 
 // synchronous helpers (reference deepspeed_py_aio.cpp sync paths)
 int aio_write_sync(const char* path, const void* buf, int64_t nbytes) {
-    return write_all(path, buf, nbytes) ? 0 : -1;
+    return write_all(path, buf, nbytes, false) ? 0 : -1;
 }
 
 int aio_read_sync(const char* path, void* buf, int64_t nbytes) {
-    return read_all(path, buf, nbytes) ? 0 : -1;
+    return read_all(path, buf, nbytes, false) ? 0 : -1;
 }
 
 }  // extern "C"

@@ -90,18 +90,7 @@ def load_universal_into_state(universal_dir: str, abstract_state, shardings):
     flat_abs, treedef = jax.tree_util.tree_flatten_with_path(abstract_state)
     flat_shard = jax.tree_util.tree_flatten_with_path(shardings)[0]
 
-    def norm(jax_path) -> str:
-        parts = []
-        for p in jax_path:
-            if hasattr(p, "key"):
-                parts.append(str(p.key))
-            elif hasattr(p, "name"):
-                parts.append(str(p.name))
-            elif hasattr(p, "idx"):
-                parts.append(str(p.idx))
-            else:
-                parts.append(str(p))
-        return "/".join(parts)
+    from deepspeed_tpu.utils.tree import keypath_str as norm
 
     leaves = []
     for (path, leaf), (_, shard) in zip(flat_abs, flat_shard):

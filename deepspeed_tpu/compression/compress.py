@@ -44,12 +44,9 @@ def _match(path: str, patterns: List[str]) -> bool:
 
 
 def _param_paths(params) -> List[Tuple[str, Any]]:
-    flat = jax.tree_util.tree_flatten_with_path(params)[0]
-    out = []
-    for kp, leaf in flat:
-        parts = [str(getattr(p, "key", getattr(p, "name", getattr(p, "idx", p)))) for p in kp]
-        out.append(("/".join(parts), leaf))
-    return out
+    from deepspeed_tpu.utils.tree import keypath_str
+    return [(keypath_str(kp), leaf)
+            for kp, leaf in jax.tree_util.tree_flatten_with_path(params)[0]]
 
 
 class CompressionSpec:
@@ -85,12 +82,12 @@ class CompressionSpec:
         rules = self.rules
 
         def apply(params, step):
+            from deepspeed_tpu.utils.tree import keypath_str
             step = jnp.asarray(step)
             flat = jax.tree_util.tree_flatten_with_path(params)
             leaves = []
             for kp, leaf in flat[0]:
-                parts = [str(getattr(p, "key", getattr(p, "name", getattr(p, "idx", p)))) for p in kp]
-                path = "/".join(parts)
+                path = keypath_str(kp)
                 for tech, gp, shared in rules.get(path, ()):
                     offset = int(shared.get("schedule_offset", 0))
                     active = step >= offset

@@ -1,0 +1,129 @@
+"""Paged KV cache: a shared page pool + per-sequence block tables
+(reference ``csrc/transformer/inference/includes/inference_context.h`` KV
+workspace management + ``pt_binding.cpp:1928`` ``allocate_workspace``).
+
+The reference carves one big workspace and hands each request offsets into
+it. The TPU formulation keeps a static-shape page pool
+``[num_pages, page_size, heads, dim]`` (XLA-friendly) and drives it with a
+host-side allocator: sequences own page lists, freeing returns pages to the
+pool, and ``gather`` materializes a dense [b, L] view for attention via one
+``jnp.take`` (the gather IS the block-table lookup). Memory scales with
+TOKENS IN FLIGHT, not batch × max_len.
+"""
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class PagedKVCache:
+    """One layer's K and V pools + the shared allocator state."""
+
+    def __init__(self, num_pages: int, page_size: int, num_heads: int, head_dim: int,
+                 num_layers: int = 1, dtype=jnp.bfloat16):
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.num_layers = num_layers
+        shape = (num_layers, num_pages, page_size, num_heads, head_dim)
+        self.k_pool = jnp.zeros(shape, dtype)
+        self.v_pool = jnp.zeros(shape, dtype)
+        self._free: List[int] = list(range(num_pages - 1, -1, -1))
+        self._tables: Dict[int, List[int]] = {}   # seq id -> page list
+        self._lengths: Dict[int, int] = {}        # seq id -> tokens used
+
+        # donated in-place page write: O(page) update, no pool copy
+        def write(pool, vals, layer, page, in_page):
+            return jax.lax.dynamic_update_slice(
+                pool, vals[None, None].astype(pool.dtype), (layer, page, in_page, 0, 0))
+
+        self._write = jax.jit(write, donate_argnums=(0,))
+
+    # ------------------------------------------------------------------
+    # allocator (host side — the reference's workspace bookkeeping)
+    # ------------------------------------------------------------------
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def allocate(self, seq_id: int) -> None:
+        assert seq_id not in self._tables, f"sequence {seq_id} already allocated"
+        self._tables[seq_id] = []
+        self._lengths[seq_id] = 0
+
+    def free(self, seq_id: int) -> None:
+        """Return a sequence's pages to the pool (reference frees by resetting
+        the workspace offset; pages make it per-sequence)."""
+        for p in self._tables.pop(seq_id):
+            self._free.append(p)
+        del self._lengths[seq_id]
+
+    def _ensure_capacity(self, seq_id: int, new_tokens: int) -> None:
+        need = self._lengths[seq_id] + new_tokens
+        have = len(self._tables[seq_id]) * self.page_size
+        while have < need:
+            if not self._free:
+                raise RuntimeError(f"KV page pool exhausted ({self.num_pages} pages of "
+                                   f"{self.page_size}); free finished sequences first")
+            self._tables[seq_id].append(self._free.pop())
+            have += self.page_size
+
+    def seq_len(self, seq_id: int) -> int:
+        return self._lengths[seq_id]
+
+    def block_table(self, seq_id: int) -> List[int]:
+        return list(self._tables[seq_id])
+
+    # ------------------------------------------------------------------
+    # device ops
+    # ------------------------------------------------------------------
+    def append(self, seq_id: int, k: jax.Array, v: jax.Array, layer: int = 0) -> None:
+        """Write [t, heads, dim] new tokens for one sequence/layer."""
+        t = k.shape[0]
+        if layer == 0:
+            self._ensure_capacity(seq_id, t)
+        start = self._lengths[seq_id]
+        table = self._tables[seq_id]
+        # split the token run across page boundaries; each write is a jitted
+        # donated dynamic_update_slice — O(page), never an O(pool) copy
+        off = 0
+        while off < t:
+            page_idx = (start + off) // self.page_size
+            in_page = (start + off) % self.page_size
+            n = min(self.page_size - in_page, t - off)
+            page = table[page_idx]
+            self.k_pool = self._write(self.k_pool, k[off:off + n],
+                                      jnp.int32(layer), jnp.int32(page), jnp.int32(in_page))
+            self.v_pool = self._write(self.v_pool, v[off:off + n],
+                                      jnp.int32(layer), jnp.int32(page), jnp.int32(in_page))
+            off += n
+        if layer == self.num_layers - 1:
+            self._lengths[seq_id] += t
+
+    def gather(self, seq_ids: List[int], layer: int = 0,
+               pad_to: Optional[int] = None) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """Dense [b, L, heads, dim] K/V views + [b] true lengths. ``pad_to``
+        buckets L so the consumer's attention program doesn't recompile per
+        batch composition."""
+        max_len = max(self._lengths[s] for s in seq_ids)
+        L = pad_to or max_len
+        assert L >= max_len
+        pages_per = (L + self.page_size - 1) // self.page_size
+        table = np.zeros((len(seq_ids), pages_per), np.int32)
+        for i, s in enumerate(seq_ids):
+            for j, p in enumerate(self._tables[s][:pages_per]):
+                table[i, j] = p
+        # one gather = the block-table lookup: [b, pages_per, page, h, d]
+        k = jnp.take(self.k_pool[layer], jnp.asarray(table), axis=0)
+        v = jnp.take(self.v_pool[layer], jnp.asarray(table), axis=0)
+        b = len(seq_ids)
+        k = k.reshape(b, pages_per * self.page_size, *k.shape[3:])[:, :L]
+        v = v.reshape(b, pages_per * self.page_size, *v.shape[3:])[:, :L]
+        lengths = jnp.asarray([self._lengths[s] for s in seq_ids], jnp.int32)
+        return k, v, lengths
+
+    def utilization(self) -> float:
+        used = self.num_pages - len(self._free)
+        return used / self.num_pages

@@ -38,6 +38,12 @@ class GPT2Config:
     dtype: Any = jnp.float32  # compute dtype; params stay in param_dtype
     param_dtype: Any = jnp.float32
     remat: bool = False
+    # jax.checkpoint policy name (runtime/activation_checkpointing: e.g.
+    # "dots_saveable" keeps matmul outputs, None = full recompute) and
+    # selective application (checkpoint every Nth block; reference
+    # ``number_checkpoints`` semantics)
+    remat_policy: Optional[str] = None
+    remat_every: int = 1
     attention_backend: str = "xla"
     # MoE (reference GPT-MoE configs: every other layer is an MoE FFN)
     moe_num_experts: int = 0  # 0 = dense model
@@ -235,12 +241,16 @@ class GPT2LMHeadModel(nn.Module):
         if not deterministic and cfg.dropout > 0.0:
             x = nn.Dropout(rate=cfg.dropout)(x, deterministic=False)
 
-        block_cls = Block
+        remat_cls = Block
         if cfg.remat:
-            block_cls = nn.remat(Block, static_argnums=(2,), prevent_cse=False)
+            from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import get_remat_policy
+            remat_cls = nn.remat(Block, static_argnums=(2,), prevent_cse=False,
+                                 policy=get_remat_policy(cfg.remat_policy))
         aux_total = jnp.zeros([], jnp.float32)
         for i in range(cfg.n_layer):
             use_moe = cfg.moe_num_experts > 0 and (i % cfg.moe_layer_freq == cfg.moe_layer_freq - 1)
+            # selective checkpointing: only every remat_every-th block recomputes
+            block_cls = remat_cls if (cfg.remat and i % max(cfg.remat_every, 1) == 0) else Block
             x, l_aux = block_cls(cfg, use_moe, decode, name=f"h_{i}")(x, deterministic)
             aux_total = aux_total + l_aux
         x = LayerNorm(cfg, name="ln_f")(x)

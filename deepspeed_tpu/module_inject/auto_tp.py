@@ -12,6 +12,8 @@ the (all-gather / all-reduce) collectives a Megatron layout implies.
 
 from typing import Optional, Sequence, Tuple
 
+import numpy as np
+
 import jax
 from jax.sharding import PartitionSpec as P
 
@@ -39,6 +41,18 @@ def _path_parts(path) -> Tuple[str, ...]:
 class AutoTP:
     """Classify params into TP shardings by path (reference ``AutoTP``)."""
 
+    _warned: set = set()
+
+    @staticmethod
+    def _warn_unmatched(path: str, shape) -> None:
+        if path not in AutoTP._warned:
+            AutoTP._warned.add(path)
+            from deepspeed_tpu.utils.logging import logger
+            logger.warning(f"AutoTP: no sharding rule matched {path!r} {tuple(shape)}; "
+                           f"the param stays REPLICATED — if this is a projection of an "
+                           f"unrecognized naming convention, pass an injection_policy "
+                           f"(reference auto_tp.py parses module graphs here)")
+
     @staticmethod
     def classify(path_parts: Sequence[str]) -> Optional[str]:
         for part in path_parts:
@@ -60,6 +74,11 @@ class AutoTP:
         role = AutoTP.classify(path_parts)
         is_bias = path_parts and path_parts[-1] in ("bias",)
         if role is None:
+            # the reference parses module graphs and errors on unsupported
+            # architectures (auto_tp.py is_load_module checks); name matching
+            # must at least SAY when a big kernel falls through to replication
+            if len(shape) >= 2 and int(np.prod(shape)) >= 1 << 16:
+                AutoTP._warn_unmatched("/".join(path_parts), shape)
             return P()
         if role == "vocab":
             if len(shape) >= 2 and shape[0] % tp_size == 0:

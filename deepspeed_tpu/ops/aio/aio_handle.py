@@ -16,9 +16,16 @@ class AsyncIOHandle:
     returns — same contract as the reference's pinned bounce buffers.
     """
 
-    def __init__(self, n_threads: int = 4):
+    def __init__(self, n_threads: int = 4, use_direct: bool = False):
+        """``use_direct=True`` bypasses the page cache via O_DIRECT +
+        aligned bounce buffers (reference ``deepspeed_aio_common.cpp:335``);
+        filesystems that refuse O_DIRECT fall back to buffered I/O."""
         self.lib = AsyncIOBuilder().load()
-        self._h = self.lib.aio_handle_create(int(n_threads))
+        if use_direct and hasattr(self.lib, "aio_handle_create2"):
+            self._h = self.lib.aio_handle_create2(int(n_threads), 1)
+        else:
+            self._h = self.lib.aio_handle_create(int(n_threads))
+        self.use_direct = use_direct
         self._pending = []  # keep buffer refs alive until wait()
 
     def pwrite(self, buf: np.ndarray, path: str):

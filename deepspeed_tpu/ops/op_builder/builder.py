@@ -119,6 +119,8 @@ class AsyncIOBuilder(OpBuilder):
     def _declare(self, lib):
         lib.aio_handle_create.argtypes = [ctypes.c_int]
         lib.aio_handle_create.restype = ctypes.c_void_p
+        lib.aio_handle_create2.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.aio_handle_create2.restype = ctypes.c_void_p
         lib.aio_handle_destroy.argtypes = [ctypes.c_void_p]
         lib.aio_pwrite_async.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
                                          ctypes.c_int64]

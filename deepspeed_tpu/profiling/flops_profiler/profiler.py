@@ -158,7 +158,8 @@ def profile_engine_step(engine, device_batch, rng, step_latency_s=None,
         if engine._eval_step_fn is not None:
             # device_batch is [gas, micro, ...]; the eval step takes one microbatch
             eval_batch = jax.tree.map(lambda x: x[0], device_batch)
-            fwd_compiled = engine._eval_step_fn.lower(engine.state.params, eval_batch).compile()
+            fwd_compiled = engine._eval_step_fn.lower(engine.state.params, eval_batch,
+                                                      engine.state.step).compile()
     except Exception as e:
         notes.append(f"fwd cost unavailable: {type(e).__name__}: {e}")
     ids = device_batch["input_ids"] if isinstance(device_batch, dict) else device_batch

@@ -1,0 +1,114 @@
+"""Activation checkpointing (reference
+``runtime/activation_checkpointing/checkpointing.py``: ``configure`` :789,
+``CheckpointFunction`` :366, ``checkpoint()`` entry :978).
+
+On TPU, rematerialization is ``jax.checkpoint``: the reference's manual
+stash/recompute machinery (RNG fork tracking, partitioned/cpu-offloaded
+stashes) collapses into XLA remat policies. What survives as real surface:
+
+* a POLICY CHOICE — which intermediates are worth keeping in HBM
+  (``dots_saveable`` keeps matmul outputs: recompute elementwise only;
+  ``nothing_saveable`` recomputes everything: minimum memory; etc.);
+* the module-level ``configure()``/``checkpoint()`` API user code calls;
+* ``partition_activations`` → saved activations keep their sequence/tensor
+  shardings (XLA does this natively for sharded residuals — accepted,
+  no-op); ``cpu_checkpointing`` → ``jax.checkpoint`` offload policies.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+
+from deepspeed_tpu.utils.logging import log_dist
+
+# name → jax.checkpoint policy (None = save everything, i.e. no remat gain)
+POLICIES = {
+    "everything_saveable": jax.checkpoint_policies.everything_saveable,
+    "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+    "dots_saveable": jax.checkpoint_policies.dots_saveable,
+    "checkpoint_dots": jax.checkpoint_policies.checkpoint_dots,
+    "dots_with_no_batch_dims_saveable": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "checkpoint_dots_with_no_batch_dims": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+}
+
+
+def get_remat_policy(name: Optional[str]):
+    """Resolve a policy name; None → full recompute (``nothing_saveable``
+    semantics of plain ``jax.checkpoint``)."""
+    if name is None:
+        return None
+    if name not in POLICIES:
+        raise ValueError(f"unknown remat policy {name!r}; choose from {sorted(POLICIES)}")
+    return POLICIES[name]
+
+
+class _State:
+    configured = False
+    partition_activations = False
+    contiguous_checkpointing = False
+    cpu_checkpointing = False
+    num_checkpoints: Optional[int] = None
+    synchronize = False
+    profile = False
+    policy_name: Optional[str] = None
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, checkpoint_in_cpu=None, synchronize=None,
+              profile=None, num_checkpoints=None, policy: Optional[str] = None):
+    """Reference-surface ``configure`` (checkpointing.py:789). Values from an
+    explicit kwarg win over the config block."""
+    cfg = {}
+    if deepspeed_config is not None:
+        raw = deepspeed_config if isinstance(deepspeed_config, dict) else {}
+        cfg = raw.get("activation_checkpointing", {}) or {}
+    _State.partition_activations = bool(
+        partition_activations if partition_activations is not None
+        else cfg.get("partition_activations", False))
+    _State.contiguous_checkpointing = bool(
+        contiguous_checkpointing if contiguous_checkpointing is not None
+        else cfg.get("contiguous_memory_optimization", False))
+    _State.cpu_checkpointing = bool(
+        checkpoint_in_cpu if checkpoint_in_cpu is not None
+        else cfg.get("cpu_checkpointing", False))
+    _State.num_checkpoints = (num_checkpoints if num_checkpoints is not None
+                              else cfg.get("number_checkpoints"))
+    _State.synchronize = bool(synchronize if synchronize is not None
+                              else cfg.get("synchronize_checkpoint_boundary", False))
+    _State.profile = bool(profile if profile is not None else cfg.get("profile", False))
+    _State.policy_name = policy or cfg.get("policy")
+    _State.configured = True
+    log_dist(f"activation checkpointing configured: policy={_State.policy_name or 'full-recompute'} "
+             f"cpu={_State.cpu_checkpointing} partition={_State.partition_activations}")
+
+
+def is_configured() -> bool:
+    return _State.configured
+
+
+def reset():
+    """(reference checkpointing.py ``reset``) — clears the module state."""
+    for k, v in vars(_State).items():
+        if not k.startswith("__"):
+            setattr(_State, k, False if isinstance(v, bool) else None)
+    _State.configured = False
+
+
+def model_parallel_cuda_manual_seed(seed):  # reference API parity: RNG forking
+    """No-op on TPU: flax threads explicit PRNG keys, so remat replays the
+    same dropout keys by construction (the reference must fork/restore CUDA
+    RNG states around recompute, checkpointing.py:366)."""
+    return None
+
+
+def checkpoint(function: Callable, *args) -> Any:
+    """Checkpoint a function call (reference ``checkpoint`` :978): the
+    backward pass recomputes ``function`` under the configured policy."""
+    policy = get_remat_policy(_State.policy_name)
+    if _State.cpu_checkpointing:
+        # offload saved residuals to host memory instead of recomputing
+        policy = jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=[], names_which_can_be_offloaded=[],
+            offload_src="device", offload_dst="pinned_host") if policy is None else policy
+    fn = jax.checkpoint(function, policy=policy)
+    return fn(*args)

@@ -169,7 +169,8 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         cache buffers inside the jitted generate, so dropping the engine's
         compiled fns is the whole job."""
         if self._infer_engine is not None:
-            self._infer_engine._gen_fn = None
+            self._infer_engine._gen_cache = {}
+            self._infer_engine._gen_fns = None
             self._infer_engine._gen_key = None
 
     def hybrid_stats(self) -> Dict[str, float]:

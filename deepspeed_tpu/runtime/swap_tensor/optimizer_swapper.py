@@ -23,11 +23,14 @@ from deepspeed_tpu.utils.logging import logger
 
 class PipelinedOptimizerSwapper:
 
-    def __init__(self, swap_dir: str, n_threads: int = 4):
+    def __init__(self, swap_dir: str, n_threads: int = 4, use_direct: bool = True):
         self.swap_dir = Path(swap_dir)
         self.swap_dir.mkdir(parents=True, exist_ok=True)
-        self.read_handle = AsyncIOHandle(n_threads)
-        self.write_handle = AsyncIOHandle(n_threads)
+        # O_DIRECT by default: swap traffic must not churn the page cache the
+        # training job needs (reference aio defaults; falls back where the
+        # filesystem refuses it)
+        self.read_handle = AsyncIOHandle(n_threads, use_direct=use_direct)
+        self.write_handle = AsyncIOHandle(n_threads, use_direct=use_direct)
         self._sizes: Dict[int, int] = {}
 
     def _paths(self, idx: int):

@@ -45,6 +45,8 @@ def interval_at(step: int, scaler: int, clipper: Optional[int] = None) -> int:
     """Interval in effect at 1-indexed ``step``: starts at 1, held for
     ``scaler`` on-interval events, then doubles (pure function of step —
     O(log step), checkpoint-exact)."""
+    if scaler <= 0:
+        raise ValueError(f"interval scaler must be positive, got {scaler}")
     if step <= 0:
         return 1
     interval, consumed = 1, 0
@@ -138,11 +140,18 @@ class ZeroOneRunner:
     def load_state_dict(self, blob: dict) -> None:
         sh = NamedSharding(self.mesh, P(DP_AXES))
         self._lrs_since_sync = float(blob.get("lrs_since_sync", 0.0))
+        # absent keys must CLEAR live buffers: rolling back to a phase-1
+        # checkpoint after entering phase 2 would otherwise replay stale
+        # pending updates against the rewound params
         if "ew" in blob:
             self._bufs = (jax.device_put(blob["ew"], sh), jax.device_put(blob["es"], sh))
+        else:
+            self._bufs = None
         if "m_local" in blob:
             self._p2_state = (jax.device_put(blob["m_local"], sh),
                               jax.device_put(blob["u"], sh))
+        else:
+            self._p2_state = None
 
     # ------------------------------------------------------------------
     # program builders (all shard_map over the DP axes on flat storage)
@@ -365,5 +374,8 @@ class ZeroOneRunner:
             loss = jnp.mean(losses)
             gnorm = jnp.mean(unorms)
             overflow = jnp.bool_(False)
+        # gnorm in phase 2 is the accumulated-update (u) norm, not a gradient
+        # norm — also surfaced explicitly (see engine._post_step note)
         return {"loss": loss, "grad_norm": gnorm, "overflow": overflow,
+                "compressed_update_norm": gnorm,
                 "loss_scale": state.loss_scale.loss_scale}

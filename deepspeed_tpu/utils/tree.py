@@ -1,0 +1,16 @@
+"""Pytree path helpers shared by every path-keyed subsystem (compression
+rules, universal-checkpoint fragments, AutoTP classification)."""
+
+from typing import Tuple
+
+
+def keypath_parts(path) -> Tuple[str, ...]:
+    """jax keypath → string segments. MUST stay the single source of truth:
+    compression resolves rules with it and re-derives paths inside the jitted
+    transform; any divergence silently unmatches the rules."""
+    return tuple(str(getattr(p, "key", getattr(p, "name", getattr(p, "idx", p))))
+                 for p in path)
+
+
+def keypath_str(path, sep: str = "/") -> str:
+    return sep.join(keypath_parts(path))

@@ -1,0 +1,98 @@
+"""Activation checkpointing tests (reference
+``tests/unit/runtime/activation_checkpointing/test_activation_checkpointing.py``):
+policy registry, configure() surface, checkpoint() gradient parity, and the
+per-model policy/selective knobs."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing as ckpt
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    set_topology(None)
+    ckpt.reset()
+    yield
+    set_topology(None)
+    ckpt.reset()
+
+
+def test_policy_registry():
+    assert ckpt.get_remat_policy(None) is None
+    assert ckpt.get_remat_policy("dots_saveable") is jax.checkpoint_policies.dots_saveable
+    with pytest.raises(ValueError, match="unknown remat policy"):
+        ckpt.get_remat_policy("save_everything_twice")
+
+
+def test_configure_surface():
+    assert not ckpt.is_configured()
+    ckpt.configure(deepspeed_config={"activation_checkpointing": {
+        "partition_activations": True, "cpu_checkpointing": False,
+        "number_checkpoints": 4, "policy": "dots_saveable"}})
+    assert ckpt.is_configured()
+    assert ckpt._State.partition_activations and ckpt._State.num_checkpoints == 4
+    # explicit kwarg wins over the config block
+    ckpt.configure(deepspeed_config={"activation_checkpointing": {"partition_activations": True}},
+                   partition_activations=False)
+    assert not ckpt._State.partition_activations
+    assert ckpt.model_parallel_cuda_manual_seed(0) is None  # API parity no-op
+
+
+def test_checkpoint_gradient_parity():
+    """checkpoint() must not change values or gradients — only the recompute
+    schedule."""
+    ckpt.configure(policy="dots_saveable")
+    w = jnp.asarray(np.random.default_rng(0).normal(size=(16, 16)), jnp.float32)
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(4, 16)), jnp.float32)
+
+    def f(w, x):
+        return jnp.tanh(x @ w).sum()
+
+    def f_ck(w, x):
+        return ckpt.checkpoint(lambda a, b: jnp.tanh(b @ a).sum(), w, x)
+
+    np.testing.assert_allclose(np.asarray(f(w, x)), np.asarray(f_ck(w, x)), rtol=1e-6)
+    g = jax.grad(f)(w, x)
+    g_ck = jax.grad(f_ck)(w, x)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ck), rtol=1e-6)
+
+
+@pytest.mark.parametrize("policy,every", [("dots_saveable", 1), (None, 2)])
+def test_model_remat_policy_trains(policy, every):
+    cfg = get_gpt2_config("test", n_layer=2, remat=True, remat_policy=policy,
+                          remat_every=every)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(cfg),
+        config={"train_batch_size": 8, "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 1}},
+        topology=MeshTopology(data=8))
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (8, 32)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch)) for _ in range(3)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_remat_policy_numerics_match_no_remat():
+    """Same seed, with and without remat: identical first-step loss."""
+    def first_loss(remat, policy=None):
+        set_topology(None)
+        cfg = get_gpt2_config("test", n_layer=2, remat=remat, remat_policy=policy)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT2LMHeadModel(cfg),
+            config={"train_batch_size": 8, "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 0}},
+            topology=MeshTopology(data=8))
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(0, cfg.vocab_size, (8, 32)).astype(np.int32)}
+        return [float(engine.train_batch(batch)) for _ in range(2)]
+
+    base = first_loss(False)
+    for pol in (None, "dots_saveable", "nothing_saveable"):
+        assert first_loss(True, pol) == base, f"remat policy {pol} changed the numerics"
