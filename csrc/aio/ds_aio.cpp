@@ -125,20 +125,29 @@ bool write_all(const char* path, const void* buf, int64_t nbytes, bool use_direc
             }
             int64_t aligned = (nbytes / kAlign) * kAlign;
             bool ok = true;
-            for (off_t off = 0; ok && off < aligned;) {
+            off_t off = 0;
+            while (ok && off < aligned) {
                 int64_t n = std::min<int64_t>(kBounce, aligned - off);
                 std::memcpy(bounce, src + off, (size_t)n);
-                ssize_t w = ::pwrite(dfd, bounce, (size_t)n, off);
-                ok = (w == n);
-                off += n;
+                // short direct writes are legal POSIX; retry while the next
+                // offset stays sector-aligned, else finish buffered below
+                int64_t done = 0;
+                while (done < n) {
+                    ssize_t w = ::pwrite(dfd, (char*)bounce + done, (size_t)(n - done), off + done);
+                    if (w <= 0) { ok = false; break; }
+                    done += w;
+                    if (done < n && (done % kAlign) != 0) break;  // unaligned resume
+                }
+                off += done;
+                if (ok && done < n) break;  // aligned prefix written; tail goes buffered
             }
             ::close(dfd);
             free(bounce);
             if (!ok) return false;
-            if (aligned < nbytes) {  // unaligned tail: buffered append + fsync
+            if (off < nbytes) {  // remainder (unaligned tail or short-write rest)
                 int fd = ::open(path, O_WRONLY, 0644);
                 if (fd < 0) return false;
-                bool tail_ok = write_all_buffered(fd, src + aligned, nbytes - aligned, aligned);
+                bool tail_ok = write_all_buffered(fd, src + off, nbytes - off, off);
                 if (tail_ok) ::fsync(fd);
                 ::close(fd);
                 return tail_ok;
@@ -170,20 +179,27 @@ bool read_all(const char* path, void* buf, int64_t nbytes, bool use_direct) {
             }
             int64_t aligned = (nbytes / kAlign) * kAlign;
             bool ok = true;
-            for (off_t off = 0; ok && off < aligned;) {
+            off_t off = 0;
+            while (ok && off < aligned) {
                 int64_t n = std::min<int64_t>(kBounce, aligned - off);
-                ssize_t r = ::pread(dfd, bounce, (size_t)n, off);
-                ok = (r == n);
-                if (ok) std::memcpy(dst + off, bounce, (size_t)n);
-                off += n;
+                int64_t done = 0;
+                while (done < n) {  // short direct reads are legal; retry aligned
+                    ssize_t r = ::pread(dfd, (char*)bounce + done, (size_t)(n - done), off + done);
+                    if (r <= 0) { ok = false; break; }
+                    done += r;
+                    if (done < n && (done % kAlign) != 0) break;
+                }
+                if (done > 0) std::memcpy(dst + off, bounce, (size_t)done);
+                off += done;
+                if (ok && done < n) break;  // rest goes buffered
             }
             ::close(dfd);
             free(bounce);
             if (!ok) return false;
-            if (aligned < nbytes) {  // tail via buffered descriptor
+            if (off < nbytes) {  // remainder via buffered descriptor
                 int fd = ::open(path, O_RDONLY);
                 if (fd < 0) return false;
-                bool tail_ok = read_all_buffered(fd, dst + aligned, nbytes - aligned, aligned);
+                bool tail_ok = read_all_buffered(fd, dst + off, nbytes - off, off);
                 ::close(fd);
                 return tail_ok;
             }

@@ -1,0 +1,9 @@
+"""Accelerator abstraction (reference ``deepspeed/accelerator``)."""
+
+from deepspeed_tpu.accelerator.abstract_accelerator import DeepSpeedAccelerator
+from deepspeed_tpu.accelerator.real_accelerator import (get_accelerator,
+                                                        is_current_accelerator_supported,
+                                                        set_accelerator)
+
+__all__ = ["DeepSpeedAccelerator", "get_accelerator", "set_accelerator",
+           "is_current_accelerator_supported"]

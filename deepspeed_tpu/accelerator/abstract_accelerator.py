@@ -1,0 +1,115 @@
+"""Accelerator abstraction (reference
+``accelerator/abstract_accelerator.py`` ``DeepSpeedAccelerator`` ABC).
+
+The seam that lets runtime code ask device questions without naming a
+backend. Torch-tensor constructors and CUDA stream/event surface collapse
+on TPU — XLA owns streams and JAX owns dtypes — so those reference methods
+map to their JAX equivalents (``synchronize`` = block_until_ready of a
+token; RNG = seeded ``jax.random`` keys) or honest no-ops with documented
+semantics.
+"""
+
+import abc
+from typing import Any, Optional
+
+
+class DeepSpeedAccelerator(abc.ABC):
+    """Subset of the reference ABC that has TPU meaning; names kept
+    identical so runtime code ports."""
+
+    def __init__(self):
+        self._name: Optional[str] = None
+        self._communication_backend_name: Optional[str] = None
+
+    # -- device ---------------------------------------------------------
+    @abc.abstractmethod
+    def is_synchronized_device(self) -> bool: ...
+
+    @abc.abstractmethod
+    def device_name(self, device_index: Optional[int] = None) -> str: ...
+
+    @abc.abstractmethod
+    def device(self, device_index: Optional[int] = None): ...
+
+    @abc.abstractmethod
+    def set_device(self, device_index: int) -> None: ...
+
+    @abc.abstractmethod
+    def current_device(self) -> int: ...
+
+    @abc.abstractmethod
+    def current_device_name(self) -> str: ...
+
+    @abc.abstractmethod
+    def device_count(self) -> int: ...
+
+    @abc.abstractmethod
+    def synchronize(self, device_index: Optional[int] = None) -> None: ...
+
+    # -- RNG ------------------------------------------------------------
+    @abc.abstractmethod
+    def manual_seed(self, seed: int) -> None: ...
+
+    @abc.abstractmethod
+    def manual_seed_all(self, seed: int) -> None: ...
+
+    @abc.abstractmethod
+    def initial_seed(self) -> int: ...
+
+    @abc.abstractmethod
+    def get_rng_state(self, device_index: Optional[int] = None): ...
+
+    @abc.abstractmethod
+    def set_rng_state(self, new_state, device_index: Optional[int] = None) -> None: ...
+
+    # -- memory ---------------------------------------------------------
+    @abc.abstractmethod
+    def empty_cache(self) -> None: ...
+
+    @abc.abstractmethod
+    def memory_allocated(self, device_index: Optional[int] = None) -> int: ...
+
+    @abc.abstractmethod
+    def max_memory_allocated(self, device_index: Optional[int] = None) -> int: ...
+
+    @abc.abstractmethod
+    def memory_stats(self, device_index: Optional[int] = None) -> dict: ...
+
+    @abc.abstractmethod
+    def total_memory(self, device_index: Optional[int] = None) -> int: ...
+
+    @abc.abstractmethod
+    def available_memory(self, device_index: Optional[int] = None) -> int: ...
+
+    # -- dtype / capability ---------------------------------------------
+    @abc.abstractmethod
+    def is_bf16_supported(self) -> bool: ...
+
+    @abc.abstractmethod
+    def is_fp16_supported(self) -> bool: ...
+
+    @abc.abstractmethod
+    def supported_dtypes(self) -> list: ...
+
+    @abc.abstractmethod
+    def is_available(self) -> bool: ...
+
+    @abc.abstractmethod
+    def communication_backend_name(self) -> str: ...
+
+    # -- data movement ---------------------------------------------------
+    @abc.abstractmethod
+    def pin_memory(self, array): ...
+
+    @abc.abstractmethod
+    def on_accelerator(self, array) -> bool: ...
+
+    # -- op builders ------------------------------------------------------
+    @abc.abstractmethod
+    def op_builder_dir(self) -> str: ...
+
+    @abc.abstractmethod
+    def create_op_builder(self, class_name: str): ...
+
+    @abc.abstractmethod
+    def get_op_builder(self, class_name: str): ...

@@ -1,0 +1,60 @@
+"""CPU accelerator (reference ``accelerator/cpu_accelerator.py``): the
+development/CI backend — same seam over JAX's CPU platform."""
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.accelerator.tpu_accelerator import TPU_Accelerator
+
+
+class CPU_Accelerator(TPU_Accelerator):
+    """JAX-CPU flavor: memory stats come from psutil when the backend
+    reports none; collectives ride XLA's host transport (gloo analog)."""
+
+    def __init__(self):
+        super().__init__()
+        self._name = "cpu"
+        self._communication_backend_name = "xla-cpu"
+
+    def _devices(self):
+        try:
+            return jax.devices("cpu")
+        except RuntimeError:
+            return jax.devices()
+
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        return "cpu" if device_index is None else f"cpu:{device_index}"
+
+    def device_count(self) -> int:
+        return len(self._devices())
+
+    def is_available(self) -> bool:
+        return True
+
+    def is_bf16_supported(self) -> bool:
+        return True
+
+    def is_fp16_supported(self) -> bool:
+        return True
+
+    def _stats(self, device_index):
+        stats = super()._stats(device_index)
+        if stats:
+            return stats
+        try:
+            import psutil
+            vm = psutil.virtual_memory()
+            return {"bytes_limit": int(vm.total), "bytes_in_use": int(vm.used),
+                    "peak_bytes_in_use": int(vm.used)}
+        except Exception:
+            return {}
+
+    def on_accelerator(self, array) -> bool:
+        try:
+            return all(getattr(d, "platform", "") == "cpu" for d in array.devices())
+        except AttributeError:
+            return False

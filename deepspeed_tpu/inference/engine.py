@@ -176,7 +176,10 @@ class InferenceEngine:
 
             t, done, tok, cache, out, rng = jax.lax.while_loop(
                 cond, body, (jnp.int32(1), done0, tok, cache, out0, rng))
-            return out, t
+            # the final cache is returned (and discarded by the caller) so
+            # the donated input cache has an output to alias — without it
+            # donation is dead and JAX warns on every first compile
+            return out, t, cache
 
         return {
             # one jitted prefill specializes to exactly two shapes: the
@@ -250,8 +253,8 @@ class InferenceEngine:
             pos += 1
         if max_new <= 0:
             return jnp.asarray(ids_np[:real_batch])
-        out, n = fns["gen_loop"](self.params, cache, last_logits, use_rng,
-                                 jnp.int32(min(max_new, cap)))
+        out, n, _ = fns["gen_loop"](self.params, cache, last_logits, use_rng,
+                                    jnp.int32(min(max_new, cap)))
         n = int(n)
         full = jnp.concatenate([jnp.asarray(ids_np), out[:, :n]], axis=1)
         return full[:real_batch]

@@ -254,8 +254,13 @@ class GPT2LMHeadModel(nn.Module):
             x, l_aux = block_cls(cfg, use_moe, decode, name=f"h_{i}")(x, deterministic)
             aux_total = aux_total + l_aux
         x = LayerNorm(cfg, name="ln_f")(x)
-        # tied LM head (fp32 logits for a stable loss)
-        logits = jnp.einsum("ble,ve->blv", x, wte_value.astype(cfg.dtype), preferred_element_type=jnp.float32)
+        # tied LM head. Logits stay at the COMPUTE dtype: [B,L,V] is the
+        # single largest activation (824MB fp32 at bs4/seq1024/GPT-2 vocab)
+        # and the loss does its softmax reductions in fp32 anyway
+        # (cross_entropy_loss) — bf16 logits halve the dominant HBM traffic
+        # of the step (PERF.md hypothesis #2)
+        logits = jnp.einsum("ble,ve->blv", x, wte_value.astype(cfg.dtype),
+                            preferred_element_type=cfg.dtype)
         if cfg.moe_num_experts > 0:
             return logits, aux_total * cfg.moe_aux_loss_coef
         return logits
@@ -288,7 +293,7 @@ class GPT2EmbedPipe(nn.Module):
     def attend(self, x):
         wte = self.wte.value if isinstance(self.wte, nn.meta.AxisMetadata) else self.wte
         return jnp.einsum("...le,ve->...lv", x, wte.astype(self.config.dtype),
-                          preferred_element_type=jnp.float32)
+                          preferred_element_type=self.config.dtype)
 
 
 class GPT2BlockPipe(nn.Module):

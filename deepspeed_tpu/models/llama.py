@@ -223,8 +223,9 @@ class LlamaForCausalLM(nn.Module):
         for i in range(cfg.num_hidden_layers):
             x = layer_cls(cfg, name=f"layers_{i}")(x, positions, decode, attention_mask)
         x = RMSNorm(cfg, name="norm")(x)
+        # logits at compute dtype: the loss reduces in fp32 (PERF.md #2)
         logits = nn.Dense(features=cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                           param_dtype=cfg.param_dtype,
                           kernel_init=nn.with_logical_partitioning(_init(), ("embed", "vocab")),
                           name="lm_head")(x)
-        return logits.astype(jnp.float32)
+        return logits

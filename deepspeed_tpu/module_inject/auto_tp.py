@@ -34,8 +34,7 @@ ROW_PARALLEL_NAMES = (
 VOCAB_PARALLEL_NAMES = ("wte", "embed_tokens", "word_embeddings", "lm_head", "embed_out")
 
 
-def _path_parts(path) -> Tuple[str, ...]:
-    return tuple(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+from deepspeed_tpu.utils.tree import keypath_parts as _path_parts  # shared stringification
 
 
 class AutoTP:

@@ -105,10 +105,10 @@ def checkpoint(function: Callable, *args) -> Any:
     """Checkpoint a function call (reference ``checkpoint`` :978): the
     backward pass recomputes ``function`` under the configured policy."""
     policy = get_remat_policy(_State.policy_name)
-    if _State.cpu_checkpointing:
-        # offload saved residuals to host memory instead of recomputing
-        policy = jax.checkpoint_policies.save_and_offload_only_these_names(
-            names_which_can_be_saved=[], names_which_can_be_offloaded=[],
-            offload_src="device", offload_dst="pinned_host") if policy is None else policy
+    if _State.cpu_checkpointing and policy is None:
+        # offload matmul outputs to pinned host memory instead of
+        # recomputing them (the reference's partition-to-CPU stash)
+        policy = jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host")
     fn = jax.checkpoint(function, policy=policy)
     return fn(*args)

@@ -1,2 +1,4 @@
 from deepspeed_tpu.utils.logging import logger, log_dist, LoggerFactory
+from deepspeed_tpu.utils.memory import OnDevice, see_memory_usage
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer, NoopTimer
+from deepspeed_tpu.utils.tree import keypath_parts, keypath_str

@@ -1,0 +1,87 @@
+"""Memory observability + meta-device init (reference
+``runtime/utils.py:see_memory_usage`` and ``utils/init_on_device.py``
+``OnDevice``)."""
+
+import contextlib
+import gc
+from typing import Any, Callable, Optional
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+def see_memory_usage(message: str, force: bool = False) -> Optional[dict]:
+    """Log device HBM + host RAM usage (reference ``see_memory_usage``
+    prints torch.cuda allocator stats; here the accelerator seam +
+    psutil). Returns the stats dict for programmatic use."""
+    if not force:
+        return None
+    from deepspeed_tpu.accelerator import get_accelerator
+    acc = get_accelerator()
+    ga = acc.memory_allocated() / 2**30
+    peak = acc.max_memory_allocated() / 2**30
+    total = acc.total_memory() / 2**30
+    try:
+        import psutil
+        vm = psutil.virtual_memory()
+        host = f"host used {vm.used / 2**30:.2f}GB ({vm.percent}%)"
+    except Exception:
+        host = "host n/a"
+    log_dist(f"{message} | device alloc {ga:.2f}GB peak {peak:.2f}GB "
+             f"of {total:.2f}GB | {host}")
+    return {"allocated_gb": ga, "peak_gb": peak, "total_gb": total}
+
+
+class OnDevice:
+    """Construct model params without materializing them (reference
+    ``OnDevice(dtype=..., device="meta")`` ``utils/init_on_device.py``).
+
+    JAX formulation: inside the context, ``init(module, *args)`` returns the
+    ABSTRACT variable tree via ``jax.eval_shape`` when device="meta" —
+    shapes/dtypes only, zero bytes — or real params placed on the chosen
+    device otherwise. Used for engine handoff: pass the abstract tree as
+    ``model_parameters`` metadata or feed ``engine.abstract_state``.
+    """
+
+    _current: Optional["OnDevice"] = None
+
+    def __init__(self, dtype=None, device: str = "meta", enabled: bool = True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+
+    def __enter__(self):
+        OnDevice._current = self if self.enabled else None
+        return self
+
+    def __exit__(self, *exc):
+        OnDevice._current = None
+        return False
+
+    def init(self, module, rng, *args, **kwargs):
+        """Initialize ``module`` under this context's placement."""
+        import jax
+
+        def run(key):
+            return module.init(key, *args, **kwargs)
+
+        if self.enabled and self.device == "meta":
+            tree = jax.eval_shape(run, rng)
+            if self.dtype is not None:
+                import jax.numpy as jnp
+                tree = jax.tree.map(
+                    lambda s: jax.ShapeDtypeStruct(
+                        s.shape, self.dtype if jnp.issubdtype(s.dtype, jnp.floating) else s.dtype),
+                    tree)
+            return tree
+        variables = run(rng)
+        if self.dtype is not None:
+            import jax.numpy as jnp
+            variables = jax.tree.map(
+                lambda p: p.astype(self.dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p,
+                variables)
+        if self.enabled and self.device not in ("meta", None):
+            import jax
+            target = [d for d in jax.devices() if self.device in (d.platform, str(d))]
+            if target:
+                variables = jax.device_put(variables, target[0])
+        return variables

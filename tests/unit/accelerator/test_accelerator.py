@@ -1,0 +1,103 @@
+"""Accelerator abstraction tests (reference
+``tests/unit/accelerator/test_accelerator.py``): selection (env override +
+auto-detect), device/memory/RNG seam, op-builder lookup."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu.accelerator as accel_mod
+from deepspeed_tpu.accelerator import (DeepSpeedAccelerator, get_accelerator, set_accelerator)
+from deepspeed_tpu.accelerator.cpu_accelerator import CPU_Accelerator
+from deepspeed_tpu.accelerator.real_accelerator import _detect
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    import deepspeed_tpu.accelerator.real_accelerator as ra
+    monkeypatch.setattr(ra, "_accelerator", None)
+    yield
+    monkeypatch.setattr(ra, "_accelerator", None)
+
+
+def test_autodetect_cpu_under_tests():
+    # the suite pins JAX_PLATFORMS=cpu, so detection must land on cpu
+    assert _detect() == "cpu"
+    a = get_accelerator()
+    assert isinstance(a, DeepSpeedAccelerator)
+    assert a._name == "cpu"
+    assert get_accelerator() is a  # cached singleton
+
+
+def test_env_override(monkeypatch):
+    monkeypatch.setenv("DS_ACCELERATOR", "cpu")
+    assert get_accelerator()._name == "cpu"
+
+
+def test_env_override_rejects_unknown(monkeypatch):
+    monkeypatch.setenv("DS_ACCELERATOR", "cuda")
+    with pytest.raises(ValueError, match="not supported"):
+        get_accelerator()
+
+
+def test_set_accelerator():
+    mine = CPU_Accelerator()
+    set_accelerator(mine)
+    assert get_accelerator() is mine
+
+
+def test_device_seam():
+    a = get_accelerator()
+    assert a.device_count() >= 1
+    assert a.current_device() == 0
+    assert a.device_name(0).startswith("cpu")
+    a.set_device(0)
+    assert a.current_device_name() == "cpu:0"
+    a.synchronize()  # must not raise
+    assert not a.is_synchronized_device()
+
+
+def test_memory_seam():
+    a = get_accelerator()
+    stats = a.memory_stats()
+    total = a.total_memory()
+    assert isinstance(stats, dict)
+    assert total >= 0 and a.available_memory() <= total or total == 0
+
+
+def test_rng_seam():
+    a = get_accelerator()
+    a.manual_seed(1234)
+    assert a.initial_seed() == 1234
+    state = a.get_rng_state()
+    assert np.asarray(state).shape[-1] >= 1
+    a.set_rng_state(state)
+    assert a.initial_seed() == 1234
+
+
+def test_capabilities_and_dtypes():
+    a = get_accelerator()
+    assert a.is_available()
+    assert a.is_bf16_supported()
+    assert jnp.bfloat16 in a.supported_dtypes()
+    assert "xla" in a.communication_backend_name()
+
+
+def test_data_movement_seam():
+    a = get_accelerator()
+    arr = a.pin_memory(np.arange(8, dtype=np.float32))
+    assert arr.flags.c_contiguous
+    dev = jnp.arange(4)
+    assert a.on_accelerator(dev)  # jnp arrays live on this (cpu) backend
+    assert not a.on_accelerator(np.arange(4))  # numpy is host
+
+
+def test_op_builder_seam():
+    a = get_accelerator()
+    assert a.op_builder_dir() == "deepspeed_tpu.ops.op_builder"
+    cls = a.get_op_builder("AsyncIOBuilder")
+    assert cls is not None
+    builder = a.create_op_builder("AsyncIOBuilder")
+    assert builder is not None and hasattr(builder, "is_compatible")

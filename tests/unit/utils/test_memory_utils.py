@@ -1,0 +1,51 @@
+"""Memory utilities tests (reference ``see_memory_usage`` usage +
+``tests/unit/utils/test_init_on_device.py``)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+from deepspeed_tpu.utils import OnDevice, see_memory_usage
+
+
+def test_see_memory_usage_gated_and_logs(caplog):
+    assert see_memory_usage("skip", force=False) is None
+    stats = see_memory_usage("unit test", force=True)
+    assert stats is not None and set(stats) == {"allocated_gb", "peak_gb", "total_gb"}
+
+
+def test_on_device_meta_is_abstract():
+    cfg = get_gpt2_config("test", n_layer=1)
+    model = GPT2LMHeadModel(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    with OnDevice(dtype=jnp.bfloat16, device="meta") as ctx:
+        tree = ctx.init(model, jax.random.PRNGKey(0), ids, deterministic=True)
+    leaves = jax.tree.leaves(tree)
+    assert all(isinstance(l, jax.ShapeDtypeStruct) for l in leaves)  # zero bytes
+    # floating leaves carry the requested dtype
+    floats = [l for l in leaves if jnp.issubdtype(l.dtype, jnp.floating)]
+    assert floats and all(l.dtype == jnp.bfloat16 for l in floats)
+
+
+def test_on_device_concrete_matches_meta_shapes():
+    cfg = get_gpt2_config("test", n_layer=1)
+    model = GPT2LMHeadModel(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    with OnDevice(device="meta") as meta_ctx:
+        meta = meta_ctx.init(model, jax.random.PRNGKey(0), ids, deterministic=True)
+    with OnDevice(device="cpu") as real_ctx:
+        real = real_ctx.init(model, jax.random.PRNGKey(0), ids, deterministic=True)
+    jax.tree.map(lambda a, b: np.testing.assert_equal(a.shape, b.shape), meta, real)
+    assert jax.tree.leaves(real)[0].size >= 0  # concrete arrays
+
+
+def test_on_device_disabled_passthrough():
+    cfg = get_gpt2_config("test", n_layer=1)
+    model = GPT2LMHeadModel(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    with OnDevice(device="meta", enabled=False) as ctx:
+        tree = ctx.init(model, jax.random.PRNGKey(0), ids, deterministic=True)
+    assert not isinstance(jax.tree.leaves(tree)[0], jax.ShapeDtypeStruct)
