@@ -1016,6 +1016,10 @@ class DeepSpeedEngine:
             seqlen = self.curriculum_scheduler.update_difficulty(self.global_steps + 1)
             batch = _truncate_seq(batch, seqlen)
         self.initialize_state(batch)
+        if (getattr(self, "_retain_grads_flag", False)
+                and getattr(self, "_host_opt", None) is None
+                and self._zeroone_runner is None and self._onebit_cfg is None):
+            return self._train_batch_retained(batch)
         leaves = jax.tree.leaves(batch)
         if (leaves and np.ndim(leaves[0]) > 0 and jax.process_count() == 1
                 and np.shape(leaves[0])[0] != self.config.train_batch_size
@@ -1072,6 +1076,42 @@ class DeepSpeedEngine:
         device_batch = self._shard_batch(batch, with_gas_dim=False)
         return self._eval_step_fn(self.state.params, device_batch, self.state.step)
 
+    def retain_grads(self, flag: bool = True):
+        """Keep each optimization step's averaged full-precision gradients
+        alive for ``utils.tensor_fragment.safe_get_full_grad`` (reference
+        keeps grads naturally as ``param.grad``; the fused XLA step consumes
+        them inside one program, so retention re-routes ``train_batch``
+        through the forward/backward/step shims)."""
+        self._retain_grads_flag = bool(flag)
+        if not flag:
+            self._retained_grads = None
+
+    def _train_batch_retained(self, batch):
+        """train_batch via the shim path so gradients survive the step."""
+        gas = self.config.gradient_accumulation_steps
+        sized = [np.shape(l)[0] for l in jax.tree.leaves(batch) if np.ndim(l) > 0]
+        if not sized:
+            raise ValueError("retain_grads train_batch needs at least one batched leaf")
+        b = sized[0]
+        assert b % gas == 0, f"global batch {b} not divisible by GAS {gas}"
+        mb_size = b // gas
+
+        def slice_leaf(x, i):
+            x = np.asarray(x)
+            # scalar / unbatched leaves (e.g. per-batch weights) pass through,
+            # matching the fused path's _shard_batch tolerance
+            if x.ndim == 0 or x.shape[0] != b:
+                return x
+            return x[i * mb_size:(i + 1) * mb_size]
+
+        losses = []
+        for i in range(gas):
+            mb = jax.tree.map(lambda x: slice_leaf(x, i), batch)
+            losses.append(self.forward(mb))
+            self.backward()
+        self.step()
+        return jnp.mean(jnp.stack(losses))
+
     # -- torch-style shims (reference engine.py:1709/1850/2051) ----------
     def forward(self, batch):
         """Compute the (scaled-down-by-GAS) loss for one microbatch and
@@ -1111,6 +1151,11 @@ class DeepSpeedEngine:
         if not self.is_gradient_accumulation_boundary():
             return
         n_micro = self.config.gradient_accumulation_steps
+        if getattr(self, "_retain_grads_flag", False):
+            # averaged, unscaled grads for utils.tensor_fragment debug access
+            scale = float(self.state.loss_scale.loss_scale) if self.fp16_enabled else 1.0
+            self._retained_grads = jax.tree.map(
+                lambda g: g / (n_micro * scale), self._grad_acc)
         self.state, metrics = self._apply_grads_fn(self.state, self._grad_acc, n_micro)
         self._grad_acc = None
         self.global_steps += 1

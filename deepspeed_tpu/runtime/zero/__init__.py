@@ -1,2 +1,3 @@
 from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
 from deepspeed_tpu.runtime.zero.planner import ZeroPlan, build_plan, resolve_topology_axes
+from deepspeed_tpu.runtime.zero.tiling import TiledLinear, TiledLinearReturnBias

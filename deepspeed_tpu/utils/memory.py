@@ -42,19 +42,15 @@ class OnDevice:
     ``model_parameters`` metadata or feed ``engine.abstract_state``.
     """
 
-    _current: Optional["OnDevice"] = None
-
     def __init__(self, dtype=None, device: str = "meta", enabled: bool = True):
         self.dtype = dtype
         self.device = device
         self.enabled = enabled
 
     def __enter__(self):
-        OnDevice._current = self if self.enabled else None
         return self
 
     def __exit__(self, *exc):
-        OnDevice._current = None
         return False
 
     def init(self, module, rng, *args, **kwargs):

@@ -1,0 +1,135 @@
+"""Debug access to full-precision params / optimizer state / gradients.
+
+TPU redesign of the reference's ``deepspeed/utils/tensor_fragment.py``
+(``safe_get_full_fp32_param:92``, ``safe_get_full_optimizer_state:108``,
+``safe_get_full_grad:125`` and the ``safe_set_*`` counterparts): there, HP
+fragments of each torch parameter live inside flattened ZeRO partitions and
+must be mapped back through ``fragment_address`` bookkeeping. Here the
+master params are a sharded jax pytree on a Mesh — "get the full fp32
+param" is a device_get of the addressable shards re-assembled by name, and
+"set" is a ``device_put`` against the param's existing ``NamedSharding``.
+No fragment arithmetic is needed; the path string is the address.
+
+Paths use ``/``-joined pytree keys, e.g. ``"h_0/attn/c_attn/kernel"``
+(the same naming the ZeRO planner and checkpoint tools use).
+"""
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.utils.tree import keypath_str as _path_str
+
+
+def flatten_with_names(tree) -> Dict[str, Any]:
+    """{"a/b/c": leaf} view of a pytree (stable, planner-compatible names)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return {_path_str(p): leaf for p, leaf in flat}
+
+
+def list_param_names(engine) -> List[str]:
+    """All addressable parameter paths (reference: iterating
+    ``model.named_parameters()``)."""
+    _require_state(engine)
+    return sorted(flatten_with_names(engine.state.params))
+
+
+def _require_state(engine):
+    if getattr(engine, "state", None) is None:
+        raise RuntimeError("engine state is not initialized yet — call "
+                           "engine.initialize_state(example_batch) first")
+
+
+def _lookup(tree, name: str, what: str):
+    flat = flatten_with_names(tree)
+    if name not in flat:
+        close = [k for k in flat if name in k or k in name][:5]
+        raise KeyError(f"no {what} named {name!r}; close matches: {close}")
+    return flat[name]
+
+
+def safe_get_full_fp32_param(engine, name: str) -> np.ndarray:
+    """Full (unsharded) fp32 master value of parameter ``name``.
+
+    Reference ``tensor_fragment.py:92``: there this gathers the HP fragment
+    from the ZeRO partition; here ``jax.device_get`` assembles the full
+    array from the mesh shards regardless of ZeRO stage.
+    """
+    _require_state(engine)
+    return np.asarray(jax.device_get(_lookup(engine.state.params, name, "param")))
+
+
+def safe_set_full_fp32_param(engine, name: str, value) -> None:
+    """Overwrite master parameter ``name`` in place (reference
+    ``safe_set_full_fp32_param``), re-sharding the new value like the old."""
+    _require_state(engine)
+    old = _lookup(engine.state.params, name, "param")
+    value = np.asarray(value, dtype=old.dtype)
+    if value.shape != old.shape:
+        raise ValueError(f"shape mismatch for {name}: {value.shape} vs {old.shape}")
+    new_leaf = jax.device_put(value, old.sharding)
+
+    def replace(path, leaf):
+        return new_leaf if _path_str(path) == name else leaf
+
+    new_params = jax.tree_util.tree_map_with_path(replace, engine.state.params)
+    engine.state = engine.state._replace(params=new_params)
+
+
+def safe_get_full_optimizer_state(engine, name: str, optim_state_key: str) -> np.ndarray:
+    """Full optimizer-state tensor for param ``name`` (reference
+    ``tensor_fragment.py:108``; keys ``"exp_avg"``/``"exp_avg_sq"`` map to
+    optax's ``mu``/``nu``)."""
+    _require_state(engine)
+    # the engine's fused Adam uses the reference field names directly;
+    # optax-stock transforms use mu/nu — accept either spelling
+    key_alias = {"exp_avg": "mu", "exp_avg_sq": "nu", "mu": "exp_avg", "nu": "exp_avg_sq"}
+    wants = [optim_state_key]
+    if optim_state_key in key_alias:
+        wants.append(key_alias[optim_state_key])
+
+    def walk(node):
+        if hasattr(node, "_fields"):
+            for want in wants:
+                if want in node._fields:
+                    return getattr(node, want)
+            for f in node._fields:
+                found = walk(getattr(node, f))
+                if found is not None:
+                    return found
+        elif isinstance(node, (tuple, list)):
+            for item in node:
+                found = walk(item)
+                if found is not None:
+                    return found
+        return None
+
+    sub = walk(engine.state.opt_state)
+    if sub is None:
+        raise KeyError(f"optimizer state has no field {optim_state_key!r} "
+                       f"(searched optax state tree for any of {wants})")
+    return np.asarray(jax.device_get(_lookup(sub, name, "optimizer state")))
+
+
+def safe_get_full_grad(engine, name: str) -> Optional[np.ndarray]:
+    """Full gradient of param ``name`` from the LAST ``train_batch`` call.
+
+    Reference ``tensor_fragment.py:125``. The fused step does not keep
+    gradients alive by default (they are consumed inside one XLA program);
+    enable retention first::
+
+        engine.retain_grads(True)
+        engine.train_batch(batch)
+        g = safe_get_full_grad(engine, "h_0/mlp/c_fc/kernel")
+
+    Returns None (with a warning, matching the reference's behavior when
+    gradients are not available) if retention is off or no step has run.
+    """
+    grads = getattr(engine, "_retained_grads", None)
+    if grads is None:
+        from deepspeed_tpu.utils.logging import logger
+        logger.warning("gradients are not retained — call engine.retain_grads(True) "
+                       "before train_batch to use safe_get_full_grad")
+        return None
+    return np.asarray(jax.device_get(_lookup(grads, name, "gradient")))

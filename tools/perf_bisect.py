@@ -1,0 +1,131 @@
+"""Perf bisect: time the pieces of the 350M train step on the real chip.
+
+Run: python tools/perf_bisect.py [piece ...]
+Pieces: fwd bwd opt full noembed nolmhead attnonly
+Each prints one line: <piece> <ms>
+"""
+import os
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+from deepspeed_tpu.models.gpt2 import cross_entropy_loss
+
+MB = int(os.environ.get("BENCH_MICRO_BS", "4"))
+SEQ = int(os.environ.get("BENCH_SEQ", "1024"))
+STEPS = int(os.environ.get("BENCH_STEPS", "10"))
+MODEL = os.environ.get("BENCH_MODEL", "350m")
+REMAT = os.environ.get("BENCH_REMAT", "1") == "1"
+ATTN = os.environ.get("BENCH_ATTN", "flash")
+
+
+def timed(fn, *args):
+    """Time STEPS sequential executions with a forced data dependency (the
+    tunneled backend appears to dedupe identical (program, args) dispatches,
+    so same-arg loops report impossibly fast times)."""
+    ids = args[-1]
+    head = args[:-1]
+
+    def chained(carry, ids):
+        out = fn(*head, jnp.bitwise_xor(ids, carry.astype(jnp.int32) & 0))
+        # fold the (scalar or tree) output back into the next call's ids
+        s = sum(jnp.sum(l.astype(jnp.float32)) for l in jax.tree.leaves(out))
+        return carry + s, out  # carry grows → every call has distinct args
+    cf = jax.jit(chained)
+    carry = jnp.float32(0)
+    out = cf(carry, ids)
+    jax.block_until_ready(out)
+    t0 = time.time()
+    carry = jnp.float32(0)
+    for _ in range(STEPS):
+        carry, out = cf(carry, ids)
+    jax.block_until_ready(carry)
+    return (time.time() - t0) / STEPS * 1e3
+
+
+def main():
+    pieces = sys.argv[1:] or ["fwd", "bwd", "opt", "full"]
+    cfg = get_gpt2_config(MODEL, n_positions=SEQ, remat=REMAT,
+                          attention_backend=ATTN, dtype=jnp.bfloat16)
+    model = GPT2LMHeadModel(cfg)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (MB, SEQ)), jnp.int32)
+    params = jax.jit(lambda k: model.init(k, ids[:1, :8])["params"])(jax.random.PRNGKey(0))
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    print(f"# params={n_params/1e6:.1f}M mb={MB} seq={SEQ} remat={REMAT} attn={ATTN}", flush=True)
+
+    bf16_params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
+
+    def loss_fn(p, ids):
+        logits = model.apply({"params": p}, ids)
+        labels = jnp.concatenate([ids[:, 1:], jnp.full((ids.shape[0], 1), -100, jnp.int32)], axis=1)
+        return cross_entropy_loss(logits, labels)
+
+    if "fwd" in pieces:
+        f = jax.jit(loss_fn)
+        print(f"fwd {timed(f, bf16_params, ids):.1f}", flush=True)
+
+    if "fwdnoloss" in pieces:
+        f = jax.jit(lambda p, i: model.apply({"params": p}, i).astype(jnp.float32).mean())
+        print(f"fwdnoloss {timed(f, bf16_params, ids):.1f}", flush=True)
+
+    if "bwd" in pieces:
+        g = jax.jit(lambda p, i: jax.grad(loss_fn)(p, i))
+        print(f"bwd {timed(g, bf16_params, ids):.1f}", flush=True)
+
+    if "bwd32" in pieces:
+        # grads computed from fp32 masters with cast inside (engine layout)
+        def loss32(p, i):
+            cp = jax.tree.map(lambda x: x.astype(jnp.bfloat16), p)
+            return loss_fn(cp, i)
+        g = jax.jit(lambda p, i: jax.grad(loss32)(p, i))
+        print(f"bwd32 {timed(g, params, ids):.1f}", flush=True)
+
+    if "opt" in pieces:
+        tx = optax.adamw(1e-4, weight_decay=0.01)
+        opt_state = jax.jit(tx.init)(params)
+        grads = jax.tree.map(lambda p: jnp.ones_like(p), params)
+
+        def step(p, s, g):
+            u, s2 = tx.update(g, s, p)
+            return optax.apply_updates(p, u), s2
+        f = jax.jit(step, donate_argnums=(0, 1))
+        # no donation-safe repeat timing with donated bufs; time one-shot loop
+        out = f(params, opt_state, grads)
+        jax.block_until_ready(out)
+        p2, s2 = out
+        t0 = time.time()
+        for _ in range(STEPS):
+            p2, s2 = f(p2, s2, grads)
+        jax.block_until_ready(p2)
+        print(f"opt {(time.time() - t0) / STEPS * 1e3:.1f}", flush=True)
+
+    if "noembed" in pieces:
+        # transformer stack only: skip wte/wpe gather and lm head
+        def body_loss(p, x):
+            import flax.linen as nn
+            # run blocks via model.apply with a hidden-states entry point is
+            # not exposed; approximate with logits-sum on tiny vocab instead
+            return 0.0
+        pass
+
+    if "nolmhead" in pieces:
+        def loss_nolm(p, i):
+            # model forward but reduce hidden states instead of logits
+            # (monkey: call apply with capture of pre-head sum via aux) —
+            # cheapest proxy: mean of logits at bf16 without CE
+            logits = model.apply({"params": p}, i)
+            return logits.astype(jnp.float32).mean()
+        f = jax.jit(lambda p, i: jax.grad(loss_nolm)(p, i))
+        print(f"bwd_nolosshead {timed(f, bf16_params, ids):.1f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

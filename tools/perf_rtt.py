@@ -1,0 +1,94 @@
+"""Separate tunnel dispatch latency from device compute.
+
+a) RTT probe: tiny chained jit calls — per-call time ≈ dispatch latency.
+b) fwd chained: 350M fwd, each call consuming the previous output.
+c) fwd scanned: same work, ONE dispatch running a fori_loop on device.
+If (b) >> (c), host dispatch latency dominates the per-step numbers.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+from deepspeed_tpu.models.gpt2 import cross_entropy_loss
+
+MB, SEQ, N = 4, 1024, 10
+
+# a) RTT probe
+f = jax.jit(lambda x: x * 1.000001 + 1.0)
+x = jnp.float32(0)
+x = f(x); jax.block_until_ready(x)
+t0 = time.time()
+for _ in range(20):
+    x = f(x)
+jax.block_until_ready(x)
+print(f"rtt_per_call_ms {(time.time()-t0)/20*1e3:.2f}", flush=True)
+
+cfg = get_gpt2_config("350m", n_positions=SEQ, remat=True,
+                      attention_backend="flash", dtype=jnp.bfloat16)
+model = GPT2LMHeadModel(cfg)
+rng = np.random.default_rng(0)
+ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (MB, SEQ)), jnp.int32)
+params = jax.jit(lambda k: model.init(k, ids[:1, :8])["params"])(jax.random.PRNGKey(0))
+params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
+labels = jnp.concatenate([ids[:, 1:], jnp.full((MB, 1), -100, jnp.int32)], axis=1)
+
+
+def loss_fn(p, ids, bias):
+    logits = model.apply({"params": p}, ids)
+    return cross_entropy_loss(logits, labels) + bias
+
+
+# b) chained host dispatches
+g = jax.jit(loss_fn)
+acc = jnp.float32(0)
+out = g(params, ids, acc); jax.block_until_ready(out)
+t0 = time.time()
+for _ in range(N):
+    acc = g(params, ids, acc * 1e-9)
+jax.block_until_ready(acc)
+print(f"fwd_chained_ms {(time.time()-t0)/N*1e3:.1f}", flush=True)
+
+# c) one dispatch, fori_loop on device
+def scanned(p, ids):
+    def body(i, acc):
+        return loss_fn(p, ids, acc * 1e-9)
+    return jax.lax.fori_loop(0, N, body, jnp.float32(0))
+
+s = jax.jit(scanned)
+out = s(params, ids); jax.block_until_ready(out)
+t0 = time.time()
+out = s(params, ids)
+jax.block_until_ready(out)
+print(f"fwd_scanned_ms {(time.time()-t0)/N*1e3:.1f}", flush=True)
+
+# d) grad, chained vs scanned
+grad_fn = jax.grad(loss_fn)
+
+def gsum(p, ids, acc):
+    gr = grad_fn(p, ids, acc * 1e-9)
+    return sum(jnp.sum(l.astype(jnp.float32)) for l in jax.tree.leaves(gr))
+
+gj = jax.jit(gsum)
+acc = jnp.float32(0)
+out = gj(params, ids, acc); jax.block_until_ready(out)
+t0 = time.time()
+for _ in range(N):
+    acc = gj(params, ids, acc)
+jax.block_until_ready(acc)
+print(f"grad_chained_ms {(time.time()-t0)/N*1e3:.1f}", flush=True)
+
+def gscanned(p, ids):
+    return jax.lax.fori_loop(0, N, lambda i, acc: gsum(p, ids, acc), jnp.float32(0))
+
+gs = jax.jit(gscanned)
+out = gs(params, ids); jax.block_until_ready(out)
+t0 = time.time()
+out = gs(params, ids)
+jax.block_until_ready(out)
+print(f"grad_scanned_ms {(time.time()-t0)/N*1e3:.1f}", flush=True)
