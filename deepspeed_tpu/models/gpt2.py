@@ -102,7 +102,7 @@ class SelfAttention(nn.Module):
         dropout_rng = None
         if not deterministic and cfg.dropout > 0.0:
             dropout_rng = self.make_rng("dropout")
-        causal, mask = True, None
+        causal, decode_lengths = True, None
         if self.decode:
             # incremental decoding against a static-shape KV cache (the
             # reference's inference workspace, inference_context.h)
@@ -117,16 +117,17 @@ class SelfAttention(nn.Module):
             cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, v, (0, idx, 0, 0))
             cache_index.value = idx + l
             k, v = cached_k.value, cached_v.value
-            kv_pos = jnp.arange(cfg.n_positions)[None, None, None, :]
-            q_pos = (idx + jnp.arange(l))[None, None, :, None]
-            mask = kv_pos <= q_pos
+            # per-sequence live-length vector — the flash backend's decode
+            # kernel skips dead KV blocks; the XLA backend derives the
+            # validity mask from it
+            decode_lengths = jnp.broadcast_to(idx + l, (b,))
             causal = False
         attn_out = dot_product_attention(q,
                                          k,
                                          v,
                                          backend=cfg.attention_backend,
                                          causal=causal,
-                                         mask=mask,
+                                         decode_lengths=decode_lengths,
                                          dropout_rate=0.0 if deterministic else cfg.dropout,
                                          dropout_rng=dropout_rng)
         out = nn.DenseGeneral(features=cfg.n_embd,

@@ -117,6 +117,7 @@ class LlamaAttention(nn.Module):
         v = proj(cfg.num_key_value_heads, "v_proj")(x)
 
         causal = True
+        decode_lengths = None
         # attention_mask: [B, L] 0/1 padding mask (or a pre-broadcast boolean
         # mask). In decode mode L must span the cache (max_position_embeddings).
         mask = normalize_padding_mask(attention_mask)
@@ -140,12 +141,12 @@ class LlamaAttention(nn.Module):
             cache_index.value = idx + l
             k = cached_k.value
             v = cached_v.value
-            # causal validity over cache slots, intersected with any caller
-            # padding mask (which spans the cache slots)
-            kv_pos = jnp.arange(cfg.max_position_embeddings)[None, None, None, :]
-            q_pos = positions[:, None, :, None]  # [B, 1, Lq, 1]
-            validity = kv_pos <= q_pos
-            mask = validity if mask is None else jnp.logical_and(validity, mask)
+            # per-sequence live lengths (positions may differ per batch row);
+            # the backend derives causal validity over cache slots from them —
+            # flash's decode kernel additionally skips dead KV blocks' DMA.
+            # Any caller padding mask rides alongside (flash falls back to
+            # XLA when both are present).
+            decode_lengths = positions[:, -1] + 1
             causal = False
         else:
             if positions is None:
@@ -157,7 +158,8 @@ class LlamaAttention(nn.Module):
             k = jnp.repeat(k, n_rep, axis=2)
             v = jnp.repeat(v, n_rep, axis=2)
 
-        out = dot_product_attention(q, k, v, backend=cfg.attention_backend, causal=causal, mask=mask)
+        out = dot_product_attention(q, k, v, backend=cfg.attention_backend, causal=causal,
+                                    mask=mask, decode_lengths=decode_lengths)
         return nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1), use_bias=False,
                                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                                kernel_init=nn.with_logical_partitioning(_init(), ("heads", "kv", "embed")),

@@ -72,6 +72,17 @@ class AutoTP:
             return P()
         role = AutoTP.classify(path_parts)
         is_bias = path_parts and path_parts[-1] in ("bias",)
+        if role is None and not is_bias and len(shape) == 2:
+            # shape heuristic for unknown naming conventions (the reference
+            # reads the module graph instead, auto_tp.py:13): Megatron-shaped
+            # projections are non-square — expanding [d, k*d] (fused QKV,
+            # gated/up MLP) shards the output dim, contracting [k*d, d]
+            # shards the input dim. Square kernels stay ambiguous.
+            rows, cols = int(shape[0]), int(shape[1])
+            if cols >= 2 * rows:
+                role = "column"
+            elif rows >= 2 * cols:
+                role = "row"
         if role is None:
             # the reference parses module graphs and errors on unsupported
             # architectures (auto_tp.py is_load_module checks); name matching

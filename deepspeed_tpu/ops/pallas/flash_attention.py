@@ -328,6 +328,118 @@ def _flash_attention_bhld_bwd(scale, causal, blk_q, blk_k, interpret, res, g):
 _flash_attention_bhld.defvjp(_flash_attention_bhld_fwd, _flash_attention_bhld_bwd)
 
 
+# ---------------------------------------------------------------------------
+# decode (inference): q of a few tokens vs a static KV cache with
+# per-sequence valid lengths (reference fused decode softmax,
+# ``csrc/transformer/inference/csrc/softmax.cu`` attn_softmax_v2 +
+# ``pt_binding.cpp:1935-1975`` workspace attention). No VJP — serving only.
+# ---------------------------------------------------------------------------
+def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                   scale, blk_k, lq, nk):
+    bi, j = pl.program_id(0), pl.program_id(2)
+    length = lens_ref[bi]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # kv blocks past the sequence's last live token move no bytes (the index
+    # map clamps, Mosaic elides the DMA) and run no FLOPs
+    nk_eff = (jnp.maximum(length, 1) - 1) // blk_k + 1
+
+    @pl.when((j < nk_eff) & (length > 0))
+    def _block():
+        q = q_ref[...].astype(jnp.float32) * scale          # [lq, d]
+        k = k_ref[...].astype(jnp.float32)                  # [blk_k, d]
+        v = v_ref[...].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [lq, blk_k]
+        # q row i sits at global position length - lq + i; kv col c at
+        # j*blk_k + c; causal validity: kv_pos <= q_pos
+        q_pos = length - lq + jax.lax.broadcasted_iota(jnp.int32, (lq, blk_k), 0)
+        k_pos = j * blk_k + jax.lax.broadcasted_iota(jnp.int32, (lq, blk_k), 1)
+        valid = k_pos <= q_pos
+        s = jnp.where(valid, s, NEG_INF)
+        m = m_ref[:, 0]
+        l = l_ref[:, 0]
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # explicit zero for masked probs: a fully-masked row (q_pos < 0, i.e.
+        # lq > length) must produce zeros, not exp(NEG_INF - NEG_INF) = 1
+        p = jnp.where(valid, jnp.exp(s - m_new[:, None]), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1)
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_ref[...] = m_new[:, None]
+        l_ref[...] = l_new[:, None]
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_ref[:, 0], 1e-37)
+        o_ref[...] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def flash_decode(q: jax.Array,
+                 k: jax.Array,
+                 v: jax.Array,
+                 lengths: jax.Array,
+                 *,
+                 scale: Optional[float] = None,
+                 block_k: Optional[int] = None,
+                 interpret: Optional[bool] = None) -> jax.Array:
+    """Length-masked attention of ``q`` [B, Lq, H, D] (the newest Lq tokens)
+    against a KV cache [B, Lkv, H, D] where only ``lengths[b]`` slots are
+    live. Streams one K/V block per grid step; blocks beyond a sequence's
+    length are skipped (FLOPs and DMA). Rows with no live positions
+    (``lq > lengths[b]``) return zeros."""
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    if scale is None:
+        scale = d**-0.5
+    if interpret is None:
+        interpret = _interpret_default()
+    blk_k = block_k or _pick_block(lk)
+    if lk % blk_k:
+        raise ValueError(f"KV cache length {lk} not divisible by block {blk_k}")
+    nk = lk // blk_k
+    lengths = lengths.astype(jnp.int32)
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    def kv_idx(bi, hi, j, lens):
+        # index maps receive (*grid_indices, *scalar_prefetch_refs)
+        last = (jnp.maximum(lens[bi], 1) - 1) // blk_k
+        return (bi, hi, jnp.minimum(j, last), 0)
+
+    kernel = functools.partial(_decode_kernel, scale=float(scale), blk_k=blk_k,
+                               lq=lq, nk=nk)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, h, nk),
+        in_specs=[
+            pl.BlockSpec((None, None, lq, d), lambda bi, hi, j, lens: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, blk_k, d), kv_idx),
+            pl.BlockSpec((None, None, blk_k, d), kv_idx),
+        ],
+        out_specs=pl.BlockSpec((None, None, lq, d), lambda bi, hi, j, lens: (bi, hi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((lq, 1), jnp.float32),
+            pltpu.VMEM((lq, 1), jnp.float32),
+            pltpu.VMEM((lq, d), jnp.float32),
+        ],
+    )
+    o = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, lq, d), q.dtype),
+        interpret=interpret,
+    )(lengths, qt, kt, vt)
+    return o.transpose(0, 2, 1, 3)
+
+
 @register_backend("flash")
 def flash_attention(q: jax.Array,
                     k: jax.Array,
@@ -339,6 +451,7 @@ def flash_attention(q: jax.Array,
                     scale: Optional[float] = None,
                     dropout_rate: float = 0.0,
                     dropout_rng: Optional[jax.Array] = None,
+                    decode_lengths: Optional[jax.Array] = None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
                     interpret: Optional[bool] = None) -> jax.Array:
@@ -346,6 +459,16 @@ def flash_attention(q: jax.Array,
     features the kernel doesn't cover (bias/mask/dropout)."""
     b, lq, h, d = q.shape
     lk = k.shape[1]
+    if decode_lengths is not None:
+        # KV-cache decode: per-sequence length masking in the kernel
+        if bias is None and mask is None and dropout_rate == 0.0 and lk % (block_k or _pick_block(lk)) == 0:
+            return flash_decode(q, k, v, decode_lengths, scale=scale,
+                                block_k=block_k, interpret=interpret)
+        _warn_fallback("decode with bias/mask/dropout or untileable cache")
+        from deepspeed_tpu.ops.transformer.attention import xla_attention
+        return xla_attention(q, k, v, causal=False, bias=bias, mask=mask, scale=scale,
+                             dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+                             decode_lengths=decode_lengths)
     if bias is not None or mask is not None or (dropout_rate > 0.0 and dropout_rng is not None) \
             or (causal and lq > lk):
         _warn_fallback("bias/mask/dropout or lq>lk requested")

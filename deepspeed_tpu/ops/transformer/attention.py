@@ -46,16 +46,26 @@ def xla_attention(q: jax.Array,
                   mask: Optional[jax.Array] = None,
                   scale: Optional[float] = None,
                   dropout_rate: float = 0.0,
-                  dropout_rng: Optional[jax.Array] = None) -> jax.Array:
+                  dropout_rng: Optional[jax.Array] = None,
+                  decode_lengths: Optional[jax.Array] = None) -> jax.Array:
     """Plain XLA attention: softmax(q k^T / sqrt(d) + bias) v.
 
     fp32 softmax accumulation regardless of input dtype (matches the
     reference's fused kernel numerics, ``softmax_kernels.cu``).
+
+    ``decode_lengths`` [B]: KV-cache decode — q holds the newest ``lq``
+    tokens of each sequence, slot ``lengths[b]-1`` is the last live cache
+    position; builds the per-sequence causal validity mask.
     """
     b, lq, h, d = q.shape
     lk = k.shape[1]
     if scale is None:
         scale = d**-0.5
+    if decode_lengths is not None:
+        q_pos = decode_lengths[:, None].astype(jnp.int32) - lq + jnp.arange(lq)[None, :]
+        validity = jnp.arange(lk)[None, None, None, :] <= q_pos[:, None, :, None]
+        mask = validity if mask is None else jnp.logical_and(mask, validity)
+        causal = False
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
     if bias is not None:
         logits = logits + bias.astype(jnp.float32)
@@ -88,4 +98,8 @@ def dot_product_attention(q, k, v, *, backend: str = "xla", **kwargs):
                              f"registered: {available_backends()}") from e
     if backend not in _BACKENDS:
         raise ValueError(f"unknown attention backend {backend!r}; available: {available_backends()}")
+    # None-valued kwargs mean "default" — drop them so backends that predate
+    # an optional feature (e.g. ring/ulysses without decode_lengths) stay
+    # call-compatible
+    kwargs = {key: val for key, val in kwargs.items() if val is not None}
     return _BACKENDS[backend](q, k, v, **kwargs)

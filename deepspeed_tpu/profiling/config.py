@@ -18,3 +18,21 @@ class DeepSpeedFlopsProfilerConfig(DeepSpeedConfigModel):
 
 def get_flops_profiler_config(param_dict):
     return DeepSpeedFlopsProfilerConfig(**param_dict.get("flops_profiler", {}))
+
+
+class DeepSpeedTraceProfilerConfig(DeepSpeedConfigModel):
+    """XLA trace capture window (TPU analog of wrapping the train loop in
+    ``torch.profiler``): records ``num_steps`` engine steps starting at
+    ``start_step`` into a TensorBoard/Perfetto trace via
+    ``jax.profiler.start_trace``."""
+
+    enabled: bool = False
+    start_step: int = 2  # skip compile steps by default
+    num_steps: int = 1
+    output_dir: str = "/tmp/deepspeed_tpu_trace"
+    host_tracer_level: int = 2
+    python_tracer: bool = False
+
+
+def get_trace_profiler_config(param_dict):
+    return DeepSpeedTraceProfilerConfig(**param_dict.get("trace_profiler", {}))

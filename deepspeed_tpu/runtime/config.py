@@ -14,7 +14,7 @@ from pydantic import Field
 
 from deepspeed_tpu.comm.config import DeepSpeedCommsConfig
 from deepspeed_tpu.monitor.config import get_monitor_config
-from deepspeed_tpu.profiling.config import get_flops_profiler_config
+from deepspeed_tpu.profiling.config import get_flops_profiler_config, get_trace_profiler_config
 from deepspeed_tpu.runtime import constants as C
 from deepspeed_tpu.runtime.config_utils import (DeepSpeedConfigModel, dict_raise_error_on_duplicate_keys,
                                                 get_scalar_param)
@@ -184,6 +184,7 @@ class DeepSpeedConfig:
             **param_dict.get(C.ACTIVATION_CHECKPOINTING, {}))
         self.monitor_config = get_monitor_config(param_dict)
         self.flops_profiler_config = get_flops_profiler_config(param_dict)
+        self.trace_profiler_config = get_trace_profiler_config(param_dict)
         self.comms_config = DeepSpeedCommsConfig(param_dict)
         self.checkpoint_config = CheckpointConfig(**param_dict.get(C.CHECKPOINT, {}))
         self.hybrid_engine_config = HybridEngineConfig(**param_dict.get("hybrid_engine", {}))

@@ -225,9 +225,6 @@ class DeepSpeedEngine:
         dp_world = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
         return pure_dp and dp_world > 1 and self.config.zero_optimization_stage == 0
 
-    def _onebit_comm_eligible(self) -> bool:
-        return self._compressed_comm_eligible(C.ONEBIT_ADAM_OPTIMIZER)
-
     def _configure_optimizer(self) -> optax.GradientTransformation:
         """Reference ``_configure_basic_optimizer`` (``engine.py:1225``):
         config name → built-in optimizer; a client-supplied optax transform
@@ -247,9 +244,8 @@ class DeepSpeedEngine:
             return fused_adam(lr=lr, adam_w_mode=adam_w_mode, **params)
         if name in (C.ONEBIT_ADAM_OPTIMIZER, C.ZERO_ONE_ADAM_OPTIMIZER, C.ONEBIT_LAMB_OPTIMIZER):
             from deepspeed_tpu.runtime.fp16.onebit import get_onebit_optimizer
-            if (name == C.ONEBIT_ADAM_OPTIMIZER and self._onebit_comm_eligible()) or \
-                    (name == C.ZERO_ONE_ADAM_OPTIMIZER
-                     and self._compressed_comm_eligible(C.ZERO_ONE_ADAM_OPTIMIZER)):
+            if name in (C.ONEBIT_ADAM_OPTIMIZER, C.ZERO_ONE_ADAM_OPTIMIZER,
+                        C.ONEBIT_LAMB_OPTIMIZER) and self._compressed_comm_eligible(name):
                 # the engine's compressed-collective step owns compression;
                 # the transform skips its internal QDQ and the dead
                 # full-size error-feedback tree
@@ -494,6 +490,7 @@ class DeepSpeedEngine:
         ob = self._onebit_cfg
         b1, _ = ob["betas"]
         eps, wd, lr = ob["eps"], ob["weight_decay"], ob["lr"]
+        lamb_mode = ob.get("mode") == "lamb"
         gas = self.config.gradient_accumulation_steps
         fp16 = self.fp16_enabled
         mesh = self.mesh
@@ -541,10 +538,26 @@ class DeepSpeedEngine:
 
             m_local = b1 * flat_m + (1 - b1) * flat_g
             m_avg, ew_new, es_new = compressed_allreduce(m_local, ew[0], es[0], dp_axes, world)
-            upd = m_avg / (jnp.sqrt(flat_v) + eps)
-            if wd > 0.0:
-                upd = upd + wd * flat_p
-            flat_p_new = flat_p - step_lr * upd
+            if lamb_mode:
+                # 1-bit LAMB (reference onebit/lamb.py:443): Adam-style
+                # direction from the compressed momentum, scaled per tensor
+                # by the trust ratio FROZEN at freeze_step
+                m_tree = unravel(m_avg)
+
+                def leaf_update(p, m, v, frozen):
+                    d = m / (jnp.sqrt(v) + eps)
+                    if wd > 0.0:
+                        d = d + wd * p
+                    return p - step_lr * frozen * d
+
+                p_tree_new = jax.tree.map(leaf_update, params, m_tree,
+                                          opt_state.exp_avg_sq, opt_state.frozen_ratio)
+                flat_p_new, _ = jax.flatten_util.ravel_pytree(p_tree_new)
+            else:
+                upd = m_avg / (jnp.sqrt(flat_v) + eps)
+                if wd > 0.0:
+                    upd = upd + wd * flat_p
+                flat_p_new = flat_p - step_lr * upd
 
             keep = lambda new, old: jnp.where(overflow, old, new)
             flat_p_new = keep(flat_p_new, flat_p)
@@ -792,18 +805,23 @@ class DeepSpeedEngine:
         # feedback — __init__ owns the _onebit_errors default)
         self._onebit_cfg = None
         self._onebit_step_fn = None
-        if cfg.optimizer_name == C.ONEBIT_ADAM_OPTIMIZER and self.client_optimizer is None:
-            if self._onebit_comm_eligible():
+        if (cfg.optimizer_name in (C.ONEBIT_ADAM_OPTIMIZER, C.ONEBIT_LAMB_OPTIMIZER)
+                and self.client_optimizer is None):
+            opt_label = "1-bit Adam" if cfg.optimizer_name == C.ONEBIT_ADAM_OPTIMIZER else "1-bit LAMB"
+            if self._compressed_comm_eligible(cfg.optimizer_name):
                 op, base = compressed_opt_params()
-                self._onebit_cfg = dict(base, freeze_step=int(op.get("freeze_step", 100000)))
-                log_dist(f"1-bit Adam compressed collective active after "
+                self._onebit_cfg = dict(base,
+                                        freeze_step=int(op.get("freeze_step", 100000)),
+                                        mode=("lamb" if cfg.optimizer_name == C.ONEBIT_LAMB_OPTIMIZER
+                                              else "adam"))
+                log_dist(f"{opt_label} compressed collective active after "
                          f"freeze_step={self._onebit_cfg['freeze_step']} (1-bit wire payload)")
                 if clip > 0:
-                    log_dist("warning: gradient_clipping is not applied during the 1-bit "
+                    log_dist(f"warning: gradient_clipping is not applied during the {opt_label} "
                              "compression phase (local gradients are never globally reduced; "
-                             "matches reference 1-bit Adam semantics)")
+                             "matches reference 1-bit semantics)")
             else:
-                log_dist("1-bit Adam compressed collective requires a pure-DP mesh at "
+                log_dist(f"{opt_label} compressed collective requires a pure-DP mesh at "
                          "ZeRO stage 0; using error-feedback numerics without comm savings")
 
         # 0/1 Adam: the real interval/local-step schedule (runtime/zeroone.py).
@@ -896,6 +914,27 @@ class DeepSpeedEngine:
         # single broadcast spec would rank-mismatch scalar/per-sample leaves)
         self._train_step_fn = jax.jit(
             train_step,
+            in_shardings=(self.state_shardings, None, NamedSharding(mesh, P())),
+            out_shardings=(self.state_shardings, NamedSharding(mesh, P())),
+            donate_argnums=(0,),
+        )
+
+        # N optimizer steps per dispatch: scan train_step over a leading
+        # steps axis of device-resident batches. The idiomatic TPU training
+        # loop (host dispatch + per-step host sync cost amortizes over N) —
+        # the reference has no analog because torch re-enters Python every
+        # step by construction.
+        def train_steps(state: TrainState, batches, rng):
+            keys = jax.random.split(rng, jax.tree.leaves(batches)[0].shape[0])
+
+            def body(st, xs):
+                b, key = xs
+                return train_step(st, b, key)
+
+            return jax.lax.scan(body, state, (batches, keys))
+
+        self._train_steps_fn = jax.jit(
+            train_steps,
             in_shardings=(self.state_shardings, None, NamedSharding(mesh, P())),
             out_shardings=(self.state_shardings, NamedSharding(mesh, P())),
             donate_argnums=(0,),
@@ -998,6 +1037,85 @@ class DeepSpeedEngine:
 
         return jax.tree.map(put, batch)
 
+    def _shard_batch_steps(self, batch_stack):
+        """[n_steps, global_batch, ...] host leaves → device arrays shaped
+        [n_steps, gas, micro_global, ...] with the batch dim over the DP axes."""
+        gas = self.config.gradient_accumulation_steps
+        spec = self.topology.batch_spec(extra_leading=2,
+                                        shard_sequence=self.topology.sequence_parallel_size > 1)
+
+        def put(x):
+            x = np.asarray(x)
+            n, b = x.shape[0], x.shape[1]
+            assert b % gas == 0, f"global batch {b} not divisible by GAS {gas}"
+            x = x.reshape((n, gas, b // gas) + x.shape[2:])
+            leaf_spec = P(*spec[:x.ndim])
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+                return multihost_utils.host_local_array_to_global_array(x, self.mesh, leaf_spec)
+            return jax.device_put(x, NamedSharding(self.mesh, leaf_spec))
+
+        return jax.tree.map(put, batch_stack)
+
+    def train_batches(self, batch_stack):
+        """Run ``n_steps`` full optimization steps in ONE device dispatch.
+
+        ``batch_stack`` leaves are stacked host arrays
+        ``[n_steps, global_batch, ...]``; the steps run as a ``lax.scan`` over
+        the fused train step, so per-step host dispatch/sync cost amortizes
+        over the whole stack — the idiomatic TPU training loop. (The
+        reference has no analog: torch re-enters Python every step by
+        construction.)
+
+        Falls back to per-step ``train_batch`` when a host-driven schedule
+        owns stepping (offload optimizer, 1-bit/0-1 Adam phase switching,
+        curriculum seqlen, grad retention). Per-step RNG derives from one
+        fold_in + split rather than per-step fold_in, so dropout/gating
+        noise differs from an equivalent ``train_batch`` sequence (same
+        distribution).
+
+        Returns the per-step loss array ``[n_steps]``.
+        """
+        leaves = jax.tree.leaves(batch_stack)
+        if not leaves or np.ndim(leaves[0]) < 2:
+            raise ValueError("train_batches needs [n_steps, global_batch, ...] leaves")
+        n_steps = np.shape(leaves[0])[0]
+        host_paths = (getattr(self, "_host_opt", None) is not None
+                      or self._zeroone_runner is not None
+                      or self._onebit_cfg is not None
+                      or self.curriculum_scheduler is not None
+                      or getattr(self, "_retain_grads_flag", False))
+        if host_paths:
+            losses = [self.train_batch(jax.tree.map(lambda x: np.asarray(x)[i], batch_stack))
+                      for i in range(n_steps)]
+            return jnp.stack([jnp.asarray(l) for l in losses])
+        example = jax.tree.map(lambda x: np.asarray(x)[0], batch_stack)
+        self._maybe_autotune(example)
+        self.initialize_state(example)
+        self._maybe_trace_window()  # window granularity = dispatch granularity
+        self.tput_timer.start()
+        self.timers(TRAIN_BATCH_TIMER).start()
+        device_batch = self._shard_batch_steps(batch_stack)
+        rng = jax.random.fold_in(self._base_rng, self.global_steps)
+        self.state, metrics = self._train_steps_fn(self.state, device_batch, rng)
+        self.global_steps += n_steps
+        self.global_samples += n_steps * self.config.train_batch_size
+        self.micro_steps += n_steps * self.config.gradient_accumulation_steps
+        self.timers(TRAIN_BATCH_TIMER).stop()
+        self.tput_timer.stop(global_step=True)
+        # every step in the stack counts toward overflow accounting, not just
+        # the last one (_post_step sees a scalar; the stack's total lands here)
+        n_over = int(np.sum(np.asarray(jax.device_get(metrics["overflow"]))))
+        last = jax.tree.map(lambda m: m[-1], metrics)
+        if n_over:
+            self.skipped_steps += n_over
+            log_dist(f"{n_over}/{n_steps} steps in the fused stack overflowed; "
+                     f"updates skipped, loss scale -> {float(last['loss_scale'])}")
+        last = dict(last, overflow=jnp.asarray(False))  # counted above
+        self._post_step(last)
+        self._maybe_trace_window()
+        return metrics["loss"]
+
     # ------------------------------------------------------------------
     # training API
     # ------------------------------------------------------------------
@@ -1029,6 +1147,7 @@ class DeepSpeedEngine:
                            f"config.train_batch_size={self.config.train_batch_size} "
                            f"(autotuning run mode changes the batch triangle — feed "
                            f"engine.train_batch_size samples); sample accounting will drift")
+        self._maybe_trace_window()
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         device_batch = self._shard_batch(batch, with_gas_dim=True)
@@ -1069,6 +1188,7 @@ class DeepSpeedEngine:
                                 step_latency_s=step_latency,
                                 output_file=fp_cfg.output_file)
         self._post_step(metrics)
+        self._maybe_trace_window()  # close the window right after its last step
         return metrics["loss"]
 
     def eval_batch(self, batch):
@@ -1161,6 +1281,35 @@ class DeepSpeedEngine:
         self.global_steps += 1
         self.global_samples += self.config.train_batch_size
         self._post_step(metrics)
+
+    def _maybe_trace_window(self):
+        """Open/close the XLA trace capture window (trace_profiler config —
+        the reference wraps its loop in torch.profiler externally; here the
+        engine owns the window so one config flag captures a device trace).
+        Called before AND after each train_batch/train_batches dispatch so
+        the window closes as soon as its last step has run, not on the next
+        call (which may never come)."""
+        tc = getattr(self.config, "trace_profiler_config", None)
+        if tc is None or not tc.enabled:
+            return
+        step = self.global_steps + 1
+        if (not getattr(self, "_trace_active", False)
+                and tc.start_step <= step < tc.start_step + tc.num_steps):
+            import jax.profiler
+            opts = jax.profiler.ProfileOptions()
+            opts.host_tracer_level = tc.host_tracer_level
+            opts.python_tracer_level = 1 if tc.python_tracer else 0
+            jax.profiler.start_trace(tc.output_dir, profiler_options=opts)
+            self._trace_active = True
+            log_dist(f"XLA trace capture started at step {step} -> {tc.output_dir}")
+        elif getattr(self, "_trace_active", False) and step >= tc.start_step + tc.num_steps:
+            import jax.profiler
+            # drain in-flight device work so the closing trace has the ops
+            if self.state is not None:
+                jax.block_until_ready(self.state.params)
+            jax.profiler.stop_trace()
+            self._trace_active = False
+            log_dist(f"XLA trace capture stopped after step {step - 1}")
 
     def _post_step(self, metrics):
         # metric semantics note (VERDICT r2 weak #4): during a 1-bit/0-1 Adam

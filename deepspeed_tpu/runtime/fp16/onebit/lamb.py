@@ -24,7 +24,13 @@ def onebit_lamb(lr=1e-3,
                 weight_decay: float = 0.0,
                 max_coeff: float = 10.0,
                 min_coeff: float = 0.01,
+                external_comm: bool = False,
                 **_ignored) -> optax.GradientTransformation:
+    """``external_comm=True``: the engine owns the compression phase via the
+    real 1-bit collective (``engine._build_onebit_step_fn`` in lamb mode), so
+    this transform only needs exact warmup-LAMB semantics plus the
+    frozen-ratio capture at freeze_step — it skips the internal QDQ and
+    allocates no error-feedback buffers."""
     b1, b2 = betas
 
     def init(params):
@@ -33,7 +39,7 @@ def onebit_lamb(lr=1e-3,
         return OnebitLambState(count=jnp.zeros([], jnp.int32),
                                exp_avg=zeros(),
                                exp_avg_sq=zeros(),
-                               error_feedback=zeros(),
+                               error_feedback=() if external_comm else zeros(),
                                frozen_ratio=ones)
 
     def update(grads, state, params=None):
@@ -46,17 +52,20 @@ def onebit_lamb(lr=1e-3,
         exp_avg_sq = jax.tree.map(
             lambda v, g: jnp.where(warmup, b2 * v + (1 - b2) * jnp.square(g), v), state.exp_avg_sq, grads)
 
-        def _compressed(m, e):
-            corrected = m + e
-            scale = jnp.mean(jnp.abs(corrected))
-            comp = jnp.sign(corrected) * scale
-            return comp, corrected - comp
+        if external_comm:
+            momentum, err = exp_avg, state.error_feedback
+        else:
+            def _compressed(m, e):
+                corrected = m + e
+                scale = jnp.mean(jnp.abs(corrected))
+                comp = jnp.sign(corrected) * scale
+                return comp, corrected - comp
 
-        ce = jax.tree.map(_compressed, exp_avg, state.error_feedback)
-        comp = jax.tree.map(lambda t: t[0], ce, is_leaf=lambda x: isinstance(x, tuple))
-        new_err = jax.tree.map(lambda t: t[1], ce, is_leaf=lambda x: isinstance(x, tuple))
-        momentum = jax.tree.map(lambda m, c: jnp.where(warmup, m, c), exp_avg, comp)
-        err = jax.tree.map(lambda e0, e1: jnp.where(warmup, e0, e1), state.error_feedback, new_err)
+            ce = jax.tree.map(_compressed, exp_avg, state.error_feedback)
+            comp = jax.tree.map(lambda t: t[0], ce, is_leaf=lambda x: isinstance(x, tuple))
+            new_err = jax.tree.map(lambda t: t[1], ce, is_leaf=lambda x: isinstance(x, tuple))
+            momentum = jax.tree.map(lambda m, c: jnp.where(warmup, m, c), exp_avg, comp)
+            err = jax.tree.map(lambda e0, e1: jnp.where(warmup, e0, e1), state.error_feedback, new_err)
 
         def _trust_and_dir(m, v, p, frozen):
             adam_step = m / (jnp.sqrt(v) + eps)

@@ -1,0 +1,37 @@
+"""Trace annotation — the TPU analog of NVTX ranges.
+
+Reference ``deepspeed/utils/nvtx.py:9`` (``instrument_w_nvtx`` pushes an
+accelerator range around every call). On TPU the profiler is XLA's: host
+spans come from ``jax.profiler.TraceAnnotation`` and compiled-program spans
+from ``jax.named_scope`` (which names the HLO ops a region traces to).
+``instrument_w_nvtx`` applies both so a function shows up in the trace
+viewer whether it runs host-side or inside a jitted program.
+"""
+
+import functools
+
+import jax
+
+
+def range_push(name: str):
+    """Open a named host-trace span (reference ``range_push``). Returns the
+    annotation object; pass it to ``range_pop``."""
+    ann = jax.profiler.TraceAnnotation(name)
+    ann.__enter__()
+    return ann
+
+
+def range_pop(ann) -> None:
+    ann.__exit__(None, None, None)
+
+
+def instrument_w_nvtx(func):
+    """Record a named span (host trace + HLO scope) for every call."""
+
+    @functools.wraps(func)
+    def wrapped_fn(*args, **kwargs):
+        with jax.profiler.TraceAnnotation(func.__qualname__), \
+                jax.named_scope(func.__qualname__):
+            return func(*args, **kwargs)
+
+    return wrapped_fn

@@ -111,6 +111,22 @@ def test_autotp_heuristics():
     assert specs["embed_tokens"] == P("tensor")
 
 
+def test_autotp_shape_heuristic_for_unknown_names():
+    """Unknown naming conventions: non-square 2-D kernels classify by aspect
+    ratio (fused-QKV / gated-MLP are expanding, down-projections contracting);
+    square kernels stay replicated."""
+    params = {
+        "blk": {"proj_in_weird": {"kernel": np.zeros((64, 192))},   # d -> 3d
+                "proj_out_weird": {"kernel": np.zeros((256, 64))},  # 4d -> d
+                "mixer": {"kernel": np.zeros((64, 64))}},           # square: ambiguous
+    }
+    specs = AutoTP.tp_parser(params, tp_size=4)
+    from jax.sharding import PartitionSpec as P
+    assert specs["blk"]["proj_in_weird"]["kernel"] == P(None, "tensor")
+    assert specs["blk"]["proj_out_weird"]["kernel"] == P("tensor", None)
+    assert specs["blk"]["mixer"]["kernel"] == P()
+
+
 def test_hf_gpt2_checkpoint_parity():
     """HF torch GPT-2 logits == converted deepspeed_tpu logits."""
     torch = pytest.importorskip("torch")

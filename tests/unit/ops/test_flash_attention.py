@@ -77,3 +77,85 @@ def test_flash_fallback_with_mask():
     ref = dot_product_attention(q, k, v, backend="xla", causal=True, mask=mask)
     out = dot_product_attention(q, k, v, backend="flash", causal=True, mask=mask)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+# ---------------------------------------------------------------- decode
+def test_flash_decode_matches_xla_varying_lengths():
+    """Per-sequence lengths: each row attends to its own live prefix only."""
+    rng = np.random.default_rng(3)
+    b, lkv, h, d = 4, 256, 2, 32
+    lengths = jnp.asarray([5, 64, 200, 256], jnp.int32)
+    q = jnp.asarray(rng.standard_normal((b, 1, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, lkv, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, lkv, h, d)), jnp.float32)
+    ref = dot_product_attention(q, k, v, backend="xla", causal=False,
+                                decode_lengths=lengths)
+    out = dot_product_attention(q, k, v, backend="flash", causal=False,
+                                decode_lengths=lengths, block_k=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_decode_multi_token_append():
+    """lq>1 (chunked prefill / speculative step): row i of q sits at global
+    position length - lq + i and must only see positions <= its own."""
+    rng = np.random.default_rng(4)
+    b, lq, lkv, h, d = 2, 8, 128, 3, 16
+    lengths = jnp.asarray([32, 128], jnp.int32)
+    q = jnp.asarray(rng.standard_normal((b, lq, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, lkv, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, lkv, h, d)), jnp.float32)
+    ref = dot_product_attention(q, k, v, backend="xla", causal=False,
+                                decode_lengths=lengths)
+    out = dot_product_attention(q, k, v, backend="flash", causal=False,
+                                decode_lengths=lengths, block_k=32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_decode_ignores_dead_cache():
+    """Garbage beyond a sequence's length must not leak into the output."""
+    rng = np.random.default_rng(5)
+    b, lkv, h, d = 1, 128, 1, 16
+    q = jnp.asarray(rng.standard_normal((b, 1, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, lkv, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, lkv, h, d)), jnp.float32)
+    lengths = jnp.asarray([40], jnp.int32)
+    out1 = dot_product_attention(q, k, v, backend="flash", causal=False,
+                                 decode_lengths=lengths, block_k=32)
+    poison = jnp.full_like(k[:, 40:], 1e4)
+    k2 = k.at[:, 40:].set(poison)
+    v2 = v.at[:, 40:].set(poison)
+    out2 = dot_product_attention(q, k2, v2, backend="flash", causal=False,
+                                 decode_lengths=lengths, block_k=32)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), atol=1e-6)
+
+
+def test_flash_decode_bf16():
+    rng = np.random.default_rng(6)
+    b, lkv, h, d = 2, 128, 2, 32
+    lengths = jnp.asarray([17, 99], jnp.int32)
+    q = jnp.asarray(rng.standard_normal((b, 1, h, d)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((b, lkv, h, d)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((b, lkv, h, d)), jnp.bfloat16)
+    ref = dot_product_attention(q, k, v, backend="xla", causal=False,
+                                decode_lengths=lengths)
+    out = dot_product_attention(q, k, v, backend="flash", causal=False,
+                                decode_lengths=lengths, block_k=64)
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref, np.float32),
+                               atol=2e-2, rtol=2e-2)
+
+
+def test_flash_decode_fully_masked_rows_are_zero():
+    """lq > lengths[b]: rows with no live positions return zeros (documented
+    contract) instead of a bogus average of dead cache slots."""
+    rng = np.random.default_rng(7)
+    b, lq, lkv, h, d = 1, 4, 64, 1, 16
+    q = jnp.asarray(rng.standard_normal((b, lq, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, lkv, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, lkv, h, d)), jnp.float32)
+    lengths = jnp.asarray([2], jnp.int32)
+    out = dot_product_attention(q, k, v, backend="flash", causal=False,
+                                decode_lengths=lengths, block_k=32)
+    # rows 0,1 sit at q_pos -2,-1 -> fully masked -> zeros
+    np.testing.assert_array_equal(np.asarray(out[:, :2]), np.zeros((b, 2, h, d), np.float32))
+    # rows 2,3 are live and must be finite/nonzero
+    assert np.abs(np.asarray(out[:, 2:])).max() > 0

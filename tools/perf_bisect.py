@@ -1,7 +1,7 @@
 """Perf bisect: time the pieces of the 350M train step on the real chip.
 
 Run: python tools/perf_bisect.py [piece ...]
-Pieces: fwd bwd opt full noembed nolmhead attnonly
+Pieces: fwd fwdnoloss bwd bwd32 opt nolmhead
 Each prints one line: <piece> <ms>
 """
 import os
@@ -106,15 +106,6 @@ def main():
             p2, s2 = f(p2, s2, grads)
         jax.block_until_ready(p2)
         print(f"opt {(time.time() - t0) / STEPS * 1e3:.1f}", flush=True)
-
-    if "noembed" in pieces:
-        # transformer stack only: skip wte/wpe gather and lm head
-        def body_loss(p, x):
-            import flax.linen as nn
-            # run blocks via model.apply with a hidden-states entry point is
-            # not exposed; approximate with logits-sum on tiny vocab instead
-            return 0.0
-        pass
 
     if "nolmhead" in pieces:
         def loss_nolm(p, i):
