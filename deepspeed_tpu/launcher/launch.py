@@ -63,6 +63,10 @@ def parse_args(args=None):
     parser.add_argument("--master_addr", type=str, default="127.0.0.1")
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--num_chips", type=int, default=0)
+    # reference launch.py:92 --bind_cores_to_rank: pin each node process to
+    # its share of host cores (input pipeline / offload-optimizer threads)
+    parser.add_argument("--bind_cores_to_rank", action="store_true")
+    parser.add_argument("--bind_core_list", type=str, default=None)
     parser.add_argument("user_script", type=str)
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
     return parser.parse_args(args)
@@ -115,6 +119,21 @@ def main(args=None):
     env = build_child_env(node_rank, args.nnodes, args.master_addr, args.master_port,
                           args.num_chips)
     cmd = [sys.executable, args.user_script] + args.user_args
+    if args.bind_cores_to_rank:
+        # this launcher spawns ONE process per node (LOCAL_RANK=0), so the
+        # bind is over all of this host's cores (or the user's core list) —
+        # the (num_local_procs, local_rank) slice is (1, 0), NOT the global
+        # node rank: slicing by node rank would strand most of each host
+        from deepspeed_tpu.utils.numa import get_numactl_cmd
+        cores_per_rank, numactl_prefix = get_numactl_cmd(args.bind_core_list, 1, 0)
+        env["OMP_NUM_THREADS"] = str(cores_per_rank)
+        if numactl_prefix:
+            cmd = numactl_prefix + cmd
+        else:
+            # no numactl on the host: the child binds itself
+            env["DS_BIND_CORES"] = args.bind_core_list or "all"
+            env["DS_BIND_RANK"] = "0"
+            env["DS_BIND_NPROCS"] = "1"
     logger.info(f"node {node_rank}/{args.nnodes}: spawning {' '.join(cmd)}")
     child = subprocess.Popen(cmd, env=env, start_new_session=True)
 

@@ -1,12 +1,14 @@
 """Native op builders (reference ``op_builder/``)."""
 
-from deepspeed_tpu.ops.op_builder.builder import AsyncIOBuilder, CPUAdamBuilder, OpBuilder
+from deepspeed_tpu.ops.op_builder.builder import (AsyncIOBuilder, CPUAdamBuilder, OpBuilder,
+                                                  SpatialInferenceBuilder)
 
 # registry for ds_report's compatibility matrix (reference ALL_OPS,
 # op_builder/all_ops.py)
 ALL_BUILDERS = {
     CPUAdamBuilder.NAME: CPUAdamBuilder,
     AsyncIOBuilder.NAME: AsyncIOBuilder,
+    SpatialInferenceBuilder.NAME: SpatialInferenceBuilder,
 }
 
-__all__ = ["OpBuilder", "CPUAdamBuilder", "AsyncIOBuilder", "ALL_BUILDERS"]
+__all__ = ["OpBuilder", "CPUAdamBuilder", "AsyncIOBuilder", "SpatialInferenceBuilder", "ALL_BUILDERS"]

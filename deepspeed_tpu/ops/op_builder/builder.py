@@ -132,3 +132,28 @@ class AsyncIOBuilder(OpBuilder):
         lib.aio_write_sync.restype = ctypes.c_int
         lib.aio_read_sync.argtypes = [ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64]
         lib.aio_read_sync.restype = ctypes.c_int
+
+
+class SpatialInferenceBuilder(OpBuilder):
+    """Reference ``op_builder/spatial_inference.py``. The spatial ops are
+    pure-XLA on TPU (``ops/spatial``) — no native source; "building" is a
+    no-op and compatibility means jax is importable."""
+
+    NAME = "spatial_inference"
+
+    def sources(self):
+        return []
+
+    def is_compatible(self) -> bool:
+        try:
+            import jax  # noqa: F401
+            return True
+        except ImportError:
+            return False
+
+    def build(self):
+        return None
+
+    def load(self):
+        import deepspeed_tpu.ops.spatial as spatial
+        return spatial

@@ -1,0 +1,19 @@
+"""Random-LTD token-dropping ops (reference
+``deepspeed/ops/random_ltd/dropping_utils.py`` + the CUDA kernels in
+``csrc/random_ltd/``: ``token_sort_``, ``token_gather``, ``token_scatter_``).
+
+TPU formulation: the comparison-free CUDA sort becomes ``jnp.sort`` and the
+gather/scatter become ``jnp.take_along_axis`` / ``.at[].set`` — XLA lowers
+both onto the vector unit, and autodiff replaces the hand-written
+``GatherTokens``/``ScatterTokens`` autograd pairs (gather's VJP IS scatter).
+The module-level layer lives in
+``runtime/data_pipeline/data_routing/basic_layer.py`` (RandomLayerTokenDrop);
+these are the reference-shaped functional primitives.
+"""
+
+from deepspeed_tpu.ops.random_ltd.dropping_utils import (bert_sample_tokens, gpt_sample_tokens,
+                                                         token_gather, token_scatter_,
+                                                         token_sort_)
+
+__all__ = ["gpt_sample_tokens", "bert_sample_tokens", "token_sort_",
+           "token_gather", "token_scatter_"]

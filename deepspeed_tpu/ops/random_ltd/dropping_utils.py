@@ -1,0 +1,104 @@
+"""Random-LTD functional primitives — reference surface of
+``deepspeed/ops/random_ltd/dropping_utils.py`` (``gpt_sample_tokens:18``,
+``bert_sample_tokens:52``, ``GatherTokens:80``, ``ScatterTokens:104``) over
+jnp. Returns/shape contracts match the reference docstrings:
+
+* sample fns → ``sampled_indices [layers, batch, reserved]`` (sorted
+  ascending per row, the reference's ``token_sort_`` invariant) plus the
+  truncated attention mask.
+* ``token_gather``/``token_scatter_`` are differentiable by construction —
+  jax derives the scatter VJP of a gather, which is exactly what the
+  reference's autograd Functions hand-implement.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def token_sort_(indices: jax.Array, seq_length: int = 0) -> jax.Array:
+    """Ascending per-row sort (reference CUDA ``token_sort_``,
+    ``csrc/random_ltd/token_sort.cu``). ``seq_length`` is accepted for call
+    parity; jnp.sort needs no histogram workspace."""
+    del seq_length
+    return jnp.sort(indices, axis=-1)
+
+
+def _sample(rng: jax.Array, layers: int, batch: int, seq: int, reserved: int) -> jax.Array:
+    """[layers, batch, reserved] distinct sorted positions per row — the
+    reference's uniform ``torch.multinomial`` without replacement."""
+    if reserved > seq:
+        raise ValueError(f"reserved_length {reserved} > seq_length {seq}")
+    keys = jax.random.split(rng, layers * batch)
+    idx = jax.vmap(lambda k: jax.random.choice(k, seq, (reserved,), replace=False))(keys)
+    return jnp.sort(idx.reshape(layers, batch, reserved).astype(jnp.int32), axis=-1)
+
+
+def gpt_sample_tokens(reserved_length: int,
+                      seq_length: int,
+                      batch_size: int,
+                      layers: int = 1,
+                      rng: Optional[jax.Array] = None,
+                      attn_mask: Optional[jax.Array] = None
+                      ) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Reference ``dropping_utils.py:18``. The causal mask truncates to the
+    reserved square ([B, 1, r, r])."""
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    sampled = _sample(rng, layers, batch_size, seq_length, reserved_length)
+    new_mask = None
+    if attn_mask is not None:
+        new_mask = attn_mask[..., :reserved_length, :reserved_length]
+    return sampled, new_mask
+
+
+def bert_sample_tokens(reserved_length: int,
+                       seq_length: int,
+                       batch_size: int,
+                       layers: int = 1,
+                       rng: Optional[jax.Array] = None,
+                       attn_mask: Optional[jax.Array] = None
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """Reference ``dropping_utils.py:52``: bidirectional masks are gathered
+    per layer at the sampled positions ([layers, B, 1, r, r])."""
+    if attn_mask is None:
+        raise ValueError("bert_sample_tokens requires attn_mask")
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    sampled = _sample(rng, layers, batch_size, seq_length, reserved_length)
+
+    def layer_mask(idx_lb):  # [B, r] for one layer
+        def one(b_mask, b_idx):  # b_mask [1, L, L] (or [L, L]), b_idx [r]
+            m = b_mask[..., b_idx, :][..., :, b_idx]
+            return m
+        return jax.vmap(one)(attn_mask, idx_lb)
+
+    new_mask = jax.vmap(layer_mask)(sampled)
+    return sampled, new_mask
+
+
+def token_gather(activations: jax.Array, sorted_indices: jax.Array,
+                 batch_first: bool = True) -> jax.Array:
+    """Keep the sampled tokens: [B, L, ...] → [B, r, ...] (reference CUDA
+    ``token_gather``; VJP is the zero-fill scatter, derived by jax)."""
+    if not batch_first:
+        activations = jnp.swapaxes(activations, 0, 1)
+    idx = sorted_indices.reshape(sorted_indices.shape[-2:])  # [B, r]
+    out = jnp.take_along_axis(
+        activations, idx[(...,) + (None,) * (activations.ndim - 2)], axis=1)
+    return out if batch_first else jnp.swapaxes(out, 0, 1)
+
+
+def token_scatter_(all_activations: jax.Array, layer_activations: jax.Array,
+                   sorted_indices: jax.Array, batch_first: bool = True) -> jax.Array:
+    """Write the processed reserved tokens back into the full sequence
+    (reference CUDA ``token_scatter_``; functional — returns the updated
+    array rather than mutating)."""
+    swap = not batch_first
+    if swap:
+        all_activations = jnp.swapaxes(all_activations, 0, 1)
+        layer_activations = jnp.swapaxes(layer_activations, 0, 1)
+    idx = sorted_indices.reshape(sorted_indices.shape[-2:])  # [B, r]
+    b = all_activations.shape[0]
+    batch_idx = jnp.arange(b)[:, None]
+    out = all_activations.at[batch_idx, idx].set(layer_activations)
+    return jnp.swapaxes(out, 0, 1) if swap else out

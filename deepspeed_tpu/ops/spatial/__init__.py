@@ -1,0 +1,55 @@
+"""Spatial (diffusers / UNet) inference ops.
+
+Reference ``csrc/spatial/csrc/pt_binding.cpp:109-111`` exposes three fused
+CUDA bias-add kernels for stable-diffusion UNets (``nhwc_bias_add``,
+``nhwc_bias_add_add``, ``nhwc_bias_add_bias_add``) working on
+channels-last activations. On TPU the layout question disappears — XLA
+convs are NHWC-native and elementwise chains fuse into their producers —
+so these are jnp expressions with the reference's exact call surface; the
+op exists so diffusers-style pipelines port without code changes.
+
+Accepts activations either NHWC ([B, H, W, C], TPU-native) or channels-
+last-NCHW like the reference binding ([B, C, H, W] logical); the bias is
+[C] and broadcasts over the spatial dims in both cases.
+"""
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def _bias_shape(activations, bias, layout: str):
+    if layout not in ("nhwc", "nchw"):
+        raise ValueError(f"layout must be 'nhwc' or 'nchw', got {layout!r}")
+    c = bias.shape[-1]
+    if layout == "nhwc":
+        if activations.shape[-1] != c:
+            raise ValueError(f"bias {c} != channel dim {activations.shape[-1]} (nhwc)")
+        return bias.reshape((1,) * (activations.ndim - 1) + (c,))
+    if activations.shape[1] != c:
+        raise ValueError(f"bias {c} != channel dim {activations.shape[1]} (nchw)")
+    return bias.reshape((1, c) + (1,) * (activations.ndim - 2))
+
+
+def nhwc_bias_add(activations: jnp.ndarray, bias: jnp.ndarray,
+                  layout: str = "nhwc") -> jnp.ndarray:
+    """``activation + bias`` (reference ``seq_unroll_bias_add``)."""
+    return activations + _bias_shape(activations, bias, layout).astype(activations.dtype)
+
+
+def nhwc_bias_add_add(activations: jnp.ndarray, bias: jnp.ndarray,
+                      other: jnp.ndarray, layout: str = "nhwc") -> jnp.ndarray:
+    """``activation + bias + other`` — the UNet residual fuse
+    (reference ``seq_bias_add_add``)."""
+    return (activations + _bias_shape(activations, bias, layout).astype(activations.dtype)
+            + other.astype(activations.dtype))
+
+
+def nhwc_bias_add_bias_add(activations: jnp.ndarray, bias: jnp.ndarray,
+                           other: jnp.ndarray, other_bias: jnp.ndarray,
+                           layout: str = "nhwc") -> jnp.ndarray:
+    """``(activation + bias) + (other + other_bias)`` — the double-residual
+    fuse (reference ``seq_bias_add_bias_add``)."""
+    return (activations + _bias_shape(activations, bias, layout).astype(activations.dtype)
+            + other.astype(activations.dtype)
+            + _bias_shape(other, other_bias, layout).astype(activations.dtype))

@@ -1092,7 +1092,7 @@ class DeepSpeedEngine:
         example = jax.tree.map(lambda x: np.asarray(x)[0], batch_stack)
         self._maybe_autotune(example)
         self.initialize_state(example)
-        self._maybe_trace_window()  # window granularity = dispatch granularity
+        self._maybe_trace_window(n_steps)
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         device_batch = self._shard_batch_steps(batch_stack)
@@ -1282,19 +1282,22 @@ class DeepSpeedEngine:
         self.global_samples += self.config.train_batch_size
         self._post_step(metrics)
 
-    def _maybe_trace_window(self):
+    def _maybe_trace_window(self, n_steps: int = 1):
         """Open/close the XLA trace capture window (trace_profiler config —
         the reference wraps its loop in torch.profiler externally; here the
         engine owns the window so one config flag captures a device trace).
         Called before AND after each train_batch/train_batches dispatch so
         the window closes as soon as its last step has run, not on the next
-        call (which may never come)."""
+        call (which may never come). ``n_steps``: how many steps the next
+        dispatch runs — a fused stack whose RANGE intersects the window
+        opens it (window granularity = dispatch granularity)."""
         tc = getattr(self.config, "trace_profiler_config", None)
         if tc is None or not tc.enabled:
             return
         step = self.global_steps + 1
         if (not getattr(self, "_trace_active", False)
-                and tc.start_step <= step < tc.start_step + tc.num_steps):
+                and step < tc.start_step + tc.num_steps
+                and step + n_steps > tc.start_step):
             import jax.profiler
             opts = jax.profiler.ProfileOptions()
             opts.host_tracer_level = tc.host_tracer_level

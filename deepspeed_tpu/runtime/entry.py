@@ -47,6 +47,18 @@ def initialize(args=None,
     if dist_init_required is None or dist_init_required:
         dist.init_distributed(verbose=False)
 
+    import os
+    if os.environ.get("DS_BIND_CORES"):
+        # launcher --bind_cores_to_rank on a numactl-less host: the child
+        # pins itself (utils/numa.py; reference launch.py:227 numactl path)
+        from deepspeed_tpu.utils.numa import bind_cores_for_rank
+        spec = os.environ["DS_BIND_CORES"]
+        bound = bind_cores_for_rank(int(os.environ.get("DS_BIND_NPROCS", "1")),
+                                    int(os.environ.get("DS_BIND_RANK", "0")),
+                                    None if spec == "all" else spec)
+        if bound:
+            log_dist(f"bound to host cores {bound[0]}-{bound[-1]} ({len(bound)} cores)")
+
     ds_config = DeepSpeedConfig(config,
                                 dp_world_size=topology.data_parallel_size if topology is not None else None)
     from deepspeed_tpu.runtime.pipe.module import PipelineModule

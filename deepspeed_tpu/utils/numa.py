@@ -1,0 +1,97 @@
+"""CPU-core binding for host-side workers (reference
+``deepspeed/utils/numa.py``: ``parse_range_list:86``, ``get_numactl_cmd:101``).
+
+On TPU hosts the device does the math, but host cores still matter for the
+input pipeline, the offload optimizer (C++ AVX Adam) and NVMe swappers —
+the same reason the reference binds ranks with numactl. The TPU
+formulation avoids the numactl dependency: affinity is applied directly
+with ``os.sched_setaffinity`` (``bind_cores_for_rank``), and
+``get_numactl_cmd`` is kept for launcher parity when numactl exists.
+"""
+
+import os
+import shutil
+import subprocess
+from typing import List, Optional, Sequence
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def get_numa_cores() -> List[List[int]]:
+    """Core ids grouped by NUMA node (reference ``numa.py:24`` parses
+    ``numactl --hardware``; falls back to one flat node when unavailable)."""
+    numactl = shutil.which("numactl")
+    if numactl:
+        try:
+            out = subprocess.run([numactl, "--hardware"], capture_output=True,
+                                 text=True, timeout=10).stdout
+            nodes = []
+            for line in out.splitlines():
+                # "node 0 cpus: 0 1 2 ..."
+                parts = line.split()
+                if len(parts) >= 4 and parts[0] == "node" and parts[2] == "cpus:":
+                    nodes.append([int(c) for c in parts[3:]])
+            if nodes:
+                return nodes
+        except (OSError, subprocess.SubprocessError):
+            pass
+    try:
+        return [sorted(os.sched_getaffinity(0))]
+    except (AttributeError, OSError):
+        return [list(range(os.cpu_count() or 1))]
+
+
+def parse_range(rng: str) -> List[int]:
+    """``"3"`` or ``"0-7"`` → core list (reference ``numa.py:62``)."""
+    if "-" in rng:
+        lo, hi = rng.split("-", 1)
+        lo_i, hi_i = int(lo), int(hi)
+        if hi_i < lo_i:
+            raise ValueError(f"invalid core range {rng!r}")
+        return list(range(lo_i, hi_i + 1))
+    return [int(rng)]
+
+
+def parse_range_list(range_str: str) -> List[int]:
+    """``"0-7,16-23"`` → sorted core list (reference ``numa.py:86``)."""
+    if not range_str:
+        return []
+    cores: List[int] = []
+    for rng in range_str.split(","):
+        cores.extend(parse_range(rng.strip()))
+    return sorted(set(cores))
+
+
+def _rank_slice(cores: Sequence[int], num_local_procs: int, local_rank: int) -> List[int]:
+    per = max(1, len(cores) // max(num_local_procs, 1))
+    start = local_rank * per
+    return list(cores[start:start + per]) or list(cores)
+
+
+def bind_cores_for_rank(num_local_procs: int, local_rank: int,
+                        core_list: Optional[str] = None) -> List[int]:
+    """Pin this process to its share of host cores. Returns the core list
+    actually applied (empty when the platform has no affinity support)."""
+    cores = parse_range_list(core_list) if core_list else sorted(
+        c for node in get_numa_cores() for c in node)
+    mine = _rank_slice(cores, num_local_procs, local_rank)
+    try:
+        os.sched_setaffinity(0, mine)
+    except (AttributeError, OSError) as e:
+        logger.warning(f"could not set CPU affinity ({e}); continuing unbound")
+        return []
+    return mine
+
+
+def get_numactl_cmd(bind_core_list: Optional[str], num_local_procs: int,
+                    local_rank: int):
+    """(cores_per_rank, numactl argv prefix) — launcher parity with reference
+    ``numa.py:101``. Empty prefix when numactl is absent (the launcher then
+    calls ``bind_cores_for_rank`` in-process instead)."""
+    cores = parse_range_list(bind_core_list) if bind_core_list else sorted(
+        c for node in get_numa_cores() for c in node)
+    mine = _rank_slice(cores, num_local_procs, local_rank)
+    if shutil.which("numactl") is None:
+        return len(mine), []
+    spec = ",".join(str(c) for c in mine)
+    return len(mine), ["numactl", f"--physcpubind={spec}"]

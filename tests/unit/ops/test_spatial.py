@@ -1,0 +1,52 @@
+"""Spatial (diffusers) bias-add ops — parity with the reference semantics
+(``tests/unit/ops/spatial/test_nhwc_bias_add.py``: activation + bias
+broadcast over spatial dims, both layouts)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.spatial import nhwc_bias_add, nhwc_bias_add_add, nhwc_bias_add_bias_add
+
+
+@pytest.mark.parametrize("layout", ["nhwc", "nchw"])
+def test_bias_add(layout):
+    rng = np.random.default_rng(0)
+    b, c, s = 2, 192, 16
+    shape = (b, s, s, c) if layout == "nhwc" else (b, c, s, s)
+    x = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    bias = jnp.asarray(rng.standard_normal((c,)), jnp.float32)
+    out = nhwc_bias_add(x, bias, layout=layout)
+    ref = np.asarray(x) + (np.asarray(bias).reshape(1, 1, 1, c) if layout == "nhwc"
+                           else np.asarray(bias).reshape(1, c, 1, 1))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+
+
+def test_bias_add_add_and_double():
+    rng = np.random.default_rng(1)
+    b, c, s = 1, 320, 8
+    x = jnp.asarray(rng.standard_normal((b, s, s, c)), jnp.float32)
+    other = jnp.asarray(rng.standard_normal((b, s, s, c)), jnp.float32)
+    bias = jnp.asarray(rng.standard_normal((c,)), jnp.float32)
+    bias2 = jnp.asarray(rng.standard_normal((c,)), jnp.float32)
+    np.testing.assert_allclose(np.asarray(nhwc_bias_add_add(x, bias, other)),
+                               np.asarray(x) + np.asarray(bias) + np.asarray(other), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(nhwc_bias_add_bias_add(x, bias, other, bias2)),
+        np.asarray(x) + np.asarray(bias) + np.asarray(other) + np.asarray(bias2), rtol=1e-6)
+
+
+def test_bad_layout_and_shape():
+    x = jnp.zeros((1, 4, 4, 8))
+    with pytest.raises(ValueError):
+        nhwc_bias_add(x, jnp.zeros((7,)))
+    with pytest.raises(ValueError):
+        nhwc_bias_add(x, jnp.zeros((8,)), layout="chwn")
+
+
+def test_builder_registered():
+    from deepspeed_tpu.ops.op_builder import ALL_BUILDERS, SpatialInferenceBuilder
+    assert "spatial_inference" in ALL_BUILDERS
+    b = SpatialInferenceBuilder()
+    assert b.is_compatible()
+    assert b.load().nhwc_bias_add is nhwc_bias_add

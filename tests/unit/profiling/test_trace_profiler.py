@@ -40,3 +40,23 @@ def test_trace_window_writes_profile(tmp_path):
     # jax writes plugins/profile/<run>/*.xplane.pb under the log dir
     found = glob.glob(os.path.join(out, "**", "*.xplane.pb"), recursive=True)
     assert found, f"no xplane trace written under {out}"
+
+
+def test_trace_window_inside_fused_stack(tmp_path):
+    """start_step strictly inside a train_batches stack must still open the
+    window (window granularity = dispatch granularity)."""
+    out = str(tmp_path / "fused_trace")
+    cfg = get_gpt2_config("test")
+    engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2LMHeadModel(cfg), config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "trace_profiler": {"enabled": True, "start_step": 2, "num_steps": 1,
+                           "output_dir": out},
+    })
+    stack = {"input_ids": np.tile(np.arange(8 * 32, dtype=np.int32).reshape(1, 8, 32) % cfg.vocab_size,
+                                  (4, 1, 1))}
+    engine.initialize_state({"input_ids": stack["input_ids"][0]})
+    engine.train_batches(stack)  # steps 1..4; window [2,3) intersects
+    assert not getattr(engine, "_trace_active", False)
+    found = glob.glob(os.path.join(out, "**", "*.xplane.pb"), recursive=True)
+    assert found, f"no xplane trace written under {out}"

@@ -1,0 +1,48 @@
+"""Core-binding utilities (reference deepspeed/utils/numa.py parity)."""
+
+import os
+
+import pytest
+
+from deepspeed_tpu.utils.numa import (bind_cores_for_rank, get_numa_cores, get_numactl_cmd,
+                                      parse_range, parse_range_list)
+
+
+def test_parse_range():
+    assert parse_range("3") == [3]
+    assert parse_range("0-3") == [0, 1, 2, 3]
+    with pytest.raises(ValueError):
+        parse_range("5-2")
+
+
+def test_parse_range_list():
+    assert parse_range_list("0-2,5,7-8") == [0, 1, 2, 5, 7, 8]
+    assert parse_range_list("") == []
+    assert parse_range_list("3,1,1") == [1, 3]
+
+
+def test_get_numa_cores_nonempty():
+    nodes = get_numa_cores()
+    assert nodes and all(isinstance(c, int) for node in nodes for c in node)
+
+
+def test_numactl_cmd_splits_by_rank():
+    n, cmd0 = get_numactl_cmd("0-7", num_local_procs=2, local_rank=0)
+    n1, cmd1 = get_numactl_cmd("0-7", num_local_procs=2, local_rank=1)
+    assert n == n1 == 4
+    if cmd0:  # numactl present on the host
+        assert "--physcpubind=0,1,2,3" in cmd0[1]
+        assert "--physcpubind=4,5,6,7" in cmd1[1]
+
+
+def test_bind_cores_for_rank_applies_affinity():
+    if not hasattr(os, "sched_getaffinity"):
+        pytest.skip("platform has no affinity API")
+    before = os.sched_getaffinity(0)
+    try:
+        cores = sorted(before)
+        spec = f"{cores[0]}-{cores[-1]}" if len(cores) > 1 else str(cores[0])
+        mine = bind_cores_for_rank(num_local_procs=1, local_rank=0, core_list=spec)
+        assert set(mine) == set(os.sched_getaffinity(0))
+    finally:
+        os.sched_setaffinity(0, before)

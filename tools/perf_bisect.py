@@ -51,7 +51,7 @@ def timed(fn, *args):
 
 
 def main():
-    pieces = sys.argv[1:] or ["fwd", "bwd", "opt", "full"]
+    pieces = sys.argv[1:] or ["fwd", "bwd", "opt"]
     cfg = get_gpt2_config(MODEL, n_positions=SEQ, remat=REMAT,
                           attention_backend=ATTN, dtype=jnp.bfloat16)
     model = GPT2LMHeadModel(cfg)
