@@ -3,3 +3,4 @@ from deepspeed_tpu.models.gpt2 import (GPT2Config, GPT2LMHeadModel, GPT2_CONFIGS
 from deepspeed_tpu.models.llama import (LlamaConfig, LlamaForCausalLM, LLAMA_CONFIGS, get_llama_config)
 from deepspeed_tpu.models.bert import (BertConfig, BertModel, BertForMaskedLM, BERT_CONFIGS,
                                        get_bert_config, bert_mlm_loss)
+from deepspeed_tpu.models.opt import (OPTConfig, OPTForCausalLM, OPT_CONFIGS, get_opt_config)

@@ -1,0 +1,201 @@
+"""OPT family — decoder with learned positions (offset 2) and ReLU MLP
+(the reference serves OPT through kernel injection,
+``module_inject/containers/opt.py``; HF ``OPTForCausalLM`` is the
+checkpoint source).
+
+Same TPU conventions as ``models/gpt2.py``: logical axis names drive the
+ZeRO planner, attention goes through the pluggable backend seam
+(xla/flash with ``decode_lengths`` for KV-cache decode), and a flax
+``cache`` collection holds the static-shape decode state.
+
+OPT quirks kept for checkpoint parity: positions are looked up at
+``position + 2`` (HF ``OPTLearnedPositionalEmbedding`` offset), q/k/v/out
+projections carry biases, 350m-style checkpoints project embeddings
+through ``project_in``/``project_out`` when ``word_embed_proj_dim`` differs
+from ``hidden_size``, and ``do_layer_norm_before`` selects pre- vs post-LN
+blocks.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.common import config_from, dense_init as _init
+from deepspeed_tpu.ops.transformer.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class OPTConfig:
+    vocab_size: int = 50272
+    hidden_size: int = 768
+    ffn_dim: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 2048
+    word_embed_proj_dim: Optional[int] = None  # != hidden_size → project_in/out
+    do_layer_norm_before: bool = True
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    attention_backend: str = "xla"
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def embed_dim(self):
+        return self.word_embed_proj_dim or self.hidden_size
+
+    @property
+    def has_embed_proj(self) -> bool:
+        """project_in/out exist only when the embedding width differs from
+        the hidden width (HF sets word_embed_proj_dim == hidden_size for all
+        non-350m checkpoints — that means NO projection layers)."""
+        return self.word_embed_proj_dim not in (None, self.hidden_size)
+
+
+OPT_CONFIGS = {
+    "test": dict(vocab_size=256, hidden_size=64, ffn_dim=128, num_hidden_layers=2,
+                 num_attention_heads=4, max_position_embeddings=128),
+    "125m": dict(hidden_size=768, ffn_dim=3072, num_hidden_layers=12, num_attention_heads=12),
+    "350m": dict(hidden_size=1024, ffn_dim=4096, num_hidden_layers=24, num_attention_heads=16,
+                 word_embed_proj_dim=512, do_layer_norm_before=False),
+    "1.3b": dict(hidden_size=2048, ffn_dim=8192, num_hidden_layers=24, num_attention_heads=32),
+    "6.7b": dict(hidden_size=4096, ffn_dim=16384, num_hidden_layers=32, num_attention_heads=32),
+}
+
+POSITION_OFFSET = 2  # HF OPTLearnedPositionalEmbedding.offset
+
+
+def get_opt_config(name: str, **overrides) -> OPTConfig:
+    return config_from(OPT_CONFIGS, OPTConfig, name, **overrides)
+
+
+class OPTAttention(nn.Module):
+    config: OPTConfig
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+
+        def proj(name):
+            return nn.DenseGeneral(features=(cfg.num_attention_heads, cfg.head_dim), axis=-1,
+                                   dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                                   kernel_init=nn.with_logical_partitioning(_init(), ("embed", "heads", "kv")),
+                                   bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("heads", "kv")),
+                                   name=name)
+
+        q = proj("q_proj")(x)
+        k = proj("k_proj")(x)
+        v = proj("v_proj")(x)
+        causal, decode_lengths = True, None
+        if self.decode:
+            b, l = x.shape[0], x.shape[1]
+            shape = (b, cfg.max_position_embeddings, cfg.num_attention_heads, cfg.head_dim)
+            cached_k = self.variable("cache", "cached_key", jnp.zeros, shape, k.dtype)
+            cached_v = self.variable("cache", "cached_value", jnp.zeros, shape, v.dtype)
+            cache_index = self.variable("cache", "cache_index", lambda: jnp.zeros([], jnp.int32))
+            idx = cache_index.value
+            cached_k.value = jax.lax.dynamic_update_slice(cached_k.value, k, (0, idx, 0, 0))
+            cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, v, (0, idx, 0, 0))
+            cache_index.value = idx + l
+            k, v = cached_k.value, cached_v.value
+            decode_lengths = jnp.broadcast_to(idx + l, (b,))
+            causal = False
+        out = dot_product_attention(q, k, v, backend=cfg.attention_backend,
+                                    causal=causal, decode_lengths=decode_lengths)
+        return nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1),
+                               dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                               kernel_init=nn.with_logical_partitioning(_init(), ("heads", "kv", "embed")),
+                               bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
+                               name="out_proj")(out)
+
+
+class OPTBlock(nn.Module):
+    config: OPTConfig
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                                       param_dtype=cfg.param_dtype, name=name)
+        h = x
+        if cfg.do_layer_norm_before:
+            h = ln("self_attn_layer_norm")(h)
+        h = OPTAttention(cfg, self.decode, name="self_attn")(h)
+        x = x + h
+        if not cfg.do_layer_norm_before:
+            x = ln("self_attn_layer_norm")(x)
+
+        h = x
+        if cfg.do_layer_norm_before:
+            h = ln("final_layer_norm")(h)
+        h = nn.Dense(features=cfg.ffn_dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     kernel_init=nn.with_logical_partitioning(_init(), ("embed", "mlp")),
+                     bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("mlp",)),
+                     name="fc1")(h)
+        h = jax.nn.relu(h)
+        h = nn.Dense(features=cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     kernel_init=nn.with_logical_partitioning(_init(), ("mlp", "embed")),
+                     bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
+                     name="fc2")(h)
+        x = x + h
+        if not cfg.do_layer_norm_before:
+            x = ln("final_layer_norm")(x)
+        return x
+
+
+class OPTForCausalLM(nn.Module):
+    """OPT with tied-embedding LM head. Returns logits [B, L, V]."""
+
+    config: OPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids, *, deterministic: bool = True, decode: bool = False):
+        cfg = self.config
+        embed_tokens = self.param(
+            "embed_tokens", nn.with_logical_partitioning(_init(), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.embed_dim), cfg.param_dtype)
+        embed_positions = self.param(
+            "embed_positions", nn.with_logical_partitioning(_init(0.01), (None, "embed")),
+            (cfg.max_position_embeddings + POSITION_OFFSET, cfg.hidden_size), cfg.param_dtype)
+        wte = embed_tokens.value if isinstance(embed_tokens, nn.meta.AxisMetadata) else embed_tokens
+        wpe = embed_positions.value if isinstance(embed_positions, nn.meta.AxisMetadata) else embed_positions
+
+        b, l = input_ids.shape
+        x = jnp.take(wte, input_ids, axis=0).astype(cfg.dtype)
+        if cfg.has_embed_proj:
+            x = nn.Dense(features=cfg.hidden_size, use_bias=False, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype,
+                         kernel_init=nn.with_logical_partitioning(_init(), ("embed", "mlp")),
+                         name="project_in")(x)
+        if decode:
+            pos_idx = self.variable("cache", "position_index", lambda: jnp.zeros([], jnp.int32))
+            positions = pos_idx.value + jnp.arange(l)
+            pos_idx.value = pos_idx.value + l
+            x = x + jnp.take(wpe, positions + POSITION_OFFSET, axis=0).astype(cfg.dtype)[None]
+        else:
+            x = x + wpe[POSITION_OFFSET:POSITION_OFFSET + l].astype(cfg.dtype)
+
+        block_cls = OPTBlock
+        if cfg.remat:
+            block_cls = nn.remat(OPTBlock, prevent_cse=False)
+        for i in range(cfg.num_hidden_layers):
+            x = block_cls(cfg, decode, name=f"layers_{i}")(x)
+        if cfg.do_layer_norm_before:
+            x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                             param_dtype=cfg.param_dtype, name="final_layer_norm")(x)
+        if cfg.has_embed_proj:
+            x = nn.Dense(features=cfg.embed_dim, use_bias=False, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype,
+                         kernel_init=nn.with_logical_partitioning(_init(), ("mlp", "embed")),
+                         name="project_out")(x)
+        return jnp.einsum("ble,ve->blv", x, wte.astype(cfg.dtype),
+                          preferred_element_type=cfg.dtype)

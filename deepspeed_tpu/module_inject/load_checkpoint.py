@@ -110,9 +110,63 @@ def load_hf_llama(model_or_sd, cfg) -> dict:
     return params
 
 
+def load_hf_opt(model_or_sd, cfg) -> dict:
+    """HF ``OPTForCausalLM`` → ``models.opt.OPTForCausalLM`` params
+    (reference ``module_inject/containers/opt.py`` slices the same tensors
+    into its injected module).
+
+    HF Linear weights are [out, in] → flax [in, out]; q/k/v reshape to
+    [E, heads, D] and out_proj to [heads, D, E]; LayerNorm weight→scale.
+    """
+    sd = _sd(model_or_sd)
+    pre = "model.decoder." if any(k.startswith("model.decoder.") for k in sd) else "decoder."
+    if not any(k.startswith(pre) for k in sd):
+        pre = ""
+    E, H, D = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
+
+    def lin(name):
+        return {"kernel": jnp.asarray(sd[name + ".weight"].T),
+                "bias": jnp.asarray(sd[name + ".bias"])}
+
+    def ln(name):
+        return {"scale": jnp.asarray(sd[name + ".weight"]),
+                "bias": jnp.asarray(sd[name + ".bias"])}
+
+    params = {
+        "embed_tokens": jnp.asarray(sd[f"{pre}embed_tokens.weight"]),
+        "embed_positions": jnp.asarray(sd[f"{pre}embed_positions.weight"]),
+    }
+    if cfg.do_layer_norm_before and f"{pre}final_layer_norm.weight" in sd:
+        params["final_layer_norm"] = ln(f"{pre}final_layer_norm")
+    if cfg.has_embed_proj:
+        params["project_in"] = {"kernel": jnp.asarray(sd[f"{pre}project_in.weight"].T)}
+        params["project_out"] = {"kernel": jnp.asarray(sd[f"{pre}project_out.weight"].T)}
+    for i in range(cfg.num_hidden_layers):
+        p = f"{pre}layers.{i}."
+
+        def heads_in(name):  # [H*D, E] -> [E, H, D]
+            return {"kernel": jnp.asarray(sd[name + ".weight"].T.reshape(E, H, D)),
+                    "bias": jnp.asarray(sd[name + ".bias"].reshape(H, D))}
+
+        params[f"layers_{i}"] = {
+            "self_attn_layer_norm": ln(p + "self_attn_layer_norm"),
+            "final_layer_norm": ln(p + "final_layer_norm"),
+            "self_attn": {
+                "q_proj": heads_in(p + "self_attn.q_proj"),
+                "k_proj": heads_in(p + "self_attn.k_proj"),
+                "v_proj": heads_in(p + "self_attn.v_proj"),
+                "out_proj": {"kernel": jnp.asarray(sd[p + "self_attn.out_proj.weight"].T.reshape(H, D, E)),
+                             "bias": jnp.asarray(sd[p + "self_attn.out_proj.bias"])},
+            },
+            "fc1": lin(p + "fc1"),
+            "fc2": lin(p + "fc2"),
+        }
+    return params
+
+
 def load_hf_checkpoint(hf_model, arch: str, cfg) -> dict:
     """Dispatch by architecture (reference per-arch policy containers)."""
-    loaders = {"gpt2": load_hf_gpt2, "llama": load_hf_llama}
+    loaders = {"gpt2": load_hf_gpt2, "llama": load_hf_llama, "opt": load_hf_opt}
     if arch not in loaders:
         raise ValueError(f"no HF converter for architecture {arch!r}; available: {sorted(loaders)}")
     return loaders[arch](hf_model, cfg)

@@ -16,6 +16,17 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+# fresh randomness when the caller omits rng — the reference draws from
+# torch's global RNG, so the functional analog keeps a module-level key and
+# splits it per call (pass rng explicitly for reproducible pipelines)
+_global_key = jax.random.PRNGKey(0)
+
+
+def _next_key() -> jax.Array:
+    global _global_key
+    _global_key, sub = jax.random.split(_global_key)
+    return sub
+
 
 def token_sort_(indices: jax.Array, seq_length: int = 0) -> jax.Array:
     """Ascending per-row sort (reference CUDA ``token_sort_``,
@@ -44,7 +55,7 @@ def gpt_sample_tokens(reserved_length: int,
                       ) -> Tuple[jax.Array, Optional[jax.Array]]:
     """Reference ``dropping_utils.py:18``. The causal mask truncates to the
     reserved square ([B, 1, r, r])."""
-    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    rng = rng if rng is not None else _next_key()
     sampled = _sample(rng, layers, batch_size, seq_length, reserved_length)
     new_mask = None
     if attn_mask is not None:
@@ -63,7 +74,7 @@ def bert_sample_tokens(reserved_length: int,
     per layer at the sampled positions ([layers, B, 1, r, r])."""
     if attn_mask is None:
         raise ValueError("bert_sample_tokens requires attn_mask")
-    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    rng = rng if rng is not None else _next_key()
     sampled = _sample(rng, layers, batch_size, seq_length, reserved_length)
 
     def layer_mask(idx_lb):  # [B, r] for one layer

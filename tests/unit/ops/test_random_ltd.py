@@ -65,3 +65,11 @@ def test_gather_is_differentiable():
     assert (g[0, rows] == 1.0).all()
     dead = np.setdiff1d(np.arange(l), rows)
     assert (g[0, dead] == 0.0).all()
+
+
+def test_default_rng_varies_across_calls():
+    """Omitting rng must draw fresh randomness per call (reference uses the
+    global torch RNG) — a fixed default would drop the same tokens forever."""
+    a, _ = gpt_sample_tokens(8, 64, batch_size=2)
+    b, _ = gpt_sample_tokens(8, 64, batch_size=2)
+    assert not np.array_equal(np.asarray(a), np.asarray(b))

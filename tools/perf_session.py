@@ -1,0 +1,85 @@
+"""One clean-exit TPU perf session: measures the engine step per-dispatch
+vs fused-scan, prints each result immediately, exits cleanly (never kill
+this while running — a killed TPU process wedges the axon tunnel claim).
+
+Run: timeout 1500 python tools/perf_session.py
+Budget: ~3 compiles (~2-4 min each cold) + ~12 timed dispatches.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+
+MODEL = os.environ.get("BENCH_MODEL", "350m")
+MB = int(os.environ.get("BENCH_MICRO_BS", "4"))
+SEQ = int(os.environ.get("BENCH_SEQ", "1024"))
+FUSED = int(os.environ.get("BENCH_FUSED_STEPS", "10"))
+
+
+def report(tag, steps, dt, n_params):
+    tok = MB * SEQ * steps / dt
+    tflops = 6.0 * n_params * tok / 1e12
+    print(json.dumps({"tag": tag, "step_ms": round(dt / steps * 1e3, 1),
+                      "tokens_per_s": round(tok, 1),
+                      "tflops": round(tflops, 2)}), flush=True)
+
+
+def main():
+    cfg = get_gpt2_config(MODEL, n_positions=SEQ, remat=True,
+                          attention_backend="flash", dtype=jnp.bfloat16)
+    model = GPT2LMHeadModel(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_batch_size": MB,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        "zero_optimization": {"stage": 0},
+        "steps_per_print": 10**9,
+    })
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (MB, SEQ)).astype(np.int32)}
+    engine.initialize_state(batch)
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(engine.state.params))
+    print(f"# {MODEL} params={n_params/1e6:.1f}M mb={MB} seq={SEQ}", flush=True)
+
+    # 1) per-dispatch loop (bench.py default path)
+    for _ in range(2):
+        engine.train_batch(batch)
+    jax.block_until_ready(engine.state.params)
+    t0 = time.time()
+    for _ in range(10):
+        engine.train_batch(batch)
+    jax.block_until_ready(engine.state.params)
+    report("per_dispatch", 10, time.time() - t0, n_params)
+
+    # 2) fused scan: FUSED steps per dispatch
+    stack = {"input_ids": np.broadcast_to(batch["input_ids"],
+                                          (FUSED,) + batch["input_ids"].shape)}
+    engine.train_batches(stack)
+    jax.block_until_ready(engine.state.params)
+    t0 = time.time()
+    engine.train_batches(stack)
+    jax.block_until_ready(engine.state.params)
+    report(f"fused_{FUSED}", FUSED, time.time() - t0, n_params)
+
+    # run the fused dispatch twice more for variance
+    t0 = time.time()
+    engine.train_batches(stack)
+    engine.train_batches(stack)
+    jax.block_until_ready(engine.state.params)
+    report(f"fused_{FUSED}_x2", 2 * FUSED, time.time() - t0, n_params)
+
+    print("# DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()
