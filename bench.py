@@ -85,13 +85,20 @@ def run_child():
 
     # >1: run that many optimizer steps per device dispatch (lax.scan inside
     # one jit call) — amortizes host→device dispatch latency, the idiomatic
-    # TPU training-loop shape
-    fused = int(os.environ.get("BENCH_FUSED_STEPS", "1"))
+    # TPU training-loop shape. Falls back to the per-dispatch loop if the
+    # scanned program fails to build (keeps the driver's bench robust).
+    fused = int(os.environ.get("BENCH_FUSED_STEPS", "10"))
     if fused > 1:
-        stack = {"input_ids": np.broadcast_to(batch["input_ids"],
-                                              (fused,) + batch["input_ids"].shape)}
-        engine.train_batches(stack)  # warmup/compile
-        jax.block_until_ready(engine.state.params)
+        try:
+            stack = {"input_ids": np.broadcast_to(batch["input_ids"],
+                                                  (fused,) + batch["input_ids"].shape)}
+            engine.train_batches(stack)  # warmup/compile
+            jax.block_until_ready(engine.state.params)
+        except Exception as e:  # noqa: BLE001 — any build failure → fallback
+            print(f"# fused-step path failed ({type(e).__name__}: {e}); "
+                  f"falling back to per-dispatch", flush=True)
+            fused = 1
+    if fused > 1:
         outer = max(1, steps // fused)
         t0 = time.time()
         for _ in range(outer):
