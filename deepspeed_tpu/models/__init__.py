@@ -4,3 +4,5 @@ from deepspeed_tpu.models.llama import (LlamaConfig, LlamaForCausalLM, LLAMA_CON
 from deepspeed_tpu.models.bert import (BertConfig, BertModel, BertForMaskedLM, BERT_CONFIGS,
                                        get_bert_config, bert_mlm_loss)
 from deepspeed_tpu.models.opt import (OPTConfig, OPTForCausalLM, OPT_CONFIGS, get_opt_config)
+from deepspeed_tpu.models.gpt_neox import (GPTNeoXConfig, GPTNeoXForCausalLM, GPT_NEOX_CONFIGS,
+                                            get_gpt_neox_config)

@@ -1,0 +1,176 @@
+"""GPT-NeoX family (Pythia/20B) — partial-rotary attention with parallel
+residual (the reference serves NeoX through kernel injection,
+``module_inject/containers/gptneox.py``).
+
+Same TPU conventions as the rest of the zoo (logical axis names → ZeRO
+planner, pluggable attention backend with ``decode_lengths`` decode, flax
+``cache`` collection). NeoX quirks kept for checkpoint parity: rotary on
+only the first ``rotary_pct`` of each head dim, parallel residual
+(``x + attn(ln1(x)) + mlp(ln2(x))``), untied ``embed_out`` LM head, and
+biased projections throughout.
+"""
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.common import config_from, dense_init as _init
+from deepspeed_tpu.models.llama import rotary_embedding
+from deepspeed_tpu.ops.transformer.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTNeoXConfig:
+    vocab_size: int = 50432
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 2048
+    rotary_pct: float = 0.25
+    rotary_emb_base: float = 10000.0
+    use_parallel_residual: bool = True
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    attention_backend: str = "xla"
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def rotary_ndims(self):
+        return int(self.head_dim * self.rotary_pct)
+
+
+GPT_NEOX_CONFIGS = {
+    "test": dict(vocab_size=256, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                 num_attention_heads=4, max_position_embeddings=128),
+    "pythia-160m": dict(vocab_size=50304, hidden_size=768, intermediate_size=3072,
+                        num_hidden_layers=12, num_attention_heads=12),
+    "pythia-1.4b": dict(vocab_size=50304, hidden_size=2048, intermediate_size=8192,
+                        num_hidden_layers=24, num_attention_heads=16),
+    "pythia-6.9b": dict(hidden_size=4096, intermediate_size=16384, num_hidden_layers=32,
+                        num_attention_heads=32),
+    "20b": dict(vocab_size=50432, hidden_size=6144, intermediate_size=24576,
+                num_hidden_layers=44, num_attention_heads=64),
+}
+
+
+def get_gpt_neox_config(name: str, **overrides) -> GPTNeoXConfig:
+    return config_from(GPT_NEOX_CONFIGS, GPTNeoXConfig, name, **overrides)
+
+
+def _partial_rotary(x, positions, rotary_ndims: int, base: float):
+    """RoPE on the first ``rotary_ndims`` of the head dim, rest passes
+    through (NeoX convention)."""
+    rot, rest = x[..., :rotary_ndims], x[..., rotary_ndims:]
+    rot = rotary_embedding(rot, positions, base)
+    return jnp.concatenate([rot, rest], axis=-1)
+
+
+class GPTNeoXAttention(nn.Module):
+    config: GPTNeoXConfig
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        b, l, _ = x.shape
+        # fused qkv in NeoX's per-head-interleaved layout: [E] -> [H, 3, D]
+        qkv = nn.DenseGeneral(features=(cfg.num_attention_heads, 3, cfg.head_dim), axis=-1,
+                              dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                              kernel_init=nn.with_logical_partitioning(
+                                  _init(), ("embed", "heads", None, "kv")),
+                              bias_init=nn.with_logical_partitioning(
+                                  nn.initializers.zeros, ("heads", None, "kv")),
+                              name="query_key_value")(x)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]  # [B, L, H, D]
+        causal, decode_lengths = True, None
+        if self.decode:
+            cache_index = self.variable("cache", "cache_index", lambda: jnp.zeros([], jnp.int32))
+            idx = cache_index.value
+            positions = idx + jnp.broadcast_to(jnp.arange(l)[None, :], (b, l))
+            q = _partial_rotary(q, positions, cfg.rotary_ndims, cfg.rotary_emb_base)
+            k = _partial_rotary(k, positions, cfg.rotary_ndims, cfg.rotary_emb_base)
+            shape = (b, cfg.max_position_embeddings, cfg.num_attention_heads, cfg.head_dim)
+            cached_k = self.variable("cache", "cached_key", jnp.zeros, shape, k.dtype)
+            cached_v = self.variable("cache", "cached_value", jnp.zeros, shape, v.dtype)
+            cached_k.value = jax.lax.dynamic_update_slice(cached_k.value, k, (0, idx, 0, 0))
+            cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, v, (0, idx, 0, 0))
+            cache_index.value = idx + l
+            k, v = cached_k.value, cached_v.value
+            decode_lengths = jnp.broadcast_to(idx + l, (b,))
+            causal = False
+        else:
+            positions = jnp.broadcast_to(jnp.arange(l)[None, :], (b, l))
+            q = _partial_rotary(q, positions, cfg.rotary_ndims, cfg.rotary_emb_base)
+            k = _partial_rotary(k, positions, cfg.rotary_ndims, cfg.rotary_emb_base)
+        out = dot_product_attention(q, k, v, backend=cfg.attention_backend,
+                                    causal=causal, decode_lengths=decode_lengths)
+        return nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1),
+                               dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                               kernel_init=nn.with_logical_partitioning(_init(), ("heads", "kv", "embed")),
+                               bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
+                               name="dense")(out)
+
+
+class GPTNeoXBlock(nn.Module):
+    config: GPTNeoXConfig
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                                       param_dtype=cfg.param_dtype, name=name)
+
+        def mlp(h):
+            h = nn.Dense(features=cfg.intermediate_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         kernel_init=nn.with_logical_partitioning(_init(), ("embed", "mlp")),
+                         bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("mlp",)),
+                         name="dense_h_to_4h")(h)
+            h = jax.nn.gelu(h, approximate=False)  # HF NeoX uses exact (erf) gelu
+            return nn.Dense(features=cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                            kernel_init=nn.with_logical_partitioning(_init(), ("mlp", "embed")),
+                            bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
+                            name="dense_4h_to_h")(h)
+
+        attn_out = GPTNeoXAttention(cfg, self.decode, name="attention")(
+            ln("input_layernorm")(x))
+        if cfg.use_parallel_residual:
+            # x + attn(ln1(x)) + mlp(ln2(x)) — one residual stream
+            mlp_out = mlp(ln("post_attention_layernorm")(x))
+            return x + attn_out + mlp_out
+        x = x + attn_out
+        return x + mlp(ln("post_attention_layernorm")(x))
+
+
+class GPTNeoXForCausalLM(nn.Module):
+    """GPT-NeoX with UNTIED ``embed_out`` head. Returns logits [B, L, V]."""
+
+    config: GPTNeoXConfig
+
+    @nn.compact
+    def __call__(self, input_ids, *, deterministic: bool = True, decode: bool = False):
+        cfg = self.config
+        embed_in = self.param("embed_in", nn.with_logical_partitioning(_init(), ("vocab", "embed")),
+                              (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+        wte = embed_in.value if isinstance(embed_in, nn.meta.AxisMetadata) else embed_in
+        x = jnp.take(wte, input_ids, axis=0).astype(cfg.dtype)
+        block_cls = GPTNeoXBlock
+        if cfg.remat:
+            block_cls = nn.remat(GPTNeoXBlock, prevent_cse=False)
+        for i in range(cfg.num_hidden_layers):
+            x = block_cls(cfg, decode, name=f"layers_{i}")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="final_layer_norm")(x)
+        return nn.Dense(features=cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype,
+                        kernel_init=nn.with_logical_partitioning(_init(), ("embed", "vocab")),
+                        name="embed_out")(x)

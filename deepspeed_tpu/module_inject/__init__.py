@@ -3,9 +3,10 @@
 
 from deepspeed_tpu.module_inject.auto_tp import AutoTP
 from deepspeed_tpu.module_inject.load_checkpoint import (load_hf_checkpoint, load_hf_gpt2,
-                                                         load_hf_llama, load_hf_opt)
+                                                         load_hf_llama, load_hf_opt,
+                                                         load_hf_gpt_neox)
 from deepspeed_tpu.module_inject.replace_module import (generic_injection, replace_transformer_layer,
                                                         tp_shard_params)
 
-__all__ = ["AutoTP", "load_hf_checkpoint", "load_hf_gpt2", "load_hf_llama", "load_hf_opt", "generic_injection",
+__all__ = ["AutoTP", "load_hf_checkpoint", "load_hf_gpt2", "load_hf_llama", "load_hf_opt", "load_hf_gpt_neox", "generic_injection",
            "replace_transformer_layer", "tp_shard_params"]

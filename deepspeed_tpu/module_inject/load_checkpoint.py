@@ -164,9 +164,57 @@ def load_hf_opt(model_or_sd, cfg) -> dict:
     return params
 
 
+def load_hf_gpt_neox(model_or_sd, cfg) -> dict:
+    """HF ``GPTNeoXForCausalLM`` → ``models.gpt_neox.GPTNeoXForCausalLM``
+    params (reference ``module_inject/containers/gptneox.py``).
+
+    The fused qkv is per-head interleaved: torch [3E, E] transposes to
+    [E, 3E] and reshapes to [E, H, 3, D] (matching our DenseGeneral); HF
+    NeoX rotary is the half-split (rotate_half) convention our
+    ``rotary_embedding`` implements.
+    """
+    sd = _sd(model_or_sd)
+    pre = "gpt_neox." if any(k.startswith("gpt_neox.") for k in sd) else ""
+    E, H, D = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
+
+    def lin(name):
+        return {"kernel": jnp.asarray(sd[name + ".weight"].T),
+                "bias": jnp.asarray(sd[name + ".bias"])}
+
+    def ln(name):
+        return {"scale": jnp.asarray(sd[name + ".weight"]),
+                "bias": jnp.asarray(sd[name + ".bias"])}
+
+    params = {
+        "embed_in": jnp.asarray(sd[f"{pre}embed_in.weight"]),
+        "final_layer_norm": ln(f"{pre}final_layer_norm"),
+        "embed_out": {"kernel": jnp.asarray(sd["embed_out.weight"].T)},
+    }
+    for i in range(cfg.num_hidden_layers):
+        p = f"{pre}layers.{i}."
+        params[f"layers_{i}"] = {
+            "input_layernorm": ln(p + "input_layernorm"),
+            "post_attention_layernorm": ln(p + "post_attention_layernorm"),
+            "attention": {
+                "query_key_value": {
+                    "kernel": jnp.asarray(sd[p + "attention.query_key_value.weight"].T
+                                          .reshape(E, H, 3, D)),
+                    "bias": jnp.asarray(sd[p + "attention.query_key_value.bias"]
+                                        .reshape(H, 3, D)),
+                },
+                "dense": {"kernel": jnp.asarray(sd[p + "attention.dense.weight"].T.reshape(H, D, E)),
+                          "bias": jnp.asarray(sd[p + "attention.dense.bias"])},
+            },
+            "dense_h_to_4h": lin(p + "mlp.dense_h_to_4h"),
+            "dense_4h_to_h": lin(p + "mlp.dense_4h_to_h"),
+        }
+    return params
+
+
 def load_hf_checkpoint(hf_model, arch: str, cfg) -> dict:
     """Dispatch by architecture (reference per-arch policy containers)."""
-    loaders = {"gpt2": load_hf_gpt2, "llama": load_hf_llama, "opt": load_hf_opt}
+    loaders = {"gpt2": load_hf_gpt2, "llama": load_hf_llama, "opt": load_hf_opt,
+               "gpt_neox": load_hf_gpt_neox, "gptneox": load_hf_gpt_neox}
     if arch not in loaders:
         raise ValueError(f"no HF converter for architecture {arch!r}; available: {sorted(loaders)}")
     return loaders[arch](hf_model, cfg)

@@ -1,0 +1,4 @@
+from deepspeed_tpu.ops.transformer.attention import (available_backends, dot_product_attention,
+                                                     register_backend, xla_attention)
+from deepspeed_tpu.ops.transformer.transformer import (DeepSpeedTransformerConfig,
+                                                       DeepSpeedTransformerLayer)

@@ -1,0 +1,156 @@
+"""DeepSpeedTransformerLayer — the fused training encoder layer surface.
+
+Reference ``deepspeed/ops/transformer/transformer.py``:
+``DeepSpeedTransformerConfig:34`` (batch/hidden/intermediate/heads,
+dropout ratios, ``pre_layer_norm``, init-range adjustment ``:73``) and
+``DeepSpeedTransformerLayer:311`` binding to the fused CUDA encoder
+kernels (``csrc/transformer/ds_transformer_cuda.cpp``). On TPU the fusion
+IS the compiler: one flax module expresses the whole layer (QKV matmul →
+attention via the pluggable backend → residual/LN → GELU MLP), and XLA
+fuses bias/dropout/LN into the matmuls the way the hand-written kernels
+do. The memory knobs (``normalize_invertible``, ``gelu_checkpoint``,
+``attn_dropout_checkpoint``) collapse into one ``jax.checkpoint`` switch;
+``stochastic_mode`` has no analog (XLA is deterministic by default).
+
+Layout matches BERT-style encoders: post-LN by default,
+``pre_layer_norm=True`` for the pre-LN variant the reference trains BERT
+with.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.common import dense_init, normalize_padding_mask
+from deepspeed_tpu.ops.transformer.attention import dot_product_attention
+
+
+@dataclasses.dataclass
+class DeepSpeedTransformerConfig:
+    """Reference ``transformer.py:34`` — same knob names; TPU-meaningless
+    CUDA plumbing (local_rank, test_gemm, stochastic_mode) accepted and
+    ignored so configs port unchanged."""
+
+    batch_size: int = -1
+    hidden_size: int = -1
+    intermediate_size: int = -1
+    heads: int = -1
+    attn_dropout_ratio: float = -1
+    hidden_dropout_ratio: float = -1
+    num_hidden_layers: int = -1
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    local_rank: int = -1
+    seed: int = -1
+    fp16: bool = False
+    pre_layer_norm: bool = True
+    normalize_invertible: bool = False
+    gelu_checkpoint: bool = False
+    adjust_init_range: bool = True
+    attn_dropout_checkpoint: bool = False
+    stochastic_mode: bool = False
+    return_tuple: bool = False
+    training: bool = True
+    attention_backend: str = "xla"
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    layer_id: int = -1
+
+    def __post_init__(self):
+        if self.intermediate_size < 0 and self.hidden_size > 0:
+            self.intermediate_size = 4 * self.hidden_size
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.heads
+
+    @property
+    def remat(self) -> bool:
+        # the reference's three per-piece recompute switches all trade
+        # activation memory for FLOPs; jax.checkpoint does that wholesale
+        return self.normalize_invertible or self.gelu_checkpoint or self.attn_dropout_checkpoint
+
+
+class _LayerCore(nn.Module):
+    config: DeepSpeedTransformerConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask, deterministic: bool = True):
+        cfg = self.config
+        init_scale = cfg.initializer_range
+        out_scale = init_scale
+        if cfg.adjust_init_range and cfg.num_hidden_layers > 0:
+            # reference transformer.py:73: output projections scaled down by
+            # sqrt(2 * num_layers)
+            out_scale = init_scale / (2.0 * cfg.num_hidden_layers) ** 0.5
+
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                                       param_dtype=cfg.param_dtype, name=name)
+
+        def attn_block(h):
+            qkv = nn.DenseGeneral(features=(3, cfg.heads, cfg.head_dim), axis=-1,
+                                  dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                                  kernel_init=nn.with_logical_partitioning(
+                                      dense_init(init_scale), ("embed", None, "heads", "kv")),
+                                  name="attn_qkv")(h)
+            q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+            drop_rng = None
+            if not deterministic and cfg.attn_dropout_ratio > 0.0:
+                drop_rng = self.make_rng("dropout")
+            a = dot_product_attention(q, k, v, backend=cfg.attention_backend,
+                                      causal=False, mask=attention_mask,
+                                      dropout_rate=0.0 if deterministic else max(cfg.attn_dropout_ratio, 0.0),
+                                      dropout_rng=drop_rng)
+            a = nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1),
+                                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                                kernel_init=nn.with_logical_partitioning(
+                                    dense_init(out_scale), ("heads", "kv", "embed")),
+                                name="attn_out")(a)
+            if not deterministic and cfg.hidden_dropout_ratio > 0.0:
+                a = nn.Dropout(rate=cfg.hidden_dropout_ratio)(a, deterministic=False)
+            return a
+
+        def mlp_block(h):
+            m = nn.Dense(features=cfg.intermediate_size, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype,
+                         kernel_init=nn.with_logical_partitioning(
+                             dense_init(init_scale), ("embed", "mlp")),
+                         name="inter")(h)
+            m = jax.nn.gelu(m, approximate=False)
+            m = nn.Dense(features=cfg.hidden_size, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype,
+                         kernel_init=nn.with_logical_partitioning(
+                             dense_init(out_scale), ("mlp", "embed")),
+                         name="output")(m)
+            if not deterministic and cfg.hidden_dropout_ratio > 0.0:
+                m = nn.Dropout(rate=cfg.hidden_dropout_ratio)(m, deterministic=False)
+            return m
+
+        if cfg.pre_layer_norm:
+            x = x + attn_block(ln("attn_norm")(x))
+            x = x + mlp_block(ln("norm")(x))
+        else:
+            x = ln("attn_norm")(x + attn_block(x))
+            x = ln("norm")(x + mlp_block(x))
+        return x
+
+
+class DeepSpeedTransformerLayer(nn.Module):
+    """Reference ``transformer.py:311`` call contract:
+    ``layer(hidden_states, attention_mask)`` → hidden states (or 1-tuple
+    when ``config.return_tuple``)."""
+
+    config: DeepSpeedTransformerConfig
+
+    @nn.compact
+    def __call__(self, hidden_states, attention_mask=None, *, deterministic: bool = True):
+        cfg = self.config
+        mask = normalize_padding_mask(attention_mask)
+        core = _LayerCore
+        if cfg.remat:
+            core = nn.remat(_LayerCore, static_argnums=(3,), prevent_cse=False)
+        out = core(cfg, name="layer")(hidden_states, mask, deterministic)
+        return (out,) if cfg.return_tuple else out

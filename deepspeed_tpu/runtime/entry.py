@@ -42,6 +42,13 @@ def initialize(args=None,
         config = config_params
     if config is None and args is not None and getattr(args, "deepspeed_config", None) is not None:
         config = args.deepspeed_config
+    if config is None:
+        # reference Init(config_dict_or_path=...) semantics: an enclosing
+        # zero.Init context can carry the engine config
+        from deepspeed_tpu.runtime.zero.partition_parameters import get_active_init
+        active = get_active_init()
+        if active is not None and active.config is not None:
+            config = active.config
     assert config is not None, "DeepSpeed requires --deepspeed_config or the config= argument"
 
     if dist_init_required is None or dist_init_required:

@@ -1,0 +1,121 @@
+"""``zero.Init`` / ``GatheredParameters`` — the user-facing construction
+API of reference ``runtime/zero/partition_parameters.py`` (``Init``
+context patching module construction at ``:289``, ``AllGatherCoalescedHandle
+:552``, ``register_external_parameter:123``).
+
+TPU mapping (why these are thin): the reference must intercept
+``nn.Module.__init__`` because torch materializes every parameter eagerly
+on one device. Flax modules are pure descriptions — nothing materializes
+until ``engine.initialize_state``, which already builds each parameter
+DIRECTLY INTO its ZeRO shard layout via ``jit(init, out_shardings=...)``
+(``engine.py`` ``initialize_state``). So ``Init`` doesn't need to patch
+anything; it carries the construction-time knobs (dtype, meta device) and
+offers ``materialize``/``abstract`` helpers, and ``GatheredParameters``
+exposes the full values of sharded params (jax assembles shards on read).
+"""
+
+import contextlib
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+_ACTIVE_INIT: Optional["Init"] = None
+
+
+def get_active_init() -> Optional["Init"]:
+    """The innermost active ``zero.Init`` context.
+    ``deepspeed_tpu.initialize`` consults it for a carried engine config
+    (``Init(config_dict_or_path=...)``); the ``dtype``/``remote_device``
+    knobs apply to this context's OWN :meth:`Init.init`/:meth:`Init.abstract`
+    helpers, not to the engine's master/compute dtypes (those come from the
+    ds_config's bf16/fp16 sections)."""
+    return _ACTIVE_INIT
+
+
+class Init:
+    """``with zero.Init(...):`` — construction-context parity.
+
+    Accepted arguments mirror the reference signature; CUDA-only knobs
+    (``pin_memory``, ``remote_device="nvme"`` prefetch plumbing, ``mpu``)
+    are recorded but have no TPU effect. ``remote_device="meta"`` (or
+    ``device="meta"``) makes :meth:`init` return ONLY abstract
+    shapes/dtypes — zero bytes — like the reference's meta-device path.
+    """
+
+    def __init__(self, module=None, data_parallel_group=None, mem_efficient_linear=True,
+                 remote_device: Optional[str] = None, device: Optional[str] = None,
+                 pin_memory: bool = False, config_dict_or_path=None, config=None,
+                 enabled: bool = True, dtype=None, mpu=None):
+        self.enabled = enabled
+        self.dtype = dtype
+        self.remote_device = remote_device or device
+        self.config = config_dict_or_path if config_dict_or_path is not None else config
+        self._prev: Optional[Init] = None
+        if module is not None:
+            logger.warning("zero.Init(module=...) eager partitioning is a no-op on TPU: "
+                           "flax modules hold no tensors; pass the module to "
+                           "deepspeed_tpu.initialize as usual")
+
+    def __enter__(self):
+        global _ACTIVE_INIT
+        if self.enabled:
+            self._prev = _ACTIVE_INIT
+            _ACTIVE_INIT = self
+        return self
+
+    def __exit__(self, *exc):
+        global _ACTIVE_INIT
+        if self.enabled:
+            _ACTIVE_INIT = self._prev
+        return False
+
+    # -- construction helpers ------------------------------------------
+    def abstract(self, module, rng, *args, **kwargs):
+        """Abstract (shape/dtype only) variable tree — the meta-device
+        result, via ``jax.eval_shape`` (no FLOPs, no bytes)."""
+        return jax.eval_shape(lambda: module.init(rng, *args, **kwargs))
+
+    def init(self, module, rng, *args, **kwargs):
+        """Materialize params unless this context is meta-device, in which
+        case return the abstract tree."""
+        if self.remote_device == "meta":
+            return self.abstract(module, rng, *args, **kwargs)
+        out = module.init(rng, *args, **kwargs)
+        if self.dtype is not None:
+            from deepspeed_tpu.runtime.engine import _cast_floating
+            out = {k: (_cast_floating(v, self.dtype) if k == "params" else v)
+                   for k, v in out.items()}
+        return out
+
+
+@contextlib.contextmanager
+def GatheredParameters(params, modifier_rank: Optional[int] = None, fwd_module=None,
+                       enabled: bool = True):
+    """``with zero.GatheredParameters(p):`` — reference ``:1116``-style
+    access to full parameter values from sharded storage.
+
+    jax arrays assemble their shards on host read, so gathering is
+    ``device_get``; yields {path: np.ndarray}-like pytree of FULL values.
+    Writes inside the context do NOT propagate back automatically (use
+    ``utils.tensor_fragment.safe_set_full_fp32_param``) — the reference
+    semantics of in-place mutation don't exist for immutable jax arrays.
+    """
+    if not enabled or params is None:
+        yield params
+        return
+    yield jax.tree.map(lambda p: np.asarray(jax.device_get(p)), params)
+
+
+def register_external_parameter(module, parameter) -> None:
+    """Reference ``partition_parameters.py:123``: tells ZeRO-3's hook
+    machinery a module consumes a parameter it doesn't own, so it gets
+    gathered. XLA sees the whole jitted program and schedules every
+    all-gather itself — nothing to register. Kept for call parity."""
+    return None
+
+
+def unregister_external_parameter(module, parameter) -> None:
+    return None

@@ -1,0 +1,72 @@
+"""zero.Init / GatheredParameters API surface (reference
+runtime/zero/partition_parameters.py:289,1116)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+from deepspeed_tpu.runtime.zero import GatheredParameters, Init, register_external_parameter
+from deepspeed_tpu.runtime.zero.partition_parameters import get_active_init
+
+
+def test_init_context_nesting():
+    assert get_active_init() is None
+    with Init(dtype=jnp.bfloat16) as outer:
+        assert get_active_init() is outer
+        with Init(remote_device="meta") as inner:
+            assert get_active_init() is inner
+        assert get_active_init() is outer
+    assert get_active_init() is None
+    with Init(enabled=False):
+        assert get_active_init() is None
+
+
+def test_init_meta_returns_abstract():
+    cfg = get_gpt2_config("test")
+    model = GPT2LMHeadModel(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    with Init(remote_device="meta") as ctx:
+        tree = ctx.init(model, jax.random.PRNGKey(0), ids)
+    leaves = jax.tree.leaves(tree)
+    assert leaves and all(isinstance(l, jax.ShapeDtypeStruct) for l in leaves)
+
+
+def test_init_dtype_casts_params():
+    cfg = get_gpt2_config("test")
+    model = GPT2LMHeadModel(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    with Init(dtype=jnp.bfloat16) as ctx:
+        out = ctx.init(model, jax.random.PRNGKey(0), ids)
+    kinds = {l.dtype for l in jax.tree.leaves(out["params"])}
+    assert kinds == {jnp.dtype(jnp.bfloat16)}
+
+
+def test_gathered_parameters_yields_full_values():
+    cfg = get_gpt2_config("test")
+    engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2LMHeadModel(cfg), config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 3},
+    })
+    batch = {"input_ids": np.zeros((8, 16), np.int32)}
+    engine.initialize_state(batch)
+    with GatheredParameters(engine.state.params) as full:
+        wte = full["wte"]
+        assert isinstance(wte, np.ndarray) and wte.shape == (256, 64)
+    with GatheredParameters(None) as nothing:
+        assert nothing is None
+    # call-parity no-ops
+    register_external_parameter(None, None)
+
+
+def test_initialize_consumes_init_context_config():
+    """Reference Init(config_dict_or_path=...): an enclosing zero.Init can
+    carry the engine config when initialize() gets none."""
+    cfg = get_gpt2_config("test")
+    ds = {"train_batch_size": 8,
+          "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}}
+    with Init(config_dict_or_path=ds):
+        engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2LMHeadModel(cfg))
+    assert engine.config.train_batch_size == 8
