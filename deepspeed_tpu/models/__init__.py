@@ -6,3 +6,5 @@ from deepspeed_tpu.models.bert import (BertConfig, BertModel, BertForMaskedLM, B
 from deepspeed_tpu.models.opt import (OPTConfig, OPTForCausalLM, OPT_CONFIGS, get_opt_config)
 from deepspeed_tpu.models.gpt_neox import (GPTNeoXConfig, GPTNeoXForCausalLM, GPT_NEOX_CONFIGS,
                                             get_gpt_neox_config)
+from deepspeed_tpu.models.bloom import (BloomConfig, BloomForCausalLM, BLOOM_CONFIGS,
+                                        get_bloom_config)

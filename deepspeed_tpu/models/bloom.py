@@ -1,0 +1,170 @@
+"""BLOOM family — alibi-biased attention, embedding layernorm, fused
+per-head QKV (the reference serves BLOOM through kernel injection,
+``module_inject/containers/bloom.py``; its alibi build lives in the fused
+softmax kernel, ``csrc/transformer/inference/csrc/softmax.cu`` alibi
+variants).
+
+TPU formulation: alibi is an additive attention bias ``slope[h] * k_pos``
+(softmax is shift-invariant per query row, so keying on absolute k
+position equals the relative form and stays valid for KV-cache decode).
+The bias rides the attention seam's ``bias`` argument — the XLA backend
+adds it inside the fp32 softmax; same conventions as the rest of the zoo
+otherwise.
+"""
+
+import dataclasses
+import math
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.common import config_from, dense_init as _init
+from deepspeed_tpu.ops.transformer.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class BloomConfig:
+    vocab_size: int = 250880
+    hidden_size: int = 64
+    n_head: int = 8
+    n_layer: int = 2
+    layer_norm_epsilon: float = 1e-5
+    max_position_embeddings: int = 2048  # cache size only; alibi needs no table
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    attention_backend: str = "xla"
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.n_head
+
+
+BLOOM_CONFIGS = {
+    "test": dict(vocab_size=256, hidden_size=64, n_head=4, n_layer=2,
+                 max_position_embeddings=128),
+    "560m": dict(hidden_size=1024, n_head=16, n_layer=24),
+    "1b7": dict(hidden_size=2048, n_head=16, n_layer=24),
+    "7b1": dict(hidden_size=4096, n_head=32, n_layer=30),
+    "176b": dict(hidden_size=14336, n_head=112, n_layer=70),
+}
+
+
+def get_bloom_config(name: str, **overrides) -> BloomConfig:
+    return config_from(BLOOM_CONFIGS, BloomConfig, name, **overrides)
+
+
+def alibi_slopes(n_head: int) -> jnp.ndarray:
+    """Per-head alibi slopes (the HF/paper construction: powers of
+    2^(-8/n) for the nearest power-of-two head count, interleaved extras
+    for non-power-of-two)."""
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+
+    if math.log2(n_head).is_integer():
+        slopes = pow2_slopes(n_head)
+    else:
+        closest = 2 ** math.floor(math.log2(n_head))
+        slopes = pow2_slopes(closest)
+        extra = pow2_slopes(2 * closest)
+        slopes += extra[0::2][:n_head - closest]
+    return jnp.asarray(slopes, jnp.float32)
+
+
+def alibi_bias(n_head: int, kv_len: int) -> jnp.ndarray:
+    """[1, H, 1, Lk] additive logit bias: slope[h] * k_pos. Broadcasts over
+    batch and query positions; per-row shift-equal to the relative form."""
+    slopes = alibi_slopes(n_head)
+    return (slopes[:, None] * jnp.arange(kv_len, dtype=jnp.float32)[None, :])[None, :, None, :]
+
+
+class BloomAttention(nn.Module):
+    config: BloomConfig
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        b, l, _ = x.shape
+        qkv = nn.DenseGeneral(features=(cfg.n_head, 3, cfg.head_dim), axis=-1,
+                              dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                              kernel_init=nn.with_logical_partitioning(
+                                  _init(), ("embed", "heads", None, "kv")),
+                              bias_init=nn.with_logical_partitioning(
+                                  nn.initializers.zeros, ("heads", None, "kv")),
+                              name="query_key_value")(x)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        causal, decode_lengths = True, None
+        if self.decode:
+            shape = (b, cfg.max_position_embeddings, cfg.n_head, cfg.head_dim)
+            cached_k = self.variable("cache", "cached_key", jnp.zeros, shape, k.dtype)
+            cached_v = self.variable("cache", "cached_value", jnp.zeros, shape, v.dtype)
+            cache_index = self.variable("cache", "cache_index", lambda: jnp.zeros([], jnp.int32))
+            idx = cache_index.value
+            cached_k.value = jax.lax.dynamic_update_slice(cached_k.value, k, (0, idx, 0, 0))
+            cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, v, (0, idx, 0, 0))
+            cache_index.value = idx + l
+            k, v = cached_k.value, cached_v.value
+            decode_lengths = jnp.broadcast_to(idx + l, (b,))
+            causal = False
+        bias = alibi_bias(cfg.n_head, k.shape[1])
+        out = dot_product_attention(q, k, v, backend=cfg.attention_backend,
+                                    causal=causal, bias=bias,
+                                    decode_lengths=decode_lengths)
+        return nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1),
+                               dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                               kernel_init=nn.with_logical_partitioning(_init(), ("heads", "kv", "embed")),
+                               bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
+                               name="dense")(out)
+
+
+class BloomBlock(nn.Module):
+    config: BloomConfig
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                                       param_dtype=cfg.param_dtype, name=name)
+        x = x + BloomAttention(cfg, self.decode, name="self_attention")(
+            ln("input_layernorm")(x))
+        h = ln("post_attention_layernorm")(x)
+        h = nn.Dense(features=4 * cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     kernel_init=nn.with_logical_partitioning(_init(), ("embed", "mlp")),
+                     bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("mlp",)),
+                     name="dense_h_to_4h")(h)
+        h = jax.nn.gelu(h, approximate=True)  # HF Bloom uses tanh-approx gelu
+        h = nn.Dense(features=cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     kernel_init=nn.with_logical_partitioning(_init(), ("mlp", "embed")),
+                     bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
+                     name="dense_4h_to_h")(h)
+        return x + h
+
+
+class BloomForCausalLM(nn.Module):
+    """BLOOM with tied word-embedding head and embedding layernorm."""
+
+    config: BloomConfig
+
+    @nn.compact
+    def __call__(self, input_ids, *, deterministic: bool = True, decode: bool = False):
+        cfg = self.config
+        wte = self.param("word_embeddings", nn.with_logical_partitioning(_init(), ("vocab", "embed")),
+                         (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+        wte_v = wte.value if isinstance(wte, nn.meta.AxisMetadata) else wte
+        x = jnp.take(wte_v, input_ids, axis=0).astype(cfg.dtype)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="word_embeddings_layernorm")(x)
+        block_cls = BloomBlock
+        if cfg.remat:
+            block_cls = nn.remat(BloomBlock, prevent_cse=False)
+        for i in range(cfg.n_layer):
+            x = block_cls(cfg, decode, name=f"h_{i}")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="ln_f")(x)
+        return jnp.einsum("ble,ve->blv", x, wte_v.astype(cfg.dtype),
+                          preferred_element_type=cfg.dtype)

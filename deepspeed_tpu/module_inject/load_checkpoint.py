@@ -25,6 +25,18 @@ def _sd(model_or_sd) -> Dict[str, Any]:
     return {k: _np(v) for k, v in model_or_sd.items()}
 
 
+def _lin(sd, name):
+    """torch Linear [out, in] (+bias) → flax Dense {kernel [in, out], bias}."""
+    return {"kernel": jnp.asarray(sd[name + ".weight"].T),
+            "bias": jnp.asarray(sd[name + ".bias"])}
+
+
+def _ln(sd, name):
+    """torch LayerNorm weight/bias → flax scale/bias."""
+    return {"scale": jnp.asarray(sd[name + ".weight"]),
+            "bias": jnp.asarray(sd[name + ".bias"])}
+
+
 def load_hf_gpt2(model_or_sd, cfg) -> dict:
     """HF ``GPT2LMHeadModel`` → ``models.gpt2.GPT2LMHeadModel`` params.
 
@@ -124,13 +136,8 @@ def load_hf_opt(model_or_sd, cfg) -> dict:
         pre = ""
     E, H, D = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
 
-    def lin(name):
-        return {"kernel": jnp.asarray(sd[name + ".weight"].T),
-                "bias": jnp.asarray(sd[name + ".bias"])}
-
-    def ln(name):
-        return {"scale": jnp.asarray(sd[name + ".weight"]),
-                "bias": jnp.asarray(sd[name + ".bias"])}
+    lin = lambda name: _lin(sd, name)
+    ln = lambda name: _ln(sd, name)
 
     params = {
         "embed_tokens": jnp.asarray(sd[f"{pre}embed_tokens.weight"]),
@@ -177,13 +184,8 @@ def load_hf_gpt_neox(model_or_sd, cfg) -> dict:
     pre = "gpt_neox." if any(k.startswith("gpt_neox.") for k in sd) else ""
     E, H, D = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
 
-    def lin(name):
-        return {"kernel": jnp.asarray(sd[name + ".weight"].T),
-                "bias": jnp.asarray(sd[name + ".bias"])}
-
-    def ln(name):
-        return {"scale": jnp.asarray(sd[name + ".weight"]),
-                "bias": jnp.asarray(sd[name + ".bias"])}
+    lin = lambda name: _lin(sd, name)
+    ln = lambda name: _ln(sd, name)
 
     params = {
         "embed_in": jnp.asarray(sd[f"{pre}embed_in.weight"]),
@@ -211,10 +213,48 @@ def load_hf_gpt_neox(model_or_sd, cfg) -> dict:
     return params
 
 
+def load_hf_bloom(model_or_sd, cfg) -> dict:
+    """HF ``BloomForCausalLM`` → ``models.bloom.BloomForCausalLM`` params
+    (reference ``module_inject/containers/bloom.py``). The fused qkv is
+    head-major interleaved like NeoX: torch [3E, E] → [E, H, 3, D]."""
+    sd = _sd(model_or_sd)
+    pre = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    E, H, D = cfg.hidden_size, cfg.n_head, cfg.head_dim
+
+    lin = lambda name: _lin(sd, name)
+    ln = lambda name: _ln(sd, name)
+
+    params = {
+        "word_embeddings": jnp.asarray(sd[f"{pre}word_embeddings.weight"]),
+        "word_embeddings_layernorm": ln(f"{pre}word_embeddings_layernorm"),
+        "ln_f": ln(f"{pre}ln_f"),
+    }
+    for i in range(cfg.n_layer):
+        p = f"{pre}h.{i}."
+        params[f"h_{i}"] = {
+            "input_layernorm": ln(p + "input_layernorm"),
+            "post_attention_layernorm": ln(p + "post_attention_layernorm"),
+            "self_attention": {
+                "query_key_value": {
+                    "kernel": jnp.asarray(sd[p + "self_attention.query_key_value.weight"].T
+                                          .reshape(E, H, 3, D)),
+                    "bias": jnp.asarray(sd[p + "self_attention.query_key_value.bias"]
+                                        .reshape(H, 3, D)),
+                },
+                "dense": {"kernel": jnp.asarray(sd[p + "self_attention.dense.weight"].T.reshape(H, D, E)),
+                          "bias": jnp.asarray(sd[p + "self_attention.dense.bias"])},
+            },
+            "dense_h_to_4h": lin(p + "mlp.dense_h_to_4h"),
+            "dense_4h_to_h": lin(p + "mlp.dense_4h_to_h"),
+        }
+    return params
+
+
 def load_hf_checkpoint(hf_model, arch: str, cfg) -> dict:
     """Dispatch by architecture (reference per-arch policy containers)."""
     loaders = {"gpt2": load_hf_gpt2, "llama": load_hf_llama, "opt": load_hf_opt,
-               "gpt_neox": load_hf_gpt_neox, "gptneox": load_hf_gpt_neox}
+               "gpt_neox": load_hf_gpt_neox, "gptneox": load_hf_gpt_neox,
+               "bloom": load_hf_bloom}
     if arch not in loaders:
         raise ValueError(f"no HF converter for architecture {arch!r}; available: {sorted(loaders)}")
     return loaders[arch](hf_model, cfg)

@@ -106,6 +106,11 @@ def GatheredParameters(params, modifier_rank: Optional[int] = None, fwd_module=N
     if not enabled or params is None:
         yield params
         return
+    if modifier_rank is not None:
+        logger.warning("GatheredParameters(modifier_rank=...) write-back does not exist on "
+                       "TPU: jax arrays are immutable, so mutations to the yielded numpy "
+                       "values are DISCARDED on exit — use "
+                       "utils.tensor_fragment.safe_set_full_fp32_param to write params")
     yield jax.tree.map(lambda p: np.asarray(jax.device_get(p)), params)
 
 
