@@ -60,8 +60,14 @@ def run_child():
     attn = os.environ.get("BENCH_ATTN", "flash" if jax.default_backend() in ("tpu", "axon") else "xla")
     # compute in bf16 end-to-end: without an explicit dtype the flax modules
     # force fp32 compute even though the engine casts params to bf16
+    overrides = {}
+    # vocab padded to a lane-aligned multiple (Megatron-style): 50257 → 50304
+    # tiles the LM-head matmul cleanly on the MXU
+    if os.environ.get("BENCH_VOCAB"):
+        overrides["vocab_size"] = int(os.environ["BENCH_VOCAB"])
     cfg_model = get_gpt2_config(model_name, n_positions=seq, remat=remat,
-                                attention_backend=attn, dtype=jnp.bfloat16)
+                                attention_backend=attn, dtype=jnp.bfloat16,
+                                **overrides)
     model = GPT2LMHeadModel(cfg_model)
 
     zero_stage = int(os.environ.get("BENCH_ZERO", "1" if n_dev > 1 else "0"))

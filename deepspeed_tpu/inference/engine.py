@@ -121,7 +121,10 @@ class InferenceEngine:
         """Full-sequence logits (no cache) — reference ``engine.py:592``."""
         if self._forward_fn is None:
             def fwd(params, ids):
-                return self.module.apply({"params": params}, ids)
+                out = self.module.apply({"params": params}, ids)
+                if isinstance(out, (tuple, list)):
+                    out = out[0]  # MoE models return (logits, aux_loss)
+                return out
             self._forward_fn = jax.jit(fwd)
         ids = self._place_batch(jnp.asarray(np.asarray(input_ids), jnp.int32))
         return self._forward_fn(self.params, ids)
@@ -146,9 +149,15 @@ class InferenceEngine:
         model = self.module
         eos = -1 if eos_token_id is None else int(eos_token_id)
 
-        def prefill(params, cache, ids):
+        def apply_decode(params, cache, ids):
             logits, upd = model.apply({"params": params, "cache": cache}, ids, decode=True,
                                       mutable=["cache"])
+            if isinstance(logits, (tuple, list)):
+                logits = logits[0]  # MoE models return (logits, aux_loss)
+            return logits, upd
+
+        def prefill(params, cache, ids):
+            logits, upd = apply_decode(params, cache, ids)
             return upd["cache"], logits[:, -1]
 
         def gen_loop(params, cache, last_logits, rng, max_new):
@@ -164,8 +173,7 @@ class InferenceEngine:
 
             def body(state):
                 t, done, tok, cache, out, rng = state
-                logits, upd = model.apply({"params": params, "cache": cache}, tok[:, None],
-                                          decode=True, mutable=["cache"])
+                logits, upd = apply_decode(params, cache, tok[:, None])
                 rng, key = jax.random.split(rng)
                 nxt = sample_logits(logits[:, 0], key, do_sample, temperature,
                                     top_k, top_p).astype(jnp.int32)

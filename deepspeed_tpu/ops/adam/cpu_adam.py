@@ -44,6 +44,36 @@ class DeepSpeedCPUAdam:
             self.state[idx] = {"m": np.zeros(n, np.float32), "v": np.zeros(n, np.float32)}
         return self.state[idx]
 
+    def begin_step(self, lr: Optional[float] = None) -> None:
+        """Open one optimizer step for per-leaf ``step_single`` calls (the
+        engine's pipelined offload path overlaps transfers with updates)."""
+        self.step_count += 1
+        self._step_lr = self.lr if lr is None else lr
+
+    def step_single(self, idx: int, param: np.ndarray, grad: np.ndarray,
+                    bf16_out: Optional[np.ndarray] = None) -> None:
+        """Update ONE (param, grad) pair inside a ``begin_step`` window.
+        ``idx`` keys the moment buffers — it must be the leaf's stable
+        position, not a call counter. The ctypes call releases the GIL, so
+        a second thread can fetch the next leaf's gradient meanwhile."""
+        assert param.dtype == np.float32 and param.flags.c_contiguous, \
+            "host master must be fp32 contiguous"
+        g32 = np.ascontiguousarray(grad.reshape(-1), np.float32)
+        flat = param.reshape(-1)
+        st = self._ensure_state(idx, flat.size)
+        if bf16_out is not None:
+            out = bf16_out.reshape(-1)
+            self.lib.ds_adam_update_copy_bf16(
+                _f32p(flat), _f32p(g32), _f32p(st["m"]), _f32p(st["v"]),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+                flat.size, self.step_count, self._step_lr, self.betas[0], self.betas[1], self.eps,
+                self.weight_decay, int(self.adamw_mode), int(self.bias_correction))
+        else:
+            self.lib.ds_adam_update(
+                _f32p(flat), _f32p(g32), _f32p(st["m"]), _f32p(st["v"]),
+                flat.size, self.step_count, self._step_lr, self.betas[0], self.betas[1], self.eps,
+                self.weight_decay, int(self.adamw_mode), int(self.bias_correction))
+
     def step(self, params: List[np.ndarray], grads: List[np.ndarray],
              bf16_out: Optional[List[np.ndarray]] = None, lr: Optional[float] = None):
         """In-place fused update of every (param, grad) pair.
@@ -52,25 +82,9 @@ class DeepSpeedCPUAdam:
         ``bf16_out``: optional preallocated uint16 arrays receiving the
         bf16-rounded updated params (device copy, zero extra passes).
         """
-        self.step_count += 1
-        use_lr = self.lr if lr is None else lr
+        self.begin_step(lr)
         for i, (p, g) in enumerate(zip(params, grads)):
-            assert p.dtype == np.float32 and p.flags.c_contiguous, "host master must be fp32 contiguous"
-            g32 = np.ascontiguousarray(g.reshape(-1), np.float32)
-            flat = p.reshape(-1)
-            st = self._ensure_state(i, flat.size)
-            if bf16_out is not None:
-                out = bf16_out[i].reshape(-1)
-                self.lib.ds_adam_update_copy_bf16(
-                    _f32p(flat), _f32p(g32), _f32p(st["m"]), _f32p(st["v"]),
-                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
-                    flat.size, self.step_count, use_lr, self.betas[0], self.betas[1], self.eps,
-                    self.weight_decay, int(self.adamw_mode), int(self.bias_correction))
-            else:
-                self.lib.ds_adam_update(
-                    _f32p(flat), _f32p(g32), _f32p(st["m"]), _f32p(st["v"]),
-                    flat.size, self.step_count, use_lr, self.betas[0], self.betas[1], self.eps,
-                    self.weight_decay, int(self.adamw_mode), int(self.bias_correction))
+            self.step_single(i, p, g, None if bf16_out is None else bf16_out[i])
         return params
 
     # -- checkpoint surface -------------------------------------------------

@@ -651,13 +651,32 @@ class DeepSpeedEngine:
             self.state = self.state._replace(loss_scale=new_ls, step=self.state.step + 1)
             return loss, {"loss": loss, "grad_norm": gnorm, "overflow": jnp.asarray(True),
                           "loss_scale": new_ls.loss_scale}
-        grad_leaves = [np.asarray(jax.device_get(g), np.float32) for g in jax.tree.leaves(grads)]
-        self._host_opt.step(self._host_masters, grad_leaves, lr=self.get_lr()[0])
-        # push updated masters back into the sharded device params
         leaves, treedef = jax.tree.flatten(self.state.params)
         shard_leaves = jax.tree.leaves(self.state_shardings.params)
-        new_leaves = [jax.device_put(m.reshape(old.shape).astype(old.dtype), s)
-                      for m, old, s in zip(self._host_masters, leaves, shard_leaves)]
+        grad_dev = jax.tree.leaves(grads)
+        new_leaves = [None] * len(leaves)
+        if hasattr(self._host_opt, "step_single"):
+            # pipelined: d2h of leaf i+1 overlaps the AVX update of leaf i
+            # (the ctypes call releases the GIL); the h2d re-upload of leaf i
+            # is async dispatch. Reference overlaps the same three stages
+            # with CUDA streams (stage_1_and_2.py:1086).
+            if not hasattr(self, "_offload_pool"):
+                import concurrent.futures
+                self._offload_pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+            fetch = lambda i: np.asarray(jax.device_get(grad_dev[i]), np.float32)
+            self._host_opt.begin_step(lr=self.get_lr()[0])
+            fut = self._offload_pool.submit(fetch, 0)
+            for i, (m, old, s) in enumerate(zip(self._host_masters, leaves, shard_leaves)):
+                g = fut.result()
+                if i + 1 < len(leaves):
+                    fut = self._offload_pool.submit(fetch, i + 1)
+                self._host_opt.step_single(i, m, g)
+                new_leaves[i] = jax.device_put(m.reshape(old.shape).astype(old.dtype), s)
+        else:
+            grad_leaves = [np.asarray(jax.device_get(g), np.float32) for g in grad_dev]
+            self._host_opt.step(self._host_masters, grad_leaves, lr=self.get_lr()[0])
+            new_leaves = [jax.device_put(m.reshape(old.shape).astype(old.dtype), s)
+                          for m, old, s in zip(self._host_masters, leaves, shard_leaves)]
         new_params = jax.tree.unflatten(treedef, new_leaves)
         new_ls = self._ls_update(self.state.loss_scale, jnp.asarray(False))
         self.state = TrainState(step=self.state.step + 1, params=new_params,
