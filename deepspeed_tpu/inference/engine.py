@@ -33,6 +33,13 @@ from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
 from deepspeed_tpu.utils.logging import log_dist
 
 
+def _unwrap_logits(out):
+    """MoE models return (logits, aux_loss); serving wants the logits."""
+    if isinstance(out, (tuple, list)):
+        return out[0]
+    return out
+
+
 def sample_logits(logits, rng, do_sample: bool, temperature: float, top_k: int, top_p: float):
     """Next-token selection on [B, V] logits (greedy or filtered sampling)."""
     if not do_sample:
@@ -116,15 +123,18 @@ class InferenceEngine:
             return jax.device_put(ids, NamedSharding(self.mesh, P(("expert", "data", "fsdp"))))
         return jax.device_put(ids, NamedSharding(self.mesh, P()))
 
+    def _apply_decode(self, params, cache, ids):
+        """One cached decode step; single source of the MoE logits unwrap."""
+        logits, upd = self.module.apply({"params": params, "cache": cache}, ids,
+                                        decode=True, mutable=["cache"])
+        return _unwrap_logits(logits), upd
+
     # ------------------------------------------------------------------
     def forward(self, input_ids, **kwargs):
         """Full-sequence logits (no cache) — reference ``engine.py:592``."""
         if self._forward_fn is None:
             def fwd(params, ids):
-                out = self.module.apply({"params": params}, ids)
-                if isinstance(out, (tuple, list)):
-                    out = out[0]  # MoE models return (logits, aux_loss)
-                return out
+                return _unwrap_logits(self.module.apply({"params": params}, ids))
             self._forward_fn = jax.jit(fwd)
         ids = self._place_batch(jnp.asarray(np.asarray(input_ids), jnp.int32))
         return self._forward_fn(self.params, ids)
@@ -149,12 +159,7 @@ class InferenceEngine:
         model = self.module
         eos = -1 if eos_token_id is None else int(eos_token_id)
 
-        def apply_decode(params, cache, ids):
-            logits, upd = model.apply({"params": params, "cache": cache}, ids, decode=True,
-                                      mutable=["cache"])
-            if isinstance(logits, (tuple, list)):
-                logits = logits[0]  # MoE models return (logits, aux_loss)
-            return logits, upd
+        apply_decode = self._apply_decode
 
         def prefill(params, cache, ids):
             logits, upd = apply_decode(params, cache, ids)
@@ -196,6 +201,88 @@ class InferenceEngine:
             "gen_loop": jax.jit(gen_loop, donate_argnums=(1,)),
         }
 
+    def _build_beam_loop(self, batch, beams, eos_token_id, cap, length_penalty):
+        """Beam-search decode (reference relies on HF ``generate`` over the
+        injected kernels; here the whole search is one jitted while_loop).
+        Each live hypothesis is one row of a [batch*beams] decode batch; the
+        KV cache reindexes by the winning beams' source indices every step."""
+        model = self.module
+        eos = -1 if eos_token_id is None else int(eos_token_id)
+
+        apply_decode = self._apply_decode
+
+        def replicate(cache):
+            # leaves with a leading batch dim fan out to [batch*beams, ...];
+            # scalars (cache_index counters) stay shared
+            def rep(x):
+                if x.ndim > 0 and x.shape[0] == batch:
+                    return jnp.repeat(x, beams, axis=0)
+                return x
+            return jax.tree.map(rep, cache)
+
+        def reindex(cache, beam_src):
+            # beam_src [batch, beams]: winning hypotheses' source beams
+            def gather(x):
+                if x.ndim > 0 and x.shape[0] == batch * beams:
+                    xb = x.reshape((batch, beams) + x.shape[1:])
+                    idx = beam_src.reshape((batch, beams) + (1,) * (x.ndim - 1))
+                    return jnp.take_along_axis(xb, idx, axis=1).reshape(x.shape)
+                return x
+            return jax.tree.map(gather, cache)
+
+        def beam_loop(params, cache, last_logits, max_new):
+            # cache arrives ALREADY replicated to [batch*beams, ...] (the
+            # caller runs the jitted replicate first) so the donated input
+            # aliases the loop-carried cache — inside-loop replication would
+            # leave donation dead and hold 1+beams cache copies in HBM
+            lp0 = jax.nn.log_softmax(last_logits.astype(jnp.float32), axis=-1)  # [B, V]
+            scores, tok = jax.lax.top_k(lp0, beams)  # [B, beams]
+            tok = tok.astype(jnp.int32)
+            out0 = jnp.zeros((batch, beams, cap), jnp.int32).at[:, :, 0].set(tok)
+            done0 = tok == eos
+            len0 = jnp.ones((batch, beams), jnp.int32)
+            vocab = lp0.shape[-1]
+            # candidate set for a finished beam: only "stay finished" (eos,
+            # score unchanged) — standard done-beam handling
+            done_lp = jnp.full((vocab,), -jnp.inf).at[max(eos, 0)].set(0.0)
+
+            def cond(state):
+                t, done, *_ = state
+                return (t < max_new) & ~jnp.all(done)
+
+            def body(state):
+                t, done, tok, scores, lens, cache, out = state
+                logits, upd = apply_decode(params, cache, tok.reshape(batch * beams, 1))
+                lp = jax.nn.log_softmax(logits[:, 0].astype(jnp.float32), axis=-1)
+                lp = lp.reshape(batch, beams, vocab)
+                lp = jnp.where(done[:, :, None], done_lp[None, None, :], lp)
+                total = scores[:, :, None] + lp  # [B, beams, V]
+                new_scores, flat = jax.lax.top_k(total.reshape(batch, beams * vocab), beams)
+                beam_src = (flat // vocab).astype(jnp.int32)
+                new_tok = (flat % vocab).astype(jnp.int32)
+                take = lambda a: jnp.take_along_axis(a, beam_src, axis=1)
+                prev_done = take(done)
+                new_done = prev_done | (new_tok == eos)
+                new_lens = take(lens) + (~prev_done).astype(jnp.int32)
+                out = jnp.take_along_axis(out, beam_src[:, :, None], axis=1)
+                # a finished beam keeps emitting eos (or 0) — already its token
+                out = out.at[:, :, t].set(jnp.where(prev_done, max(eos, 0), new_tok))
+                cache = reindex(upd["cache"], beam_src)
+                return t + 1, new_done, new_tok, new_scores, new_lens, cache, out
+
+            t, done, tok, scores, lens, cache, out = jax.lax.while_loop(
+                cond, body, (jnp.int32(1), done0, tok, scores, len0, cache, out0))
+            # HF-style length normalization (length_penalty=1.0 → mean logprob)
+            norm = scores / (lens.astype(jnp.float32) ** length_penalty)
+            best = jnp.argmax(norm, axis=-1)
+            best_out = jnp.take_along_axis(out, best[:, None, None], axis=1)[:, 0]
+            return best_out, t, cache
+
+        # replicate is NOT donated (outputs are beams× larger, nothing can
+        # alias); the prefill cache dies naturally after this call
+        return {"replicate": jax.jit(replicate),
+                "loop": jax.jit(beam_loop, donate_argnums=(1,))}
+
     @staticmethod
     def _pow2_bucket(n: int) -> int:
         b = 1
@@ -205,9 +292,15 @@ class InferenceEngine:
 
     def generate(self, input_ids, max_new_tokens: Optional[int] = None, do_sample: bool = False,
                  temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
-                 eos_token_id: Optional[int] = None, rng: Optional[jax.Array] = None, **kwargs):
+                 eos_token_id: Optional[int] = None, rng: Optional[jax.Array] = None,
+                 num_beams: int = 1, length_penalty: float = 1.0, **kwargs):
         """Generate ``max_new_tokens`` continuations (reference routes
-        ``generate`` through the injected model's fused decode kernels)."""
+        ``generate`` through the injected model's fused decode kernels).
+        ``num_beams > 1`` runs beam search (greedy expansion; HF-style
+        length normalization via ``length_penalty``)."""
+        if num_beams > 1 and do_sample:
+            raise ValueError("num_beams > 1 requires do_sample=False (beam-sample "
+                             "hybrid is not supported)")
         ids_np = np.asarray(input_ids, np.int32)
         real_batch, prompt_len = ids_np.shape
         max_new = int(max_new_tokens if max_new_tokens is not None else self.config.max_new_tokens)
@@ -261,8 +354,20 @@ class InferenceEngine:
             pos += 1
         if max_new <= 0:
             return jnp.asarray(ids_np[:real_batch])
-        out, n, _ = fns["gen_loop"](self.params, cache, last_logits, use_rng,
-                                    jnp.int32(min(max_new, cap)))
+        if num_beams > 1:
+            bkey = (batch, num_beams, eos_token_id, float(length_penalty))
+            if not hasattr(self, "_beam_cache"):
+                self._beam_cache = {}
+            if bkey not in self._beam_cache:
+                self._beam_cache[bkey] = self._build_beam_loop(
+                    batch, num_beams, eos_token_id, cap, float(length_penalty))
+            bfns = self._beam_cache[bkey]
+            cache = bfns["replicate"](cache)
+            out, n, _ = bfns["loop"](self.params, cache, last_logits,
+                                     jnp.int32(min(max_new, cap)))
+        else:
+            out, n, _ = fns["gen_loop"](self.params, cache, last_logits, use_rng,
+                                        jnp.int32(min(max_new, cap)))
         n = int(n)
         full = jnp.concatenate([jnp.asarray(ids_np), out[:, :n]], axis=1)
         return full[:real_batch]

@@ -190,3 +190,57 @@ def test_moe_model_forward_returns_logits():
     out = engine(ids)
     assert not isinstance(out, tuple)
     assert out.shape == (1, 8, cfg.vocab_size)
+
+
+class TestBeamSearch:
+
+    def _engine(self):
+        cfg = get_gpt2_config("test")
+        model = GPT2LMHeadModel(cfg)
+        ids = np.arange(2 * 8, dtype=np.int32).reshape(2, 8) % cfg.vocab_size
+        variables = model.init(jax.random.PRNGKey(0), jnp.asarray(ids))
+        return deepspeed_tpu.init_inference(model, config={"dtype": "fp32"},
+                                            params=variables["params"]), ids, cfg
+
+    def test_one_beam_equals_greedy(self):
+        engine, ids, _ = self._engine()
+        greedy = engine.generate(ids, max_new_tokens=5)
+        # num_beams=1 must route through the greedy path (identical output)
+        one = engine.generate(ids, max_new_tokens=5, num_beams=1)
+        np.testing.assert_array_equal(np.asarray(one), np.asarray(greedy))
+        # beams=2 must score at least as well as greedy under summed logprob
+        beam = engine.generate(ids, max_new_tokens=5, num_beams=2, length_penalty=0.0)
+        assert beam.shape == greedy.shape
+
+        # score both continuations under the model: beam >= greedy
+        def seq_logprob(full):
+            logits = np.asarray(jax.device_get(engine(np.asarray(full))), np.float32)
+            lp = jax.nn.log_softmax(jnp.asarray(logits), axis=-1)
+            total = []
+            for b in range(full.shape[0]):
+                s = 0.0
+                for t in range(ids.shape[1] - 1, full.shape[1] - 1):
+                    s += float(lp[b, t, int(full[b, t + 1])])
+                total.append(s)
+            return np.asarray(total)
+
+        g, bm = seq_logprob(np.asarray(greedy)), seq_logprob(np.asarray(beam))
+        assert (bm >= g - 1e-4).all(), (bm, g)
+
+    def test_beam_prompt_preserved_and_deterministic(self):
+        engine, ids, _ = self._engine()
+        out1 = engine.generate(ids, max_new_tokens=4, num_beams=3)
+        out2 = engine.generate(ids, max_new_tokens=4, num_beams=3)
+        assert out1.shape == (2, 12)
+        assert (np.asarray(out1[:, :8]) == ids).all()
+        np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+    def test_beam_rejects_sampling(self):
+        engine, ids, _ = self._engine()
+        with pytest.raises(ValueError):
+            engine.generate(ids, max_new_tokens=2, num_beams=2, do_sample=True)
+
+    def test_beam_eos_early_stop(self):
+        engine, ids, _ = self._engine()
+        out = engine.generate(ids, max_new_tokens=6, num_beams=2, eos_token_id=7)
+        assert out.shape[1] <= 14 and np.isfinite(np.asarray(out)).all()
