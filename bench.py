@@ -65,6 +65,9 @@ def run_child():
     # tiles the LM-head matmul cleanly on the MXU
     if os.environ.get("BENCH_VOCAB"):
         overrides["vocab_size"] = int(os.environ["BENCH_VOCAB"])
+    # embedding-grad as one-hot matmul instead of scatter-add (PERF.md #4)
+    if os.environ.get("BENCH_EMBED_ONEHOT") == "1":
+        overrides["embed_onehot_grad"] = True
     cfg_model = get_gpt2_config(model_name, n_positions=seq, remat=remat,
                                 attention_backend=attn, dtype=jnp.bfloat16,
                                 **overrides)

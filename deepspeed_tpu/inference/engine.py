@@ -156,7 +156,6 @@ class InferenceEngine:
         any length run ceil(p/C) chunked calls + (p mod C) single calls; no
         per-shape recompiles (reference per-token kernels +
         ``inference_context.h`` workspace reuse achieve the same)."""
-        model = self.module
         eos = -1 if eos_token_id is None else int(eos_token_id)
 
         apply_decode = self._apply_decode
@@ -206,7 +205,6 @@ class InferenceEngine:
         injected kernels; here the whole search is one jitted while_loop).
         Each live hypothesis is one row of a [batch*beams] decode batch; the
         KV cache reindexes by the winning beams' source indices every step."""
-        model = self.module
         eos = -1 if eos_token_id is None else int(eos_token_id)
 
         apply_decode = self._apply_decode

@@ -24,6 +24,65 @@ def dense_init(scale: float = 0.02):
     return nn.initializers.normal(stddev=scale)
 
 
+import functools
+
+
+_ONEHOT_CHUNK = 1024  # tokens per backward chunk — bounds the one-hot buffer
+
+
+@functools.lru_cache(maxsize=None)
+def _onehot_embed_fn(vocab: int, dtype_name: str):
+    @jax.custom_vjp
+    def f(wte, ids):
+        return jnp.take(wte, ids, axis=0)
+
+    def fwd(wte, ids):
+        return jnp.take(wte, ids, axis=0), ids
+
+    def bwd(ids, g):
+        # chunk the token axis: a single-shot one_hot is [T, V] in the grad
+        # dtype (~824 MB at T=4k, V=50k, fp32); scanning T in chunks of
+        # _ONEHOT_CHUNK with a bf16 one-hot (fp32 accumulation via
+        # preferred_element_type) bounds the buffer to a few tens of MB
+        ids_f = ids.reshape(-1)
+        g_f = g.reshape(-1, g.shape[-1]).astype(jnp.float32)
+        t = ids_f.shape[0]
+        ch = _ONEHOT_CHUNK
+        if t <= ch or t % ch != 0:
+            onehot = jax.nn.one_hot(ids_f, vocab, dtype=jnp.bfloat16)
+            gw = jax.lax.dot_general(onehot, g_f, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+        else:
+            def body(acc, xs):
+                i_c, g_c = xs
+                oh = jax.nn.one_hot(i_c, vocab, dtype=jnp.bfloat16)
+                return acc + jax.lax.dot_general(oh, g_c, (((0,), (0,)), ((), ())),
+                                                 preferred_element_type=jnp.float32), None
+
+            gw, _ = jax.lax.scan(body, jnp.zeros((vocab, g_f.shape[-1]), jnp.float32),
+                                 (ids_f.reshape(-1, ch), g_f.reshape(-1, ch, g_f.shape[-1])))
+        return gw.astype(dtype_name), None
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def take_embed_onehot_grad(wte, ids):
+    """Embedding lookup whose BACKWARD is a one-hot matmul instead of a
+    scatter-add. TPU scatter lowers to a serialized per-index update; the
+    [T, V] x [T, E] matmul form rides the MXU (the standard TPU trick —
+    costs ~V*T*E extra FLOPs, usually a small fraction of a transformer
+    step). Forward is a plain gather either way."""
+    return _onehot_embed_fn(int(wte.shape[0]), jnp.dtype(wte.dtype).name)(wte, ids)
+
+
+def embed_lookup(wte, ids, onehot_grad: bool = False):
+    """Token-embedding gather with a selectable backward formulation."""
+    if onehot_grad:
+        return take_embed_onehot_grad(wte, ids)
+    return jnp.take(wte, ids, axis=0)
+
+
 def config_from(table: dict, cls, name: str, **overrides):
     """Look up a named config dict and build ``cls`` with overrides."""
     base = dict(table[name])

@@ -45,6 +45,9 @@ class GPT2Config:
     remat_policy: Optional[str] = None
     remat_every: int = 1
     attention_backend: str = "xla"
+    # backward of the token-embedding gather as a one-hot matmul instead of
+    # a scatter-add (MXU-friendly; ~V*T*E extra FLOPs) — perf knob
+    embed_onehot_grad: bool = False
     # MoE (reference GPT-MoE configs: every other layer is an MoE FFN)
     moe_num_experts: int = 0  # 0 = dense model
     moe_layer_freq: int = 2  # MoE every Nth block (reference expert-interval)
@@ -227,8 +230,9 @@ class GPT2LMHeadModel(nn.Module):
         wte_value = wte.value if isinstance(wte, nn.meta.AxisMetadata) else wte
         wpe_value = wpe.value if isinstance(wpe, nn.meta.AxisMetadata) else wpe
 
+        from deepspeed_tpu.models.common import embed_lookup
         _, seq_len = input_ids.shape
-        x = jnp.take(wte_value, input_ids, axis=0).astype(cfg.dtype)
+        x = embed_lookup(wte_value, input_ids, cfg.embed_onehot_grad).astype(cfg.dtype)
         if decode:
             # position offset for wpe; advances in lockstep with each
             # attention layer's cache_index (same increment per call — flax
@@ -286,9 +290,10 @@ class GPT2EmbedPipe(nn.Module):
 
     def __call__(self, input_ids):
         cfg = self.config
+        from deepspeed_tpu.models.common import embed_lookup
         wte = self.wte.value if isinstance(self.wte, nn.meta.AxisMetadata) else self.wte
         wpe = self.wpe.value if isinstance(self.wpe, nn.meta.AxisMetadata) else self.wpe
-        x = jnp.take(wte, input_ids, axis=0).astype(cfg.dtype)
+        x = embed_lookup(wte, input_ids, cfg.embed_onehot_grad).astype(cfg.dtype)
         return x + wpe[:input_ids.shape[-1]].astype(cfg.dtype)
 
     def attend(self, x):

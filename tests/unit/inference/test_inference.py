@@ -241,6 +241,20 @@ class TestBeamSearch:
             engine.generate(ids, max_new_tokens=2, num_beams=2, do_sample=True)
 
     def test_beam_eos_early_stop(self):
+        """Force a guaranteed-immediate EOS: use each row's greedy next token
+        as the eos id for a 1-row batch, so every beam finishes at step 1 and
+        the loop must stop early (output narrower than prompt+max_new)."""
         engine, ids, _ = self._engine()
-        out = engine.generate(ids, max_new_tokens=6, num_beams=2, eos_token_id=7)
-        assert out.shape[1] <= 14 and np.isfinite(np.asarray(out)).all()
+        row = ids[:1]
+        greedy = engine.generate(row, max_new_tokens=1)
+        eos = int(np.asarray(greedy)[0, -1])
+        out = engine.generate(row, max_new_tokens=6, num_beams=1, eos_token_id=eos)
+        assert out.shape[1] < row.shape[1] + 6, out.shape
+        # beam path: once eos appears in the best hypothesis, every later
+        # position is the eos fill
+        bout = np.asarray(engine.generate(row, max_new_tokens=6, num_beams=2,
+                                          eos_token_id=eos))
+        gen = bout[0, row.shape[1]:]
+        if eos in gen:
+            first = int(np.argmax(gen == eos))
+            assert (gen[first:] == eos).all(), gen

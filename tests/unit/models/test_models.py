@@ -110,3 +110,39 @@ def test_bert_mlm_trains():
     batch = {"input_ids": ids, "labels": labels}
     losses = [float(engine.train_batch(batch)) for _ in range(6)]
     assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+
+
+def test_embed_onehot_grad_matches_scatter():
+    """The one-hot-matmul backward must produce the same embedding gradient
+    as the scatter-add backward (models/common.embed_lookup perf knob)."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.common import embed_lookup
+    rng = np.random.default_rng(0)
+    wte = jnp.asarray(rng.standard_normal((32, 8)), jnp.float32)
+    ids = jnp.asarray(rng.integers(0, 32, (2, 16)), jnp.int32)
+
+    def loss(w, onehot):
+        x = embed_lookup(w, ids, onehot)
+        return (x * jnp.arange(1, 9)).sum()
+
+    g_scatter = jax.grad(lambda w: loss(w, False))(wte)
+    g_onehot = jax.grad(lambda w: loss(w, True))(wte)
+    np.testing.assert_allclose(np.asarray(g_onehot), np.asarray(g_scatter),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_gpt2_embed_onehot_grad_trains_identically():
+    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+    import deepspeed_tpu
+    ids = np.random.default_rng(1).integers(0, 256, (8, 32)).astype(np.int32)
+
+    def train(onehot):
+        cfg = get_gpt2_config("test", embed_onehot_grad=onehot)
+        e, _, _, _ = deepspeed_tpu.initialize(model=GPT2LMHeadModel(cfg), config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}})
+        e.initialize_state({"input_ids": ids})
+        losses = [float(e.train_batch({"input_ids": ids})) for _ in range(3)]
+        return losses
+
+    np.testing.assert_allclose(train(True), train(False), atol=1e-4)
