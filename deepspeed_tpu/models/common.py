@@ -1,5 +1,7 @@
 """Shared model-zoo helpers."""
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -24,9 +26,6 @@ def dense_init(scale: float = 0.02):
     return nn.initializers.normal(stddev=scale)
 
 
-import functools
-
-
 _ONEHOT_CHUNK = 1024  # tokens per backward chunk — bounds the one-hot buffer
 
 
@@ -48,11 +47,18 @@ def _onehot_embed_fn(vocab: int, dtype_name: str):
         g_f = g.reshape(-1, g.shape[-1]).astype(jnp.float32)
         t = ids_f.shape[0]
         ch = _ONEHOT_CHUNK
-        if t <= ch or t % ch != 0:
+        if t <= ch:
             onehot = jax.nn.one_hot(ids_f, vocab, dtype=jnp.bfloat16)
             gw = jax.lax.dot_general(onehot, g_f, (((0,), (0,)), ((), ())),
                                      preferred_element_type=jnp.float32)
         else:
+            # pad to a chunk multiple — padded rows carry zero cotangent so
+            # they contribute nothing, and the memory bound holds for EVERY
+            # shape (a full-T fallback would reintroduce the [T, V] spike)
+            pad = (-t) % ch
+            if pad:
+                ids_f = jnp.concatenate([ids_f, jnp.zeros((pad,), ids_f.dtype)])
+                g_f = jnp.concatenate([g_f, jnp.zeros((pad, g_f.shape[-1]), g_f.dtype)])
             def body(acc, xs):
                 i_c, g_c = xs
                 oh = jax.nn.one_hot(i_c, vocab, dtype=jnp.bfloat16)

@@ -21,6 +21,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.models.common import embed_lookup
 from deepspeed_tpu.ops.transformer.attention import dot_product_attention
 
 Dtype = Any
@@ -230,7 +231,6 @@ class GPT2LMHeadModel(nn.Module):
         wte_value = wte.value if isinstance(wte, nn.meta.AxisMetadata) else wte
         wpe_value = wpe.value if isinstance(wpe, nn.meta.AxisMetadata) else wpe
 
-        from deepspeed_tpu.models.common import embed_lookup
         _, seq_len = input_ids.shape
         x = embed_lookup(wte_value, input_ids, cfg.embed_onehot_grad).astype(cfg.dtype)
         if decode:
@@ -290,7 +290,6 @@ class GPT2EmbedPipe(nn.Module):
 
     def __call__(self, input_ids):
         cfg = self.config
-        from deepspeed_tpu.models.common import embed_lookup
         wte = self.wte.value if isinstance(self.wte, nn.meta.AxisMetadata) else self.wte
         wpe = self.wpe.value if isinstance(self.wpe, nn.meta.AxisMetadata) else self.wpe
         x = embed_lookup(wte, input_ids, cfg.embed_onehot_grad).astype(cfg.dtype)

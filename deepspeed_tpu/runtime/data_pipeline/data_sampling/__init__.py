@@ -1,0 +1,4 @@
+from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_analyzer import DataAnalyzer
+from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_sampler import DeepSpeedDataSampler
+from deepspeed_tpu.runtime.data_pipeline.data_sampling.indexed_dataset import (
+    MMapIndexedDataset, MMapIndexedDatasetBuilder)

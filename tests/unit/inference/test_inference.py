@@ -254,6 +254,7 @@ class TestBeamSearch:
         # position is the eos fill
         bout = np.asarray(engine.generate(row, max_new_tokens=6, num_beams=2,
                                           eos_token_id=eos))
+        assert bout.shape[1] <= row.shape[1] + 6 and np.isfinite(bout).all()
         gen = bout[0, row.shape[1]:]
         if eos in gen:
             first = int(np.argmax(gen == eos))

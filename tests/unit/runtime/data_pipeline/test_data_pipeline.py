@@ -287,3 +287,71 @@ def test_random_ltd_layer_drops_tokens():
                            rngs={"random_ltd": jax.random.PRNGKey(2)}).sum()
     g = jax.grad(loss)(x)
     assert np.asarray((g != 0).all())
+
+
+class TestDataAnalyzer:
+    """Offline difficulty maps (reference data_analyzer.py run_map/reduce)
+    feeding the curriculum sampler's index_to_metric_path."""
+
+    def _corpus(self, n=40, seed=0):
+        rng = np.random.default_rng(seed)
+        return [rng.integers(0, 100, rng.integers(2, 20)).astype(np.int32)
+                for _ in range(n)]
+
+    def test_map_reduce_single_worker(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling import (DataAnalyzer,
+                                                                       MMapIndexedDataset)
+        data = self._corpus()
+        an = DataAnalyzer(data, ["seqlen"], {"seqlen": len}, str(tmp_path))
+        an.run_map_reduce()
+        ds = MMapIndexedDataset(an.metric_path("seqlen"))
+        got = [int(ds[i][0]) for i in range(len(ds))]
+        assert got == [len(s) for s in data]
+        # metric→sample rows cover every sample exactly once, sorted by value
+        s_ds = MMapIndexedDataset(an.sample_path("seqlen"))
+        all_ids = np.concatenate([np.asarray(s_ds[i]) for i in range(len(s_ds))])
+        assert sorted(all_ids.tolist()) == list(range(len(data)))
+        vals = np.load(tmp_path / "seqlen" / "metric_values.npy")
+        assert (np.diff(vals) > 0).all()
+
+    def test_multi_worker_merge_matches_single(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling import (DataAnalyzer,
+                                                                       MMapIndexedDataset)
+        data = self._corpus(n=37, seed=1)  # odd count: uneven shards
+        a1 = DataAnalyzer(data, ["seqlen"], {"seqlen": len}, str(tmp_path / "w1"))
+        a1.run_map_reduce()
+        a3 = DataAnalyzer(data, ["seqlen"], {"seqlen": len}, str(tmp_path / "w3"),
+                          num_workers=3)
+        a3.run_map_reduce()
+        d1 = MMapIndexedDataset(a1.metric_path("seqlen"))
+        d3 = MMapIndexedDataset(a3.metric_path("seqlen"))
+        assert [int(d1[i][0]) for i in range(len(d1))] == \
+               [int(d3[i][0]) for i in range(len(d3))]
+
+    def test_analyzer_feeds_sampler(self, tmp_path):
+        """End to end: analyzer output loads through index_to_metric_path and
+        the value-based curriculum only admits short samples early."""
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling import (DataAnalyzer,
+                                                                       DeepSpeedDataSampler)
+        data = self._corpus(n=32, seed=2)
+        an = DataAnalyzer(data, ["seqlen"], {"seqlen": len}, str(tmp_path))
+        an.run_map_reduce()
+        cfg = {"data_sampling": {"num_epochs": 1, "curriculum_learning": {
+            "enabled": True,
+            "curriculum_metrics": {
+                "seqlen": {"index_to_metric_path": an.metric_path("seqlen"),
+                           "difficulty_type": "value",
+                           "schedule_type": "fixed_linear",
+                           "max_difficulty": 19,
+                           "min_difficulty": 5,
+                           "schedule_config": {"total_curriculum_step": 8,
+                                               "difficulty_step": 1}}}}}}
+        sampler = DeepSpeedDataSampler(cfg, one_epoch_total_samples=len(data),
+                                       micro_batch_size=2, data_parallel_rank=0,
+                                       data_parallel_size=1, gradient_accumulation_steps=1)
+        first = sampler.get_next_global_batch()
+        lens = [len(data[i]) for i in np.asarray(first)]
+        assert max(lens) <= 5, lens
+        for _ in range(10):
+            batch = sampler.get_next_global_batch()
+        assert max(len(data[i]) for i in np.asarray(batch)) <= 19

@@ -1,0 +1,54 @@
+"""Config-ladder build checks (BASELINE.md rungs): the judged large-model
+configurations must TRACE AND LOWER on a multi-device mesh — abstract
+shapes only, no parameter materialization — so scale-relevant breakage
+(sharding mismatches, planner errors, qcomm composition) surfaces in CI
+rather than on hardware. Compilation/runtime cost is the bench's job."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import (GPT2LMHeadModel, LlamaForCausalLM, get_gpt2_config,
+                                  get_llama_config)
+from deepspeed_tpu.parallel.topology import MeshTopology
+
+
+def _lower(model, ds_config, topology, seq=128, batch=8):
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, topology=topology,
+                                               config=ds_config)
+    batch_np = {"input_ids": np.zeros((batch, seq), np.int32)}
+    lowered = engine.lower_train_step(batch_np)
+    text = lowered.as_text()
+    assert text and "func" in text
+    return engine, text
+
+
+@pytest.mark.parametrize("stage", [2, 3])
+def test_gpt2_xl_lowers_under_zero(stage):
+    """GPT-2-XL (1.5B) bf16 ZeRO-2/3 over fsdp=8 — the ladder's second rung."""
+    import jax.numpy as jnp
+    cfg = get_gpt2_config("xl", n_positions=128, dtype=jnp.bfloat16, remat=True)
+    ds = {"train_batch_size": 8,
+          "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+          "bf16": {"enabled": True},
+          "zero_optimization": {"stage": stage}}
+    engine, text = _lower(GPT2LMHeadModel(cfg), ds, MeshTopology(fsdp=8))
+    import jax
+    n = sum(int(np.prod(sh)) for sh in jax.tree.leaves(
+        engine.plan.param_shapes, is_leaf=lambda x: isinstance(x, tuple)))
+    assert n > 1.5e9
+
+
+def test_llama_1b_lowers_with_zeropp_and_tp():
+    """LLaMA-family rung with ZeRO++ quantized collectives composing with
+    tensor parallelism (fsdp=4 x tensor=2)."""
+    import jax.numpy as jnp
+    cfg = get_llama_config("1b", max_position_embeddings=128, dtype=jnp.bfloat16, remat=True)
+    ds = {"train_batch_size": 8,
+          "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+          "bf16": {"enabled": True},
+          "zero_optimization": {"stage": 3,
+                                "zero_quantized_weights": True,
+                                "zero_quantized_gradients": True}}
+    engine, text = _lower(LlamaForCausalLM(cfg), ds, MeshTopology(fsdp=4, tensor=2))
+    assert engine._use_qcomm, "qcomm must engage on a DP(+TP) mesh"
