@@ -8,3 +8,5 @@ from deepspeed_tpu.models.gpt_neox import (GPTNeoXConfig, GPTNeoXForCausalLM, GP
                                             get_gpt_neox_config)
 from deepspeed_tpu.models.bloom import (BloomConfig, BloomForCausalLM, BLOOM_CONFIGS,
                                         get_bloom_config)
+from deepspeed_tpu.models.t5 import (T5Config, T5ForConditionalGeneration, T5_CONFIGS,
+                                     get_t5_config)

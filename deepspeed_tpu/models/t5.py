@@ -1,0 +1,290 @@
+"""T5 family — encoder-decoder with relative position biases (the
+reference serves T5 through kernel injection,
+``module_inject/containers`` T5-style policies; HF
+``T5ForConditionalGeneration`` is the checkpoint source).
+
+Same TPU conventions as the rest of the zoo (logical axis names → ZeRO
+planner, ``cache`` collection for decoder self-attention). T5 quirks kept
+for checkpoint parity: RMS layer norm without bias, UNSCALED attention
+(no 1/sqrt(d)), a learned relative-position bias computed by the FIRST
+layer of each stack and shared down the stack, ReLU (v1.0) or gated-GELU
+(v1.1) feed-forward, and logits scaled by d_model^-0.5 when the head is
+tied to the shared embedding.
+
+Cross-attention K/V are projected from the encoder output on every decode
+step (encoder sequences are short relative to generation length; a
+cached-projection variant belongs with paged serving if profiling asks).
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.common import config_from, dense_init as _init
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 512
+    d_kv: int = 64
+    d_ff: int = 2048
+    num_layers: int = 6
+    num_decoder_layers: Optional[int] = None
+    num_heads: int = 8
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    layer_norm_epsilon: float = 1e-6
+    feed_forward_proj: str = "relu"  # "relu" (t5) | "gated-gelu" (t5 v1.1)
+    max_cache_length: int = 512  # decoder self-attention cache capacity
+    tie_word_embeddings: bool = True
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+
+    @property
+    def n_dec_layers(self):
+        return self.num_decoder_layers if self.num_decoder_layers is not None else self.num_layers
+
+    @property
+    def is_gated(self):
+        return self.feed_forward_proj.startswith("gated")
+
+
+T5_CONFIGS = {
+    "test": dict(vocab_size=256, d_model=64, d_kv=16, d_ff=128, num_layers=2, num_heads=4),
+    "small": dict(d_model=512, d_kv=64, d_ff=2048, num_layers=6, num_heads=8),
+    "base": dict(d_model=768, d_kv=64, d_ff=3072, num_layers=12, num_heads=12),
+    "large": dict(d_model=1024, d_kv=64, d_ff=4096, num_layers=24, num_heads=16),
+    "3b": dict(d_model=1024, d_kv=128, d_ff=16384, num_layers=24, num_heads=32),
+}
+
+
+def get_t5_config(name: str, **overrides) -> T5Config:
+    return config_from(T5_CONFIGS, T5Config, name, **overrides)
+
+
+def relative_position_bucket(relative_position, bidirectional: bool,
+                             num_buckets: int, max_distance: int):
+    """The standard T5 log-bucketing of relative positions."""
+    ret = 0
+    n = -relative_position
+    if bidirectional:
+        num_buckets //= 2
+        ret += (n < 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_if_large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-6)
+        / jnp.log(max_distance / max_exact) * (num_buckets - max_exact)).astype(jnp.int32)
+    val_if_large = jnp.minimum(val_if_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_if_large)
+
+
+class T5LayerNorm(nn.Module):
+    """RMS norm, no bias, no mean subtraction (T5 convention)."""
+
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        w = self.param("weight", nn.with_logical_partitioning(nn.initializers.ones, ("embed",)),
+                       (x.shape[-1],), cfg.param_dtype)
+        w = w.value if isinstance(w, nn.meta.AxisMetadata) else w
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        return (x32 * jax.lax.rsqrt(var + cfg.layer_norm_epsilon)
+                * w.astype(jnp.float32)).astype(cfg.dtype)
+
+
+class T5Attention(nn.Module):
+    """Unscaled multi-head attention with optional relative-position bias.
+    ``kv`` (cross-attention source) defaults to ``x``; ``decode`` is a CALL
+    argument so the same parameters serve full and incremental passes."""
+
+    config: T5Config
+    has_relative_bias: bool = False
+    bidirectional: bool = True
+    cache_name: str = "self"
+
+    def _rel_bias(self, q_len, k_len, q_offset):
+        cfg = self.config
+        rel_embed = self.param(
+            "relative_attention_bias",
+            nn.with_logical_partitioning(_init(), (None, "heads")),
+            (cfg.relative_attention_num_buckets, cfg.num_heads), cfg.param_dtype)
+        rel_embed = rel_embed.value if isinstance(rel_embed, nn.meta.AxisMetadata) else rel_embed
+        ctx = jnp.arange(q_len)[:, None] + q_offset
+        mem = jnp.arange(k_len)[None, :]
+        buckets = relative_position_bucket(mem - ctx, self.bidirectional,
+                                           cfg.relative_attention_num_buckets,
+                                           cfg.relative_attention_max_distance)
+        bias = jnp.take(rel_embed, buckets, axis=0)  # [q, k, heads]
+        return bias.transpose(2, 0, 1)[None]  # [1, heads, q, k]
+
+    @nn.compact
+    def __call__(self, x, kv=None, mask=None, position_bias=None, decode: bool = False):
+        cfg = self.config
+        kv = x if kv is None else kv
+        b, lq = x.shape[0], x.shape[1]
+
+        def proj(name, src):
+            return nn.DenseGeneral(features=(cfg.num_heads, cfg.d_kv), axis=-1, use_bias=False,
+                                   dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                                   kernel_init=nn.with_logical_partitioning(
+                                       _init(), ("embed", "heads", "kv")),
+                                   name=name)(src)
+
+        q = proj("q", x)
+        k = proj("k", kv)
+        v = proj("v", kv)
+        q_offset = 0
+        if decode and self.cache_name == "self":
+            shape = (b, cfg.max_cache_length, cfg.num_heads, cfg.d_kv)
+            cached_k = self.variable("cache", "cached_key", jnp.zeros, shape, k.dtype)
+            cached_v = self.variable("cache", "cached_value", jnp.zeros, shape, v.dtype)
+            cache_index = self.variable("cache", "cache_index", lambda: jnp.zeros([], jnp.int32))
+            idx = cache_index.value
+            cached_k.value = jax.lax.dynamic_update_slice(cached_k.value, k, (0, idx, 0, 0))
+            cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, v, (0, idx, 0, 0))
+            cache_index.value = idx + lq
+            k, v = cached_k.value, cached_v.value
+            q_offset = idx
+        lk = k.shape[1]
+
+        if position_bias is None and self.has_relative_bias:
+            position_bias = self._rel_bias(lq, lk, q_offset)
+        # UNSCALED scores (T5: scaling folded into init)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32)
+        if position_bias is not None:
+            scores = scores + position_bias.astype(jnp.float32)
+        neg = jnp.finfo(jnp.float32).min
+        if decode and self.cache_name == "self":
+            valid = jnp.arange(lk)[None, None, None, :] <= (q_offset + jnp.arange(lq))[None, None, :, None]
+            scores = jnp.where(valid, scores, neg)
+        elif not self.bidirectional:
+            causal = jnp.arange(lq)[:, None] >= jnp.arange(lk)[None, :]
+            scores = jnp.where(causal[None, None], scores, neg)
+        if mask is not None:
+            scores = jnp.where(mask, scores, neg)
+        probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        out = nn.DenseGeneral(features=cfg.d_model, axis=(-2, -1), use_bias=False,
+                              dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                              kernel_init=nn.with_logical_partitioning(
+                                  _init(), ("heads", "kv", "embed")),
+                              name="o")(out)
+        return out, position_bias
+
+
+class T5FF(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dense = lambda feat, name, axes: nn.Dense(
+            features=feat, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(_init(), axes), name=name)
+        if cfg.is_gated:
+            # HF "gated-gelu" is NewGELU (tanh approximation)
+            h = jax.nn.gelu(dense(cfg.d_ff, "wi_0", ("embed", "mlp"))(x), approximate=True) \
+                * dense(cfg.d_ff, "wi_1", ("embed", "mlp"))(x)
+        else:
+            h = jax.nn.relu(dense(cfg.d_ff, "wi", ("embed", "mlp"))(x))
+        return dense(cfg.d_model, "wo", ("mlp", "embed"))(h)
+
+
+class T5Block(nn.Module):
+    config: T5Config
+    is_decoder: bool = False
+    has_relative_bias: bool = False
+
+    @nn.compact
+    def __call__(self, x, enc=None, self_bias=None, enc_mask=None, decode: bool = False):
+        cfg = self.config
+        h, self_bias = T5Attention(cfg, has_relative_bias=self.has_relative_bias,
+                                   bidirectional=not self.is_decoder,
+                                   cache_name="self",
+                                   name="SelfAttention")(
+            T5LayerNorm(cfg, name="ln_self")(x), position_bias=self_bias, decode=decode)
+        x = x + h
+        if self.is_decoder:
+            h, _ = T5Attention(cfg, bidirectional=True, cache_name="cross",
+                               name="EncDecAttention")(
+                T5LayerNorm(cfg, name="ln_cross")(x), kv=enc, mask=enc_mask)
+            x = x + h
+        x = x + T5FF(cfg, name="ff")(T5LayerNorm(cfg, name="ln_ff")(x))
+        return x, self_bias
+
+
+class T5Stack(nn.Module):
+    config: T5Config
+    is_decoder: bool = False
+
+    @nn.compact
+    def __call__(self, x, enc=None, enc_mask=None, decode: bool = False):
+        cfg = self.config
+        n = cfg.n_dec_layers if self.is_decoder else cfg.num_layers
+        bias = None
+        block_cls = T5Block
+        if cfg.remat:
+            # decode is arg index 5 of T5Block.__call__ (static python bool)
+            block_cls = nn.remat(T5Block, static_argnums=(5,), prevent_cse=False)
+        for i in range(n):
+            x, bias = block_cls(cfg, self.is_decoder, has_relative_bias=(i == 0),
+                                name=f"block_{i}")(
+                x, enc, bias, enc_mask, decode)
+        return T5LayerNorm(cfg, name="final_layer_norm")(x)
+
+
+class T5ForConditionalGeneration(nn.Module):
+    """Encoder-decoder LM. ``__call__(input_ids, decoder_input_ids)`` →
+    logits; ``decode=True`` runs incremental decoder steps against a cached
+    self-attention state (``encoder_outputs`` must then be supplied)."""
+
+    config: T5Config
+
+    def setup(self):
+        cfg = self.config
+        self.shared = self.param("shared", nn.with_logical_partitioning(_init(), ("vocab", "embed")),
+                                 (cfg.vocab_size, cfg.d_model), cfg.param_dtype)
+        self.encoder = T5Stack(cfg, is_decoder=False, name="encoder")
+        self.decoder = T5Stack(cfg, is_decoder=True, name="decoder")
+        if not cfg.tie_word_embeddings:
+            self.lm_head = nn.Dense(features=cfg.vocab_size, use_bias=False,
+                                    dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                                    kernel_init=nn.with_logical_partitioning(
+                                        _init(), ("embed", "vocab")),
+                                    name="lm_head")
+
+    def _embed(self, ids):
+        w = self.shared.value if isinstance(self.shared, nn.meta.AxisMetadata) else self.shared
+        return jnp.take(w, ids, axis=0).astype(self.config.dtype)
+
+    def _head(self, x):
+        cfg = self.config
+        if cfg.tie_word_embeddings:
+            w = self.shared.value if isinstance(self.shared, nn.meta.AxisMetadata) else self.shared
+            # tied head scales activations by d_model^-0.5 (HF convention)
+            return jnp.einsum("ble,ve->blv", x * (cfg.d_model ** -0.5),
+                              w.astype(cfg.dtype), preferred_element_type=cfg.dtype)
+        return self.lm_head(x)
+
+    def encode(self, input_ids):
+        return self.encoder(self._embed(input_ids))
+
+    def __call__(self, input_ids=None, decoder_input_ids=None, *,
+                 encoder_outputs=None, decode: bool = False, deterministic: bool = True):
+        if encoder_outputs is None:
+            encoder_outputs = self.encode(input_ids)
+        x = self.decoder(self._embed(decoder_input_ids), enc=encoder_outputs, decode=decode)
+        return self._head(x)

@@ -250,11 +250,70 @@ def load_hf_bloom(model_or_sd, cfg) -> dict:
     return params
 
 
+def load_hf_t5(model_or_sd, cfg) -> dict:
+    """HF ``T5ForConditionalGeneration`` → ``models.t5`` params. Attention
+    projections reshape torch [inner, d_model] into [d_model, H, d_kv]
+    (and o into [H, d_kv, d_model]); T5 LayerNorm has weight only."""
+    sd = _sd(model_or_sd)
+    D, H, KV = cfg.d_model, cfg.num_heads, cfg.d_kv
+
+    def attn(prefix, has_rel):
+        out = {
+            "q": {"kernel": jnp.asarray(sd[prefix + ".q.weight"].T.reshape(D, H, KV))},
+            "k": {"kernel": jnp.asarray(sd[prefix + ".k.weight"].T.reshape(D, H, KV))},
+            "v": {"kernel": jnp.asarray(sd[prefix + ".v.weight"].T.reshape(D, H, KV))},
+            "o": {"kernel": jnp.asarray(sd[prefix + ".o.weight"].T.reshape(H, KV, D))},
+        }
+        if has_rel:
+            out["relative_attention_bias"] = jnp.asarray(
+                sd[prefix + ".relative_attention_bias.weight"])
+        return out
+
+    def ff(prefix):
+        if cfg.is_gated:
+            return {"wi_0": {"kernel": jnp.asarray(sd[prefix + ".wi_0.weight"].T)},
+                    "wi_1": {"kernel": jnp.asarray(sd[prefix + ".wi_1.weight"].T)},
+                    "wo": {"kernel": jnp.asarray(sd[prefix + ".wo.weight"].T)}}
+        return {"wi": {"kernel": jnp.asarray(sd[prefix + ".wi.weight"].T)},
+                "wo": {"kernel": jnp.asarray(sd[prefix + ".wo.weight"].T)}}
+
+    def lnw(name):
+        return {"weight": jnp.asarray(sd[name + ".weight"])}
+
+    def stack(side, n_layers, is_decoder):
+        st = {"final_layer_norm": lnw(f"{side}.final_layer_norm")}
+        for i in range(n_layers):
+            p = f"{side}.block.{i}.layer"
+            blk = {
+                "SelfAttention": attn(f"{p}.0.SelfAttention", has_rel=(i == 0)),
+                "ln_self": lnw(f"{p}.0.layer_norm"),
+            }
+            if is_decoder:
+                blk["EncDecAttention"] = attn(f"{p}.1.EncDecAttention", has_rel=False)
+                blk["ln_cross"] = lnw(f"{p}.1.layer_norm")
+                blk["ff"] = ff(f"{p}.2.DenseReluDense")
+                blk["ln_ff"] = lnw(f"{p}.2.layer_norm")
+            else:
+                blk["ff"] = ff(f"{p}.1.DenseReluDense")
+                blk["ln_ff"] = lnw(f"{p}.1.layer_norm")
+            st[f"block_{i}"] = blk
+        return st
+
+    params = {
+        "shared": jnp.asarray(sd["shared.weight"]),
+        "encoder": stack("encoder", cfg.num_layers, False),
+        "decoder": stack("decoder", cfg.n_dec_layers, True),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = {"kernel": jnp.asarray(sd["lm_head.weight"].T)}
+    return params
+
+
 def load_hf_checkpoint(hf_model, arch: str, cfg) -> dict:
     """Dispatch by architecture (reference per-arch policy containers)."""
     loaders = {"gpt2": load_hf_gpt2, "llama": load_hf_llama, "opt": load_hf_opt,
                "gpt_neox": load_hf_gpt_neox, "gptneox": load_hf_gpt_neox,
-               "bloom": load_hf_bloom}
+               "bloom": load_hf_bloom, "t5": load_hf_t5}
     if arch not in loaders:
         raise ValueError(f"no HF converter for architecture {arch!r}; available: {sorted(loaders)}")
     return loaders[arch](hf_model, cfg)

@@ -117,14 +117,14 @@ class DataAnalyzer:
             values = np.asarray([int(ds[i][0]) for i in range(len(ds))])
             order = np.argsort(values, kind="stable")
             s_builder = MMapIndexedDatasetBuilder(self.sample_path(name), dtype=np.int64)
-            uniq = []
-            for v in np.unique(values):
-                ids = order[values[order] == v]
+            # single O(N log N) pass: order is metric-sorted, so rows are
+            # contiguous slices split at the value-change boundaries
+            uniq, counts = np.unique(values, return_counts=True)
+            for ids in np.split(order, np.cumsum(counts)[:-1]):
                 s_builder.add_item(ids.tolist())
-                uniq.append(int(v))
             s_builder.finalize()
             np.save(os.path.join(self._metric_dir(name), "metric_values.npy"),
-                    np.asarray(uniq, np.int64))
+                    uniq.astype(np.int64))
 
     def run_map_reduce(self) -> None:
         """Single-process convenience: every shard then the merge

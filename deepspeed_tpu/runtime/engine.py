@@ -309,14 +309,27 @@ class DeepSpeedEngine:
         from deepspeed_tpu.parallel.topology import set_topology
         set_topology(self.topology)
         example_ids = self._example_ids(example_batch)
+        # extra module inputs (decoder_input_ids, attention_mask, ...) at
+        # batch size 1, matching example_ids — encoder-decoder models need
+        # them present at parameter init
+        def example_extra(v):
+            v = np.asarray(v)
+            if v.ndim >= 3:  # [gas, micro, ...] batches: drop the gas dim
+                v = v[0]
+            return jnp.asarray(v[:1])
+
+        extras = {k: example_extra(v)
+                  for k, v in self._module_kwargs(example_batch).items()
+                  if np.ndim(v) > 0}
 
         def init_params(key):
-            variables = self.module.init(key, example_ids, deterministic=True)
+            variables = self.module.init(key, example_ids, deterministic=True, **extras)
             return nn.meta.unbox(variables["params"])
 
         # the plan needs the BOXED abstract params — flax logical-axis
         # metadata (nn.Partitioned) is what maps params onto mesh axes
-        aboxed = jax.eval_shape(lambda k: self.module.init(k, example_ids, deterministic=True), rng)
+        aboxed = jax.eval_shape(
+            lambda k: self.module.init(k, example_ids, deterministic=True, **extras), rng)
         self.plan = build_plan(aboxed["params"], self.config.zero_config, self.topology)
         param_shardings = self.plan.param_shardings()
         aparams = jax.eval_shape(init_params, rng)
