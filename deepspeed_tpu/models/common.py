@@ -16,14 +16,30 @@ def init_cache(model: nn.Module, batch_size: int, rng=None):
     Uses ``eval_shape`` so no compute runs and the cache index starts at 0
     (``model.init(decode=True)`` would advance it by tracing the call body).
     """
+    import inspect
     ids = jnp.zeros((batch_size, 1), jnp.int32)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
-    shapes = jax.eval_shape(lambda: model.init(rng, ids, decode=True))
+    kwargs = {}
+    try:
+        sig = inspect.signature(type(model).__call__)
+        if "decoder_input_ids" in sig.parameters:  # encoder-decoder models
+            kwargs["decoder_input_ids"] = ids
+    except (TypeError, ValueError):
+        pass
+    shapes = jax.eval_shape(lambda: model.init(rng, ids, decode=True, **kwargs))
     return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
 
 
 def dense_init(scale: float = 0.02):
     return nn.initializers.normal(stddev=scale)
+
+
+def rms_norm(x, weight, eps: float, out_dtype):
+    """Shared RMS-norm core (LLaMA RMSNorm, T5 LayerNorm): fp32 accumulate,
+    scale, cast back."""
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps) * weight.astype(jnp.float32)).astype(out_dtype)
 
 
 _ONEHOT_CHUNK = 1024  # tokens per backward chunk — bounds the one-hot buffer

@@ -76,10 +76,8 @@ class RMSNorm(nn.Module):
         w = self.param("weight", nn.with_logical_partitioning(nn.initializers.ones, ("embed",)),
                        (x.shape[-1],), cfg.param_dtype)
         w = w.value if isinstance(w, nn.meta.AxisMetadata) else w
-        x32 = x.astype(jnp.float32)
-        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
-        out = x32 * jax.lax.rsqrt(var + cfg.rms_norm_eps)
-        return (out * w.astype(jnp.float32)).astype(cfg.dtype)
+        from deepspeed_tpu.models.common import rms_norm
+        return rms_norm(x, w, cfg.rms_norm_eps, cfg.dtype)
 
 
 def rotary_embedding(x, positions, theta: float = 10000.0):

@@ -98,10 +98,8 @@ class T5LayerNorm(nn.Module):
         w = self.param("weight", nn.with_logical_partitioning(nn.initializers.ones, ("embed",)),
                        (x.shape[-1],), cfg.param_dtype)
         w = w.value if isinstance(w, nn.meta.AxisMetadata) else w
-        x32 = x.astype(jnp.float32)
-        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
-        return (x32 * jax.lax.rsqrt(var + cfg.layer_norm_epsilon)
-                * w.astype(jnp.float32)).astype(cfg.dtype)
+        from deepspeed_tpu.models.common import rms_norm
+        return rms_norm(x, w, cfg.layer_norm_epsilon, cfg.dtype)
 
 
 class T5Attention(nn.Module):

@@ -120,8 +120,9 @@ class DataAnalyzer:
             # single O(N log N) pass: order is metric-sorted, so rows are
             # contiguous slices split at the value-change boundaries
             uniq, counts = np.unique(values, return_counts=True)
-            for ids in np.split(order, np.cumsum(counts)[:-1]):
-                s_builder.add_item(ids.tolist())
+            if len(values):  # np.split on empty yields one phantom row
+                for ids in np.split(order, np.cumsum(counts)[:-1]):
+                    s_builder.add_item(ids.tolist())
             s_builder.finalize()
             np.save(os.path.join(self._metric_dir(name), "metric_values.npy"),
                     uniq.astype(np.int64))

@@ -106,7 +106,12 @@ class MMapIndexedDataset:
                                 mode="r", offset=offset, shape=(count,))
         self._pointers = np.memmap(index_file_path(path_prefix), dtype=np.uint64,
                                    mode="r", offset=offset + 4 * count, shape=(count + 1,))
-        self._data = np.memmap(data_file_path(path_prefix), dtype=self._dtype, mode="r")
+        if os.path.getsize(data_file_path(path_prefix)) == 0:
+            # a legitimately empty dataset (e.g. a metric with no samples):
+            # mmap rejects zero-byte files
+            self._data = np.empty((0,), self._dtype)
+        else:
+            self._data = np.memmap(data_file_path(path_prefix), dtype=self._dtype, mode="r")
 
     def __len__(self) -> int:
         return len(self._sizes)

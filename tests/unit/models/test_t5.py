@@ -96,3 +96,15 @@ def test_hf_t5_checkpoint_parity(variant):
     ours = T5ForConditionalGeneration(cfg).apply(
         {"params": params}, jnp.asarray(enc_np, jnp.int32), jnp.asarray(dec_np, jnp.int32))
     np.testing.assert_allclose(np.asarray(ours), ref, atol=3e-4, rtol=3e-3)
+
+
+def test_t5_init_cache_contract():
+    """The zoo-wide init_cache helper must work for encoder-decoder models
+    too (inference engine cache setup depends on it)."""
+    from deepspeed_tpu.models.common import init_cache
+    cfg = get_t5_config("test", max_cache_length=16)
+    m = T5ForConditionalGeneration(cfg)
+    cache = init_cache(m, batch_size=2)
+    k = cache["decoder"]["block_0"]["SelfAttention"]["cached_key"]
+    assert k.shape == (2, 16, cfg.num_heads, cfg.d_kv)
+    assert float(jnp.abs(cache["decoder"]["block_0"]["SelfAttention"]["cache_index"])) == 0
