@@ -218,6 +218,7 @@ def main():
     env["BENCH_SEQ"] = os.environ.get("BENCH_CPU_SEQ", "256")
     env["BENCH_STEPS"] = os.environ.get("BENCH_CPU_STEPS", "3")
     env["BENCH_ATTN"] = "xla"
+    env["BENCH_FUSED_STEPS"] = "1"  # a 10-step scan would blow the CPU budget
     rc, out, err = _run("child", env, cpu_timeout)
     result = _last_json_line(out)
     if rc == 0 and result is not None:

@@ -23,13 +23,27 @@ class PagedKVCache:
     """One layer's K and V pools + the shared allocator state."""
 
     def __init__(self, num_pages: int, page_size: int, num_heads: int, head_dim: int,
-                 num_layers: int = 1, dtype=jnp.bfloat16):
+                 num_layers: int = 1, dtype=jnp.bfloat16, quantize: bool = False):
+        """``quantize=True``: pools store int8 with one bf16 scale per
+        (page, position, head) — the reference's int8 KV path
+        (``inference_context.h`` int8 workspaces + dequant kernels) at 2x
+        the tokens-in-flight per HBM byte; ``gather`` dequantizes on read
+        into the compute dtype."""
         self.num_pages = num_pages
         self.page_size = page_size
         self.num_layers = num_layers
+        self.quantize = quantize
+        self.dtype = dtype
         shape = (num_layers, num_pages, page_size, num_heads, head_dim)
-        self.k_pool = jnp.zeros(shape, dtype)
-        self.v_pool = jnp.zeros(shape, dtype)
+        pool_dtype = jnp.int8 if quantize else dtype
+        self.k_pool = jnp.zeros(shape, pool_dtype)
+        self.v_pool = jnp.zeros(shape, pool_dtype)
+        if quantize:
+            sshape = (num_layers, num_pages, page_size, num_heads, 1)
+            # bf16 scales: same byte cost as fp16 but the fp32 exponent
+            # range, so outlier K/V magnitudes cannot overflow to inf
+            self.k_scale = jnp.zeros(sshape, jnp.bfloat16)
+            self.v_scale = jnp.zeros(sshape, jnp.bfloat16)
         self._free: List[int] = list(range(num_pages - 1, -1, -1))
         self._tables: Dict[int, List[int]] = {}   # seq id -> page list
         self._lengths: Dict[int, int] = {}        # seq id -> tokens used
@@ -40,6 +54,15 @@ class PagedKVCache:
                 pool, vals[None, None].astype(pool.dtype), (layer, page, in_page, 0, 0))
 
         self._write = jax.jit(write, donate_argnums=(0,))
+
+        def quant(vals):
+            # per-(token, head) absmax symmetric int8
+            amax = jnp.max(jnp.abs(vals.astype(jnp.float32)), axis=-1, keepdims=True)
+            scale = jnp.maximum(amax / 127.0, 1e-8)
+            q = jnp.clip(jnp.round(vals.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)
+            return q, scale.astype(jnp.bfloat16)
+
+        self._quant = jax.jit(quant)
 
     # ------------------------------------------------------------------
     # allocator (host side — the reference's workspace bookkeeping)
@@ -88,16 +111,21 @@ class PagedKVCache:
         table = self._tables[seq_id]
         # split the token run across page boundaries; each write is a jitted
         # donated dynamic_update_slice — O(page), never an O(pool) copy
+        if self.quantize:
+            k, k_s = self._quant(k)
+            v, v_s = self._quant(v)
         off = 0
         while off < t:
             page_idx = (start + off) // self.page_size
             in_page = (start + off) % self.page_size
             n = min(self.page_size - in_page, t - off)
             page = table[page_idx]
-            self.k_pool = self._write(self.k_pool, k[off:off + n],
-                                      jnp.int32(layer), jnp.int32(page), jnp.int32(in_page))
-            self.v_pool = self._write(self.v_pool, v[off:off + n],
-                                      jnp.int32(layer), jnp.int32(page), jnp.int32(in_page))
+            args = (jnp.int32(layer), jnp.int32(page), jnp.int32(in_page))
+            self.k_pool = self._write(self.k_pool, k[off:off + n], *args)
+            self.v_pool = self._write(self.v_pool, v[off:off + n], *args)
+            if self.quantize:
+                self.k_scale = self._write(self.k_scale, k_s[off:off + n], *args)
+                self.v_scale = self._write(self.v_scale, v_s[off:off + n], *args)
             off += n
         if layer == self.num_layers - 1:
             self._lengths[seq_id] += t
@@ -116,8 +144,12 @@ class PagedKVCache:
             for j, p in enumerate(self._tables[s][:pages_per]):
                 table[i, j] = p
         # one gather = the block-table lookup: [b, pages_per, page, h, d]
-        k = jnp.take(self.k_pool[layer], jnp.asarray(table), axis=0)
-        v = jnp.take(self.v_pool[layer], jnp.asarray(table), axis=0)
+        tbl = jnp.asarray(table)
+        k = jnp.take(self.k_pool[layer], tbl, axis=0)
+        v = jnp.take(self.v_pool[layer], tbl, axis=0)
+        if self.quantize:
+            k = k.astype(self.dtype) * jnp.take(self.k_scale[layer], tbl, axis=0).astype(self.dtype)
+            v = v.astype(self.dtype) * jnp.take(self.v_scale[layer], tbl, axis=0).astype(self.dtype)
         b = len(seq_ids)
         k = k.reshape(b, pages_per * self.page_size, *k.shape[3:])[:, :L]
         v = v.reshape(b, pages_per * self.page_size, *v.shape[3:])[:, :L]

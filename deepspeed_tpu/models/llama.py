@@ -21,7 +21,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.models.common import config_from, dense_init as _init, normalize_padding_mask
+from deepspeed_tpu.models.common import (config_from, dense_init as _init,
+                                         normalize_padding_mask, rms_norm)
 from deepspeed_tpu.ops.transformer.attention import dot_product_attention
 
 
@@ -76,7 +77,6 @@ class RMSNorm(nn.Module):
         w = self.param("weight", nn.with_logical_partitioning(nn.initializers.ones, ("embed",)),
                        (x.shape[-1],), cfg.param_dtype)
         w = w.value if isinstance(w, nn.meta.AxisMetadata) else w
-        from deepspeed_tpu.models.common import rms_norm
         return rms_norm(x, w, cfg.rms_norm_eps, cfg.dtype)
 
 

@@ -23,7 +23,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.models.common import config_from, dense_init as _init
+from deepspeed_tpu.models.common import config_from, dense_init as _init, rms_norm
 
 
 @dataclasses.dataclass(frozen=True)
@@ -98,7 +98,6 @@ class T5LayerNorm(nn.Module):
         w = self.param("weight", nn.with_logical_partitioning(nn.initializers.ones, ("embed",)),
                        (x.shape[-1],), cfg.param_dtype)
         w = w.value if isinstance(w, nn.meta.AxisMetadata) else w
-        from deepspeed_tpu.models.common import rms_norm
         return rms_norm(x, w, cfg.layer_norm_epsilon, cfg.dtype)
 
 

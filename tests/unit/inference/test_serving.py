@@ -133,3 +133,28 @@ def test_paged_gather_pad_bucket():
     assert k.shape == (2, 12, 1, 2)
     assert lens.tolist() == [3, 7]
     np.testing.assert_allclose(np.asarray(k[1, :7]), 2.0)
+
+
+def test_paged_int8_quantized_pool_roundtrip():
+    """quantize=True stores int8 + fp16 scales (half the KV bytes); gather
+    dequantizes within int8 tolerance of the fp pool."""
+    from deepspeed_tpu.inference.paged_kv import PagedKVCache
+    rng = np.random.default_rng(0)
+    kw = dict(num_pages=8, page_size=4, num_heads=2, head_dim=8, num_layers=2)
+    ref = PagedKVCache(dtype=jnp.float32, **kw)
+    q8 = PagedKVCache(dtype=jnp.float32, quantize=True, **kw)
+    assert q8.k_pool.dtype == jnp.int8
+    for cache in (ref, q8):
+        cache.allocate(0)
+    k = jnp.asarray(rng.standard_normal((6, 2, 8)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((6, 2, 8)), jnp.float32)
+    for layer in range(2):
+        ref.append(0, k, v, layer=layer)
+        q8.append(0, k, v, layer=layer)
+    kr, vr, lr = ref.gather([0], layer=1)
+    kq, vq, lq = q8.gather([0], layer=1)
+    assert int(lr[0]) == int(lq[0]) == 6
+    # int8 absmax quant: error bounded by scale/2 = amax/254
+    tol = float(jnp.abs(k).max()) / 127
+    np.testing.assert_allclose(np.asarray(kq[0, :6]), np.asarray(kr[0, :6]), atol=tol)
+    np.testing.assert_allclose(np.asarray(vq[0, :6]), np.asarray(vr[0, :6]), atol=tol)
