@@ -56,11 +56,14 @@ class PagedKVCache:
         self._write = jax.jit(write, donate_argnums=(0,))
 
         def quant(vals):
-            # per-(token, head) absmax symmetric int8
-            amax = jnp.max(jnp.abs(vals.astype(jnp.float32)), axis=-1, keepdims=True)
-            scale = jnp.maximum(amax / 127.0, 1e-8)
-            q = jnp.clip(jnp.round(vals.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)
-            return q, scale.astype(jnp.bfloat16)
+            # per-(token, head) groups through the shared quantizer library
+            # (ops/quantizer/core.quantize — one int8 implementation repo-wide)
+            from deepspeed_tpu.ops.quantizer.core import quantize as core_quantize
+            t, h, d = vals.shape
+            q, params = core_quantize(vals, num_bits=8, symmetric=True,
+                                      num_groups=t * h)
+            return (q.reshape(t, h, d),
+                    params.scale.reshape(t, h, 1).astype(jnp.bfloat16))
 
         self._quant = jax.jit(quant)
 

@@ -1,0 +1,39 @@
+"""CLI entry-point smoke tests (reference ``bin/deepspeed`` etc. — the
+launcher surface a reference user touches first). Each CLI must at least
+parse ``--help`` and exit 0 in a CPU-pinned subprocess."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+
+def _run(args, timeout=120):
+    sys.path.insert(0, REPO) if REPO not in sys.path else None
+    from envutil import cpu_subprocess_env
+    return subprocess.run([sys.executable] + args, capture_output=True, text=True,
+                          timeout=timeout, cwd=REPO, env=cpu_subprocess_env())
+
+
+@pytest.mark.parametrize("cli", ["deepspeed", "ds_elastic", "zero_to_fp32"])
+def test_cli_help_exits_zero(cli):
+    p = _run([os.path.join(REPO, "bin", cli), "--help"])
+    assert p.returncode == 0, p.stderr[-500:]
+    assert "usage" in (p.stdout + p.stderr).lower()
+
+
+def test_ds_report_runs():
+    p = _run([os.path.join(REPO, "bin", "ds_report")], timeout=240)
+    assert p.returncode == 0, p.stderr[-500:]
+    out = p.stdout
+    assert "op builder compatibility" in out and "cpu_adam" in out
+
+
+def test_launcher_node_rank_inference_help():
+    from deepspeed_tpu.launcher.launch import parse_args
+    args = parse_args(["--nnodes", "2", "--bind_cores_to_rank", "train.py", "--x", "1"])
+    assert args.nnodes == 2 and args.user_script == "train.py"
+    assert args.user_args == ["--x", "1"]
