@@ -110,6 +110,7 @@ class DeepSpeedEngine:
         self.global_steps = 0
         self.global_samples = 0
         self.micro_steps = 0
+        self._pending_overflow = []  # deferred (step, overflow, loss_scale)
         self.skipped_steps = 0
         self._initial_params = model_parameters
         self.state: Optional[TrainState] = None
@@ -1352,14 +1353,22 @@ class DeepSpeedEngine:
         # "grad_norm" carries the compressed-update norm instead (the step
         # functions also emit it under the explicit key) — reference 1-bit
         # Adam simply stops reporting; we keep the series with changed meaning
+        #
+        # NO eager float()/bool() on per-step metrics here: a host conversion
+        # blocks on the step's completion, serializing dispatch (the next
+        # step cannot be enqueued behind a host sync). Device arrays are
+        # stashed and resolved lazily — in accessors, at steps_per_print
+        # boundaries, or when the pending-overflow window fills.
         if "compressed_update_norm" in metrics:
-            self._last_compressed_update_norm = float(metrics["compressed_update_norm"])
+            self._last_compressed_update_norm = metrics["compressed_update_norm"]
         if "grad_norm" in metrics:
-            self._last_grad_norm = float(metrics["grad_norm"])
-        if bool(metrics.get("overflow", False)):
-            self.skipped_steps += 1
-            log_dist(f"step {self.global_steps} overflow: skipping update, "
-                     f"loss scale -> {float(metrics['loss_scale'])}")
+            self._last_grad_norm = metrics["grad_norm"]
+        ov = metrics.get("overflow")
+        if ov is not None:
+            self._pending_overflow.append((self.global_steps, ov, metrics.get("loss_scale")))
+        if (len(self._pending_overflow) >= 16
+                or self.global_steps % self.config.steps_per_print == 0):
+            self._drain_overflows()
         if self.monitor.enabled and self.global_steps % self.config.steps_per_print == 0:
             events = [(f"Train/loss", float(metrics.get("loss", 0.0)), self.global_samples),
                       (f"Train/lr", self.get_lr()[0], self.global_samples)]
@@ -1391,7 +1400,31 @@ class DeepSpeedEngine:
         return [params.get("lr", 1e-3)]
 
     def get_global_grad_norm(self):
-        return getattr(self, "_last_grad_norm", None)
+        gn = getattr(self, "_last_grad_norm", None)
+        return None if gn is None else float(gn)
+
+    def _drain_overflows(self):
+        """Resolve deferred per-step overflow flags (host sync happens HERE,
+        off the dispatch critical path)."""
+        pending, self._pending_overflow = self._pending_overflow, []
+        for step, ov, ls in pending:
+            if bool(ov):
+                self._skipped_steps += 1
+                ls_txt = f", loss scale -> {float(ls)}" if ls is not None else ""
+                log_dist(f"step {step} overflow: skipped update{ls_txt}")
+
+    @property
+    def skipped_steps(self) -> int:
+        self._drain_overflows()
+        return self._skipped_steps
+
+    @skipped_steps.setter
+    def skipped_steps(self, value: int):
+        # assigning the counter (init, checkpoint load) abandons any
+        # not-yet-drained flags from the previous timeline — they must not
+        # leak into the restored count
+        self._pending_overflow = []
+        self._skipped_steps = int(value)
 
     @property
     def module_params(self):
