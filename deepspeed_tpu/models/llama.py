@@ -41,6 +41,15 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32
     remat: bool = False
     attention_backend: str = "xla"
+    # Mixtral-style sparse MoE FFN (reference GPT-MoE wiring; MoE every
+    # moe_layer_freq-th layer replaces the SwiGLU MLP with experts)
+    moe_num_experts: int = 0  # 0 = dense
+    moe_layer_freq: int = 1   # Mixtral: every layer
+    moe_k: int = 2            # Mixtral: top-2
+    moe_capacity_factor: float = 1.25
+    moe_eval_capacity_factor: float = 2.0  # serving must not under-provision vs training
+    moe_min_capacity: int = 4
+    moe_aux_loss_coef: float = 0.01
 
     @property
     def head_dim(self):
@@ -58,6 +67,14 @@ LLAMA_CONFIGS = {
                num_attention_heads=32, num_key_value_heads=32),
     "13b": dict(hidden_size=5120, intermediate_size=13824, num_hidden_layers=40,
                 num_attention_heads=40, num_key_value_heads=40),
+    # Mixtral-8x7B shape: llama blocks, top-2 of 8 SwiGLU experts per layer
+    "mixtral-8x7b": dict(hidden_size=4096, intermediate_size=14336, num_hidden_layers=32,
+                         num_attention_heads=32, num_key_value_heads=8,
+                         max_position_embeddings=4096, rope_theta=1e6,
+                         moe_num_experts=8, moe_k=2),
+    "mixtral-test": dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                         num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+                         max_position_embeddings=128, moe_num_experts=4, moe_k=2),
 }
 
 
@@ -185,15 +202,28 @@ class LlamaMLP(nn.Module):
 
 class LlamaDecoderLayer(nn.Module):
     config: LlamaConfig
+    use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x, positions=None, decode: bool = False, attention_mask=None):
+    def __call__(self, x, positions=None, decode: bool = False, attention_mask=None,
+                 deterministic: bool = True):
         cfg = self.config
         x = x + LlamaAttention(cfg, name="self_attn")(
             RMSNorm(cfg, name="input_layernorm")(x), positions, decode=decode,
             attention_mask=attention_mask)
-        x = x + LlamaMLP(cfg, name="mlp")(RMSNorm(cfg, name="post_attention_layernorm")(x))
-        return x
+        h = RMSNorm(cfg, name="post_attention_layernorm")(x)
+        if self.use_moe:
+            from deepspeed_tpu.moe import MoE
+            moe_out, l_aux, _ = MoE(hidden_size=cfg.hidden_size,
+                                    expert=LlamaMLP(cfg),
+                                    num_experts=cfg.moe_num_experts,
+                                    k=cfg.moe_k,
+                                    capacity_factor=cfg.moe_capacity_factor,
+                                    eval_capacity_factor=cfg.moe_eval_capacity_factor,
+                                    min_capacity=cfg.moe_min_capacity,
+                                    name="moe")(h, deterministic=deterministic)
+            return x + moe_out, l_aux
+        return x + LlamaMLP(cfg, name="mlp")(h), jnp.zeros([], jnp.float32)
 
 
 from deepspeed_tpu.models.common import init_cache  # noqa: E402  (re-export)
@@ -219,13 +249,20 @@ class LlamaForCausalLM(nn.Module):
 
         layer_cls = LlamaDecoderLayer
         if cfg.remat and not decode:
-            layer_cls = nn.remat(LlamaDecoderLayer, static_argnums=(3,), prevent_cse=False)
+            layer_cls = nn.remat(LlamaDecoderLayer, static_argnums=(3, 5), prevent_cse=False)
+        aux_total = jnp.zeros([], jnp.float32)
         for i in range(cfg.num_hidden_layers):
-            x = layer_cls(cfg, name=f"layers_{i}")(x, positions, decode, attention_mask)
+            use_moe = (cfg.moe_num_experts > 0
+                       and i % max(cfg.moe_layer_freq, 1) == max(cfg.moe_layer_freq, 1) - 1)
+            x, l_aux = layer_cls(cfg, use_moe, name=f"layers_{i}")(
+                x, positions, decode, attention_mask, deterministic)
+            aux_total = aux_total + l_aux
         x = RMSNorm(cfg, name="norm")(x)
         # logits at compute dtype: the loss reduces in fp32 (PERF.md #2)
         logits = nn.Dense(features=cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                           param_dtype=cfg.param_dtype,
                           kernel_init=nn.with_logical_partitioning(_init(), ("embed", "vocab")),
                           name="lm_head")(x)
+        if cfg.moe_num_experts > 0:
+            return logits, aux_total * cfg.moe_aux_loss_coef
         return logits

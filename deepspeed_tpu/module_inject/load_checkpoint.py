@@ -101,10 +101,12 @@ def load_hf_llama(model_or_sd, cfg) -> dict:
         "lm_head": {"kernel": lin_t("lm_head.weight") if "lm_head.weight" in sd
                     else jnp.asarray(sd[f"{pre}embed_tokens.weight"].T)},
     }
+    n_experts = getattr(cfg, "moe_num_experts", 0)
+    freq = max(getattr(cfg, "moe_layer_freq", 1), 1)
     for i in range(cfg.num_hidden_layers):
         p = f"{pre}layers.{i}."
         o_w = jnp.asarray(sd[p + "self_attn.o_proj.weight"].T.reshape(H, D, E))
-        params[f"layers_{i}"] = {
+        layer = {
             "input_layernorm": {"weight": jnp.asarray(sd[p + "input_layernorm.weight"])},
             "post_attention_layernorm": {"weight": jnp.asarray(sd[p + "post_attention_layernorm.weight"])},
             "self_attn": {
@@ -113,12 +115,31 @@ def load_hf_llama(model_or_sd, cfg) -> dict:
                 "v_proj": {"kernel": heads_t(p + "self_attn.v_proj.weight", KV)},
                 "o_proj": {"kernel": o_w},
             },
-            "mlp": {
+        }
+        is_moe_layer = n_experts > 0 and i % freq == freq - 1
+        if is_moe_layer:
+            # Mixtral checkpoints: block_sparse_moe.gate + experts.N.{w1,w3,w2}
+            # (w1=gate_proj, w3=up_proj, w2=down_proj); experts stack on a
+            # leading dim matching the vmapped expert layout
+            bs = p + "block_sparse_moe."
+            stack = lambda name: jnp.stack(
+                [jnp.asarray(sd[f"{bs}experts.{n}.{name}.weight"].T)
+                 for n in range(n_experts)])
+            layer["moe"] = {"deepspeed_moe": {
+                "gate": {"wg": jnp.asarray(sd[bs + "gate.weight"].T)},
+                "experts": {"deepspeed_experts": {
+                    "gate_proj": {"kernel": stack("w1")},
+                    "up_proj": {"kernel": stack("w3")},
+                    "down_proj": {"kernel": stack("w2")},
+                }},
+            }}
+        else:
+            layer["mlp"] = {
                 "gate_proj": {"kernel": lin_t(p + "mlp.gate_proj.weight")},
                 "up_proj": {"kernel": lin_t(p + "mlp.up_proj.weight")},
                 "down_proj": {"kernel": lin_t(p + "mlp.down_proj.weight")},
-            },
-        }
+            }
+        params[f"layers_{i}"] = layer
     return params
 
 

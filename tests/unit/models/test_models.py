@@ -146,3 +146,64 @@ def test_gpt2_embed_onehot_grad_trains_identically():
         return losses
 
     np.testing.assert_allclose(train(True), train(False), atol=1e-4)
+
+
+def test_mixtral_style_llama_moe_trains_and_serves():
+    """Mixtral shape: llama blocks with top-2-of-N expert FFNs. Trains under
+    the engine (aux loss plumbed), serves through init_inference."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import LlamaForCausalLM, get_llama_config
+    cfg = get_llama_config("mixtral-test")
+    assert cfg.moe_num_experts == 4 and cfg.moe_k == 2
+    engine, _, _, _ = deepspeed_tpu.initialize(model=LlamaForCausalLM(cfg), config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+    })
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (8, 32)).astype(np.int32)}
+    engine.initialize_state(batch)
+    losses = [float(engine.train_batch(batch)) for _ in range(5)]
+    assert losses[-1] < losses[0], losses
+
+    params = jax.device_get(engine.state.params)
+    ie = deepspeed_tpu.init_inference(LlamaForCausalLM(cfg), config={"dtype": "fp32"},
+                                     params=params)
+    out = ie.generate(batch["input_ids"][:2, :8], max_new_tokens=3)
+    assert out.shape == (2, 11) and np.isfinite(np.asarray(out)).all()
+
+
+def test_mixtral_hf_checkpoint_converts():
+    """HF Mixtral checkpoints (block_sparse_moe.{gate,experts.N.w1/w2/w3})
+    map onto the llama-MoE param tree: structure matches init exactly and
+    the converted model runs finite logits. (Exact logits parity is not
+    asserted: HF routes dense top-2 while ours uses capacity-based GShard
+    dispatch — same experts, different overflow handling.)"""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    if not hasattr(transformers, "MixtralForCausalLM"):
+        pytest.skip("transformers too old for Mixtral")
+    from deepspeed_tpu.models import LlamaForCausalLM, get_llama_config
+    from deepspeed_tpu.module_inject import load_hf_llama
+
+    hf_cfg = transformers.MixtralConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                                        num_hidden_layers=2, num_attention_heads=4,
+                                        num_key_value_heads=2, max_position_embeddings=64,
+                                        num_local_experts=4, num_experts_per_tok=2,
+                                        attention_dropout=0.0)
+    hf = transformers.MixtralForCausalLM(hf_cfg).eval()
+    cfg = get_llama_config("mixtral-test", vocab_size=128, hidden_size=32,
+                           intermediate_size=64, num_hidden_layers=2,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           max_position_embeddings=64, moe_num_experts=4, moe_k=2)
+    params = load_hf_llama(hf, cfg)
+
+    model = LlamaForCausalLM(cfg)
+    ids = jnp.zeros((2, 8), jnp.int32)
+    from flax.core import meta
+    ref_tree = jax.tree_util.tree_structure(
+        meta.unbox(model.init(jax.random.PRNGKey(0), ids)["params"]))
+    got_tree = jax.tree_util.tree_structure(params)
+    assert ref_tree == got_tree, f"param tree mismatch:\n{ref_tree}\nvs\n{got_tree}"
+    logits, aux = model.apply({"params": params}, ids)
+    assert logits.shape == (2, 8, 128) and np.isfinite(np.asarray(logits)).all()
