@@ -181,18 +181,16 @@ class PipelineEngine(DeepSpeedEngine):
             grads = jax.tree.map(lambda g: g / scale, grads)
             grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
 
-            overflow = has_overflow(grads) if fp16 else jnp.zeros([], bool)
             gnorm = _global_norm(grads)
+            # every dtype mode skips on non-finite grads (a bf16/fp32 inf/nan
+            # would silently poison params), matching the base engine
+            overflow = has_overflow(grads) if fp16 else ~jnp.isfinite(gnorm)
             if clip > 0:
                 factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
                 grads = jax.tree.map(lambda g: g * factor, grads)
 
-            updates, new_opt = self.optimizer.update(grads, state.opt_state, state.params)
-            new_params = optax.apply_updates(state.params, updates)
-            if fp16:
-                keep = lambda new, old: jax.tree.map(lambda n, o: jnp.where(overflow, o, n), new, old)
-                new_params = keep(new_params, state.params)
-                new_opt = keep(new_opt, state.opt_state)
+            new_params, new_opt = self._cond_apply_updates(
+                overflow, grads, state.opt_state, state.params)
             new_ls = self._ls_update(state.loss_scale, overflow)
             new_state = TrainState(step=state.step + 1, params=new_params, opt_state=new_opt, loss_scale=new_ls)
             metrics = {"loss": loss, "grad_norm": gnorm, "overflow": overflow,
