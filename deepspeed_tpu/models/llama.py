@@ -41,6 +41,7 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32
     remat: bool = False
     attention_backend: str = "xla"
+    attention_bias: bool = False  # Qwen2-style biased q/k/v projections
     # Mixtral-style sparse MoE FFN (reference GPT-MoE wiring; MoE every
     # moe_layer_freq-th layer replaces the SwiGLU MLP with experts)
     moe_num_experts: int = 0  # 0 = dense
@@ -75,6 +76,10 @@ LLAMA_CONFIGS = {
     "mixtral-test": dict(vocab_size=256, hidden_size=64, intermediate_size=128,
                          num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
                          max_position_embeddings=128, moe_num_experts=4, moe_k=2),
+    # Qwen2 family: llama architecture + biased q/k/v projections
+    "qwen2-7b": dict(vocab_size=152064, hidden_size=3584, intermediate_size=18944,
+                     num_hidden_layers=28, num_attention_heads=28, num_key_value_heads=4,
+                     max_position_embeddings=32768, rope_theta=1e6, attention_bias=True),
 }
 
 
@@ -122,9 +127,14 @@ class LlamaAttention(nn.Module):
         n_rep = cfg.num_attention_heads // cfg.num_key_value_heads
 
         def proj(heads, name):
-            return nn.DenseGeneral(features=(heads, cfg.head_dim), axis=-1, use_bias=False,
+            # q/k/v projections only (o_proj is built separately, always
+            # bias-free); Qwen2-style configs bias these three
+            return nn.DenseGeneral(features=(heads, cfg.head_dim), axis=-1,
+                                   use_bias=cfg.attention_bias,
                                    dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                                    kernel_init=nn.with_logical_partitioning(_init(), ("embed", "heads", "kv")),
+                                   bias_init=nn.with_logical_partitioning(nn.initializers.zeros,
+                                                                          ("heads", "kv")),
                                    name=name)
 
         q = proj(cfg.num_attention_heads, "q_proj")(x)

@@ -91,8 +91,20 @@ def load_hf_llama(model_or_sd, cfg) -> dict:
     def lin_t(name):  # [out, in] -> [in, out]
         return jnp.asarray(sd[name].T)
 
+    bias_attn = bool(getattr(cfg, "attention_bias", False))
+    has_bias_keys = any(k.endswith("self_attn.q_proj.bias") for k in sd)
+    if has_bias_keys != bias_attn:
+        raise ValueError(
+            f"checkpoint {'has' if has_bias_keys else 'lacks'} attention biases but "
+            f"cfg.attention_bias={bias_attn} — silently "
+            f"{'dropping biases would corrupt logits' if has_bias_keys else 'inventing zero biases is unsupported'}; "
+            f"set attention_bias={has_bias_keys} (Qwen2-style checkpoints carry q/k/v biases)")
+
     def heads_t(name, heads):  # [heads*D, in] -> [in, heads, D]
-        return jnp.asarray(sd[name].T.reshape(E, heads, D))
+        out = {"kernel": jnp.asarray(sd[name + ".weight"].T.reshape(E, heads, D))}
+        if bias_attn:
+            out["bias"] = jnp.asarray(sd[name + ".bias"].reshape(heads, D))
+        return out
 
     params = {
         "embed_tokens": jnp.asarray(sd[f"{pre}embed_tokens.weight"]),
@@ -110,9 +122,9 @@ def load_hf_llama(model_or_sd, cfg) -> dict:
             "input_layernorm": {"weight": jnp.asarray(sd[p + "input_layernorm.weight"])},
             "post_attention_layernorm": {"weight": jnp.asarray(sd[p + "post_attention_layernorm.weight"])},
             "self_attn": {
-                "q_proj": {"kernel": heads_t(p + "self_attn.q_proj.weight", H)},
-                "k_proj": {"kernel": heads_t(p + "self_attn.k_proj.weight", KV)},
-                "v_proj": {"kernel": heads_t(p + "self_attn.v_proj.weight", KV)},
+                "q_proj": heads_t(p + "self_attn.q_proj", H),
+                "k_proj": heads_t(p + "self_attn.k_proj", KV),
+                "v_proj": heads_t(p + "self_attn.v_proj", KV),
                 "o_proj": {"kernel": o_w},
             },
         }

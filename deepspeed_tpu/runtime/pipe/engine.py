@@ -25,7 +25,6 @@ Here the whole schedule collapses into ONE differentiable ``lax.scan``:
 
 import jax
 import jax.numpy as jnp
-import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.parallel.topology import PIPE_AXIS

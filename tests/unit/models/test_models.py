@@ -207,3 +207,28 @@ def test_mixtral_hf_checkpoint_converts():
     assert ref_tree == got_tree, f"param tree mismatch:\n{ref_tree}\nvs\n{got_tree}"
     logits, aux = model.apply({"params": params}, ids)
     assert logits.shape == (2, 8, 128) and np.isfinite(np.asarray(logits)).all()
+
+
+def test_qwen2_hf_checkpoint_parity():
+    """Qwen2 = llama + biased q/k/v: converted logits match HF torch."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    if not hasattr(transformers, "Qwen2ForCausalLM"):
+        pytest.skip("transformers too old for Qwen2")
+    from deepspeed_tpu.models import LlamaForCausalLM, get_llama_config
+    from deepspeed_tpu.module_inject import load_hf_llama
+
+    hf_cfg = transformers.Qwen2Config(vocab_size=128, hidden_size=32, intermediate_size=64,
+                                      num_hidden_layers=2, num_attention_heads=4,
+                                      num_key_value_heads=2, max_position_embeddings=64,
+                                      attention_dropout=0.0, tie_word_embeddings=False)
+    hf = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+    cfg = get_llama_config("test", vocab_size=128, hidden_size=32, intermediate_size=64,
+                           num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+                           max_position_embeddings=64, attention_bias=True)
+    params = load_hf_llama(hf, cfg)
+    ids = np.random.default_rng(3).integers(0, 128, (2, 10))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    ours = LlamaForCausalLM(cfg).apply({"params": params}, jnp.asarray(ids, jnp.int32))
+    np.testing.assert_allclose(np.asarray(ours), ref, atol=3e-4, rtol=3e-3)
