@@ -13,8 +13,6 @@ last-NCHW like the reference binding ([B, C, H, W] logical); the bias is
 [C] and broadcasts over the spatial dims in both cases.
 """
 
-from typing import Optional
-
 import jax.numpy as jnp
 
 

@@ -18,7 +18,7 @@ with.
 """
 
 import dataclasses
-from typing import Any, Optional
+from typing import Any
 
 import flax.linen as nn
 import jax

@@ -15,7 +15,7 @@ exposes the full values of sharded params (jax assembles shards on read).
 """
 
 import contextlib
-from typing import Any, Optional
+from typing import Optional
 
 import jax
 import numpy as np
