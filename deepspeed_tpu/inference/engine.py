@@ -103,6 +103,8 @@ class InferenceEngine:
         self._forward_fn = None
         self._prefill_fn = None
         self._decode_fn = None
+        from deepspeed_tpu.models.common import is_seq2seq_module
+        self._is_seq2seq = is_seq2seq_module(self.module)
         self._max_len = self._model_max_len()
         log_dist(f"InferenceEngine: tp={topology.tensor_parallel_size} "
                  f"dtype={getattr(config.dtype, '__name__', 'model-default')} max_len={self._max_len}")
@@ -281,6 +283,95 @@ class InferenceEngine:
         return {"replicate": jax.jit(replicate),
                 "loop": jax.jit(beam_loop, donate_argnums=(1,))}
 
+    def _build_seq2seq_serving(self, batch, do_sample, temperature, top_k, top_p,
+                               eos_token_id, cap):
+        """Encoder-decoder serving (T5-style): encode once, then a jitted
+        decoder while_loop against the self-attention cache, cross-attending
+        the encoder output every step."""
+        model = self.module
+        eos = -1 if eos_token_id is None else int(eos_token_id)
+
+        def encode(params, enc_ids):
+            return model.apply({"params": params}, enc_ids, method=type(model).encode)
+
+        def step(params, cache, enc_out, tok):
+            logits, upd = model.apply({"params": params, "cache": cache},
+                                      decoder_input_ids=tok, encoder_outputs=enc_out,
+                                      decode=True, mutable=["cache"])
+            return _unwrap_logits(logits), upd["cache"]
+
+        def gen_loop(params, cache, enc_out, start_tok, rng, max_new):
+            logits, cache = step(params, cache, enc_out, start_tok)
+            rng, key = jax.random.split(rng)
+            tok = sample_logits(logits[:, -1], key, do_sample, temperature,
+                                top_k, top_p).astype(jnp.int32)
+            out0 = jnp.zeros((batch, cap), jnp.int32).at[:, 0].set(tok)
+            done0 = tok == eos
+
+            def cond(state):
+                t, done, *_ = state
+                return (t < max_new) & ~jnp.all(done)
+
+            def body(state):
+                t, done, tok, cache, out, rng = state
+                logits, cache = step(params, cache, enc_out, tok[:, None])
+                rng, key = jax.random.split(rng)
+                nxt = sample_logits(logits[:, 0], key, do_sample, temperature,
+                                    top_k, top_p).astype(jnp.int32)
+                nxt = jnp.where(done, eos if eos >= 0 else 0, nxt)
+                out = out.at[:, t].set(nxt)
+                done = done | (nxt == eos)
+                return t + 1, done, nxt, cache, out, rng
+
+            t, done, tok, cache, out, rng = jax.lax.while_loop(
+                cond, body, (jnp.int32(1), done0, tok, cache, out0, rng))
+            return out, t, cache
+
+        return {"encode": jax.jit(encode),
+                "gen_loop": jax.jit(gen_loop, donate_argnums=(1,))}
+
+    def _generate_seq2seq(self, ids_np, real_batch, batch, max_new, do_sample,
+                          temperature, top_k, top_p, eos_token_id, rng,
+                          decoder_start_token_id):
+        mcap = getattr(self.mcfg, "max_cache_length", None) or self._max_len
+        # cache slots consumed = max_new (the start token plus the max_new-1
+        # fed-back tokens; the final sample is never fed back)
+        if max_new > mcap:
+            raise ValueError(f"max_new_tokens ({max_new}) exceeds the decoder cache "
+                             f"capacity {mcap} (max_cache_length)")
+        if max_new > int(self.config.max_tokens or mcap):
+            raise ValueError(f"max_new_tokens ({max_new}) exceeds the configured output "
+                             f"budget max_tokens={self.config.max_tokens}; raise it in "
+                             f"the inference config (silently truncating would hide the miss)")
+        cap = int(min(mcap, self.config.max_tokens or mcap))
+        key = ("seq2seq", batch, do_sample, float(temperature), int(top_k),
+               float(top_p), eos_token_id)
+        if not hasattr(self, "_gen_cache"):
+            self._gen_cache = {}
+        if key not in self._gen_cache:
+            self._gen_cache[key] = self._build_seq2seq_serving(
+                batch, do_sample, temperature, top_k, top_p, eos_token_id, cap)
+        fns = self._gen_cache[key]
+        start = jnp.full((batch, 1), int(decoder_start_token_id), jnp.int32)
+        if max_new <= 0:  # parity with the decoder-only path's no-op return
+            return np.broadcast_to(np.int32(decoder_start_token_id), (real_batch, 1))
+        # NOTE: the encoder runs at the exact prompt length (no padding —
+        # the encode() surface carries no padding mask, and padded tokens
+        # would perturb bidirectional attention); one compile per length.
+        # The encoder program is sampling-independent: cached per batch only
+        if not hasattr(self, "_enc_cache"):
+            self._enc_cache = {}
+        if batch not in self._enc_cache:
+            self._enc_cache[batch] = fns["encode"]
+        enc_out = self._enc_cache[batch](self.params, self._place_batch(jnp.asarray(ids_np)))
+        cache = jax.device_put(init_cache(self.module, batch),
+                               NamedSharding(self.mesh, P()))
+        out, n, _ = fns["gen_loop"](self.params, cache, enc_out, start, rng,
+                                    jnp.int32(min(max_new, cap)))
+        n = int(n)
+        full = jnp.concatenate([start, out[:, :n]], axis=1)
+        return full[:real_batch]
+
     @staticmethod
     def _pow2_bucket(n: int) -> int:
         b = 1
@@ -302,6 +393,20 @@ class InferenceEngine:
         ids_np = np.asarray(input_ids, np.int32)
         real_batch, prompt_len = ids_np.shape
         max_new = int(max_new_tokens if max_new_tokens is not None else self.config.max_new_tokens)
+        if self._is_seq2seq:
+            if num_beams > 1:
+                raise NotImplementedError("beam search for encoder-decoder serving "
+                                          "is not implemented; use greedy/sampling")
+            batch = self._pow2_bucket(real_batch)
+            if batch != real_batch:
+                ids_np = np.concatenate(
+                    [ids_np, np.repeat(ids_np[:1], batch - real_batch, axis=0)], axis=0)
+            if rng is None:
+                self._rng, rng = jax.random.split(self._rng)
+            return self._generate_seq2seq(
+                ids_np, real_batch, batch, max_new, do_sample, temperature, top_k,
+                top_p, eos_token_id, rng,
+                kwargs.get("decoder_start_token_id", 0))
         if prompt_len + max_new > self._max_len:
             raise ValueError(f"prompt ({prompt_len}) + max_new_tokens ({max_new}) exceeds the model "
                              f"context/cache length {self._max_len} "

@@ -8,6 +8,17 @@ import jax.numpy as jnp
 import flax.linen as nn
 
 
+def is_seq2seq_module(model: nn.Module) -> bool:
+    """True when the module's __call__ takes decoder_input_ids (encoder-
+    decoder models such as T5) — shared probe for init_cache and the
+    inference engine so the two can never disagree."""
+    import inspect
+    try:
+        return "decoder_input_ids" in inspect.signature(type(model).__call__).parameters
+    except (TypeError, ValueError):
+        return False
+
+
 def init_cache(model: nn.Module, batch_size: int, rng=None):
     """Build a zeroed decode cache for any model supporting ``decode=True``
     (the reference's ``allocate_workspace`` KV-cache setup,
@@ -16,16 +27,9 @@ def init_cache(model: nn.Module, batch_size: int, rng=None):
     Uses ``eval_shape`` so no compute runs and the cache index starts at 0
     (``model.init(decode=True)`` would advance it by tracing the call body).
     """
-    import inspect
     ids = jnp.zeros((batch_size, 1), jnp.int32)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
-    kwargs = {}
-    try:
-        sig = inspect.signature(type(model).__call__)
-        if "decoder_input_ids" in sig.parameters:  # encoder-decoder models
-            kwargs["decoder_input_ids"] = ids
-    except (TypeError, ValueError):
-        pass
+    kwargs = {"decoder_input_ids": ids} if is_seq2seq_module(model) else {}
     shapes = jax.eval_shape(lambda: model.init(rng, ids, decode=True, **kwargs))
     return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
 
