@@ -91,9 +91,13 @@ class InferenceEngine:
 
         self._rng = jax.random.PRNGKey(seed)
         example = jnp.zeros((1, 8), jnp.int32)
+        from deepspeed_tpu.models.common import is_seq2seq_module
+        self._is_seq2seq = is_seq2seq_module(self.module)
+        example_extra = {"decoder_input_ids": example} if self._is_seq2seq else {}
 
         if params is None:
-            params = nn.meta.unbox(self.module.init(self._rng, example)["params"])
+            params = nn.meta.unbox(
+                self.module.init(self._rng, example, **example_extra)["params"])
         if config.dtype is not None:
             params = jax.tree.map(
                 lambda p: p.astype(config.dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
@@ -103,8 +107,6 @@ class InferenceEngine:
         self._forward_fn = None
         self._prefill_fn = None
         self._decode_fn = None
-        from deepspeed_tpu.models.common import is_seq2seq_module
-        self._is_seq2seq = is_seq2seq_module(self.module)
         self._max_len = self._model_max_len()
         log_dist(f"InferenceEngine: tp={topology.tensor_parallel_size} "
                  f"dtype={getattr(config.dtype, '__name__', 'model-default')} max_len={self._max_len}")
@@ -393,20 +395,28 @@ class InferenceEngine:
         ids_np = np.asarray(input_ids, np.int32)
         real_batch, prompt_len = ids_np.shape
         max_new = int(max_new_tokens if max_new_tokens is not None else self.config.max_new_tokens)
+        # batch rides a power-of-two bucket (padded rows dropped at the end)
+        batch = self._pow2_bucket(real_batch)
+        if batch != real_batch:
+            ids_np = np.concatenate(
+                [ids_np, np.repeat(ids_np[:1], batch - real_batch, axis=0)], axis=0)
+        if rng is None:
+            # engine-stream key unless the caller supplied one (keeps later
+            # rng-less calls independent of any caller key)
+            self._rng, rng = jax.random.split(self._rng)
         if self._is_seq2seq:
             if num_beams > 1:
                 raise NotImplementedError("beam search for encoder-decoder serving "
                                           "is not implemented; use greedy/sampling")
-            batch = self._pow2_bucket(real_batch)
-            if batch != real_batch:
-                ids_np = np.concatenate(
-                    [ids_np, np.repeat(ids_np[:1], batch - real_batch, axis=0)], axis=0)
-            if rng is None:
-                self._rng, rng = jax.random.split(self._rng)
+            start_id = kwargs.get("decoder_start_token_id",
+                                  getattr(self.mcfg, "decoder_start_token_id", None))
+            if start_id is None:
+                raise ValueError("encoder-decoder generate needs decoder_start_token_id "
+                                 "(pass it or set it on the model config) — defaulting "
+                                 "silently would seed generation from the wrong token")
             return self._generate_seq2seq(
                 ids_np, real_batch, batch, max_new, do_sample, temperature, top_k,
-                top_p, eos_token_id, rng,
-                kwargs.get("decoder_start_token_id", 0))
+                top_p, eos_token_id, rng, int(start_id))
         if prompt_len + max_new > self._max_len:
             raise ValueError(f"prompt ({prompt_len}) + max_new_tokens ({max_new}) exceeds the model "
                              f"context/cache length {self._max_len} "
@@ -415,11 +425,6 @@ class InferenceEngine:
             raise ValueError(f"max_new_tokens ({max_new}) exceeds the configured output budget "
                              f"max_tokens={self.config.max_tokens}; raise it in the inference "
                              f"config (silently truncating would hide the miss)")
-        # batch rides a power-of-two bucket (padded rows dropped at the end)
-        batch = self._pow2_bucket(real_batch)
-        if batch != real_batch:
-            ids_np = np.concatenate(
-                [ids_np, np.repeat(ids_np[:1], batch - real_batch, axis=0)], axis=0)
         cap = min(self._max_len, int(self.config.max_tokens or self._max_len))
 
         key = (batch, do_sample, float(temperature), int(top_k), float(top_p), eos_token_id)
@@ -433,13 +438,7 @@ class InferenceEngine:
         self._gen_key = key
         self._gen_fns = fns = self._gen_cache[key]
 
-        if rng is not None:
-            # caller-supplied key: use it directly without touching the
-            # engine's own stream, so later rng-less calls stay independent
-            # of (and uncorrelated with) the caller's key
-            use_rng = rng
-        else:
-            self._rng, use_rng = jax.random.split(self._rng)
+        use_rng = rng
 
         ids = self._place_batch(jnp.asarray(ids_np))
         # commit the fresh cache so its placement matches the donated outputs

@@ -41,6 +41,7 @@ class T5Config:
     feed_forward_proj: str = "relu"  # "relu" (t5) | "gated-gelu" (t5 v1.1)
     max_cache_length: int = 512  # decoder self-attention cache capacity
     tie_word_embeddings: bool = True
+    decoder_start_token_id: int = 0  # T5 seeds decoding from pad (HF convention)
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
     remat: bool = False

@@ -82,8 +82,11 @@ def tp_shard_params(params, model: Optional[nn.Module], topology: MeshTopology,
 
     specs = None
     if model is not None and example_ids is not None:
+        from deepspeed_tpu.models.common import is_seq2seq_module
+        extra = {"decoder_input_ids": example_ids} if is_seq2seq_module(model) else {}
         try:
-            abstract = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0), example_ids))
+            abstract = jax.eval_shape(
+                lambda: model.init(jax.random.PRNGKey(0), example_ids, **extra))
             logical = nn.get_partition_spec(abstract["params"])
             specs = jax.tree.map(lambda s: logical_to_mesh_spec(tuple(s), rules), logical,
                                  is_leaf=lambda x: isinstance(x, P))

@@ -117,3 +117,15 @@ def test_ring_in_model_end_to_end():
         set_topology(None)
     assert np.isfinite(losses["ring"])
     np.testing.assert_allclose(losses["ring"], losses["xla"], atol=1e-4, rtol=1e-4)
+
+
+def test_ulysses_flash_local_backend(seq4_mesh):
+    """Ulysses with the Pallas flash kernel as the LOCAL attention op —
+    the production TPU composition (all-to-all reshard + flash inner)."""
+    rng = np.random.default_rng(7)
+    mk = lambda: jnp.asarray(rng.standard_normal((2, 128, 4, 16)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    ref = xla_attention(q, k, v, causal=True)
+    out = ulysses_attention(q, k, v, causal=True, local_backend="flash",
+                            mesh=seq4_mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4, rtol=2e-4)
