@@ -395,15 +395,20 @@ class InferenceEngine:
         ids_np = np.asarray(input_ids, np.int32)
         real_batch, prompt_len = ids_np.shape
         max_new = int(max_new_tokens if max_new_tokens is not None else self.config.max_new_tokens)
-        # batch rides a power-of-two bucket (padded rows dropped at the end)
-        batch = self._pow2_bucket(real_batch)
-        if batch != real_batch:
-            ids_np = np.concatenate(
-                [ids_np, np.repeat(ids_np[:1], batch - real_batch, axis=0)], axis=0)
-        if rng is None:
-            # engine-stream key unless the caller supplied one (keeps later
-            # rng-less calls independent of any caller key)
-            self._rng, rng = jax.random.split(self._rng)
+        def bucket_pad_and_rng(ids_np, rng):
+            # bucket/pad + rng split happen AFTER validation so a rejected
+            # call never advances the engine's rng stream (seeded-run
+            # reproducibility must not depend on failed requests)
+            batch = self._pow2_bucket(real_batch)
+            if batch != real_batch:
+                ids_np = np.concatenate(
+                    [ids_np, np.repeat(ids_np[:1], batch - real_batch, axis=0)], axis=0)
+            if rng is None:
+                # engine-stream key unless the caller supplied one (keeps
+                # later rng-less calls independent of any caller key)
+                self._rng, rng = jax.random.split(self._rng)
+            return ids_np, batch, rng
+
         if self._is_seq2seq:
             if num_beams > 1:
                 raise NotImplementedError("beam search for encoder-decoder serving "
@@ -414,6 +419,7 @@ class InferenceEngine:
                 raise ValueError("encoder-decoder generate needs decoder_start_token_id "
                                  "(pass it or set it on the model config) — defaulting "
                                  "silently would seed generation from the wrong token")
+            ids_np, batch, rng = bucket_pad_and_rng(ids_np, rng)
             return self._generate_seq2seq(
                 ids_np, real_batch, batch, max_new, do_sample, temperature, top_k,
                 top_p, eos_token_id, rng, int(start_id))
@@ -425,6 +431,7 @@ class InferenceEngine:
             raise ValueError(f"max_new_tokens ({max_new}) exceeds the configured output budget "
                              f"max_tokens={self.config.max_tokens}; raise it in the inference "
                              f"config (silently truncating would hide the miss)")
+        ids_np, batch, rng = bucket_pad_and_rng(ids_np, rng)
         cap = min(self._max_len, int(self.config.max_tokens or self._max_len))
 
         key = (batch, do_sample, float(temperature), int(top_k), float(top_p), eos_token_id)
@@ -438,7 +445,6 @@ class InferenceEngine:
         self._gen_key = key
         self._gen_fns = fns = self._gen_cache[key]
 
-        use_rng = rng
 
         ids = self._place_batch(jnp.asarray(ids_np))
         # commit the fresh cache so its placement matches the donated outputs
@@ -468,7 +474,7 @@ class InferenceEngine:
             out, n, _ = bfns["loop"](self.params, cache, last_logits,
                                      jnp.int32(min(max_new, cap)))
         else:
-            out, n, _ = fns["gen_loop"](self.params, cache, last_logits, use_rng,
+            out, n, _ = fns["gen_loop"](self.params, cache, last_logits, rng,
                                         jnp.int32(min(max_new, cap)))
         n = int(n)
         full = jnp.concatenate([jnp.asarray(ids_np), out[:, :n]], axis=1)

@@ -1,0 +1,79 @@
+"""Debug naming/printing helpers (reference ``deepspeed/utils/debug.py``:
+``debug_extract_module_and_param_names:14`` and the ``debug_param2name*``
+family used while chasing ZeRO partitioning bugs).
+
+TPU formulation: parameters are pytree leaves addressed by path, not torch
+objects with identities — so the name extraction walks the tree with the
+repo's canonical ``keypath_str`` and the describe helpers report
+shape/dtype/sharding of jax arrays. ``log_rank_file`` matches the
+reference's per-rank debug file sink.
+"""
+
+import zlib
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.utils.tree import keypath_str
+
+
+def debug_extract_module_and_param_names(model_or_params) -> Dict[str, Any]:
+    """{path: leaf} over a param tree (or a flax module's bound variables).
+    Reference ``debug.py:14`` builds the same map from named_parameters."""
+    params = model_or_params
+    if hasattr(model_or_params, "variables"):  # bound flax module
+        params = model_or_params.variables.get("params", {})
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    return {keypath_str(path): leaf for path, leaf in flat}
+
+
+def _path_id(path: str) -> int:
+    """Deterministic across processes/reruns (Python's str hash is salted —
+    useless for correlating ranks)."""
+    return zlib.crc32(path.encode())
+
+
+def _numel(leaf) -> int:
+    return int(np.prod(getattr(leaf, "shape", ()), dtype=np.int64))  # prod(())==1 for scalars
+
+
+def debug_param2name_id_shape(path, leaf) -> str:
+    """Reference ``debug_param2name_id_shape``: stable id here is the path."""
+    return f"name={path} id={_path_id(path)} shape={tuple(getattr(leaf, 'shape', ()))}"
+
+
+def debug_param2name_id_shape_device(path, leaf) -> str:
+    sharding = getattr(leaf, "sharding", None)
+    dev = getattr(sharding, "spec", None) if sharding is not None else None
+    return debug_param2name_id_shape(path, leaf) + f" sharding={dev}"
+
+
+def debug_param2name_id_numel(path, leaf) -> str:
+    return f"name={path} id={_path_id(path)} numel={_numel(leaf)}"
+
+
+def param_summary(params, top: int = 20) -> str:
+    """Largest-params table — the question the reference's describe helpers
+    answer one param at a time, in one shot."""
+    items = sorted(debug_extract_module_and_param_names(params).items(),
+                   key=lambda kv: -_numel(kv[1]))
+    lines = [f"{_numel(l):>12,}  {getattr(l, 'dtype', '?')!s:>10}  {p}"
+             for p, l in items[:top]]
+    total = sum(_numel(l) for _, l in items)
+    return "\n".join(lines + [f"{total:>12,}  TOTAL ({len(items)} tensors)"])
+
+
+def log_rank_file(rank: int, *msgs) -> None:
+    """Append messages to a per-rank debug file (reference ``debug.py``
+    ``log_rank_file``: ``debug_rank{rank}.txt`` in the CURRENT cwd). Opened
+    per call — no handle cache to leak or go stale across chdir."""
+    with open(f"debug_rank{rank}.txt", "a") as fh:
+        for m in msgs:
+            fh.write(f"{m}\n")
+
+
+def print_rank_0(message, debug: bool = False, force: bool = False) -> None:
+    """Reference-shaped rank-0 print (process 0 only)."""
+    if (debug or force) and jax.process_index() == 0:
+        print(message, flush=True)

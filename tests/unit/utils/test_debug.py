@@ -1,0 +1,55 @@
+"""Debug helpers (reference deepspeed/utils/debug.py parity)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.utils.debug import (debug_extract_module_and_param_names,
+                                       debug_param2name_id_numel,
+                                       debug_param2name_id_shape, log_rank_file,
+                                       param_summary)
+
+
+def _tree():
+    return {"a": {"kernel": jnp.zeros((4, 8)), "bias": jnp.zeros((8,))},
+            "b": jnp.ones((2, 2))}
+
+
+def test_extract_names():
+    names = debug_extract_module_and_param_names(_tree())
+    assert set(names) == {"a/kernel", "a/bias", "b"}
+    assert names["a/kernel"].shape == (4, 8)
+
+
+def test_describe_helpers():
+    s = debug_param2name_id_shape("a/kernel", jnp.zeros((4, 8)))
+    assert "name=a/kernel" in s and "shape=(4, 8)" in s
+    n = debug_param2name_id_numel("b", jnp.ones((2, 2)))
+    assert "numel=4" in n
+
+
+def test_param_summary_sorted_with_total():
+    out = param_summary(_tree())
+    lines = out.splitlines()
+    assert "TOTAL (3 tensors)" in lines[-1]
+    assert "a/kernel" in lines[0]  # largest first (32 elems)
+    assert "44" in lines[-1].replace(",", "")
+
+
+def test_log_rank_file(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    log_rank_file(3, "hello", "world")
+    log_rank_file(3, "again")
+    text = open(tmp_path / "debug_rank3.txt").read()
+    assert text == "hello\nworld\nagain\n"
+
+
+def test_scalar_leaf_numel_and_stable_ids():
+    tree = {"t": jnp.zeros(())}
+    out = param_summary(tree)
+    assert "TOTAL (1 tensors)" in out and out.splitlines()[0].strip().startswith("1")
+    a = debug_param2name_id_shape("x/y", jnp.zeros((2,)))
+    b = debug_param2name_id_shape("x/y", jnp.zeros((2,)))
+    assert a == b  # crc32: deterministic across calls (and processes)
