@@ -10,3 +10,5 @@ from deepspeed_tpu.models.bloom import (BloomConfig, BloomForCausalLM, BLOOM_CON
                                         get_bloom_config)
 from deepspeed_tpu.models.t5 import (T5Config, T5ForConditionalGeneration, T5_CONFIGS,
                                      get_t5_config)
+from deepspeed_tpu.models.falcon import (FalconConfig, FalconForCausalLM, FALCON_CONFIGS,
+                                          get_falcon_config)

@@ -1,0 +1,166 @@
+"""Falcon family — grouped/multi-query attention with parallel residual
+(the reference serves Falcon through kernel injection; HF
+``FalconForCausalLM`` is the checkpoint source).
+
+Same TPU conventions as the rest of the zoo. Falcon quirks kept for
+checkpoint parity: the fused QKV is GROUP-interleaved ([kv_group][q x G,
+k, v] rather than per-head q/k/v), rotary covers the full head dim
+(half-split convention), projections carry no biases, attention and MLP
+read the same residual input (parallel residual), and the LN scheme
+follows ``new_decoder_architecture`` — one shared ``input_layernorm``
+(7B-style, MQA via ``num_kv_heads=1``) or separate ``ln_attn``/``ln_mlp``
+(40B/180B-style GQA).
+"""
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.common import config_from, dense_init as _init
+from deepspeed_tpu.models.llama import rotary_embedding
+from deepspeed_tpu.ops.transformer.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class FalconConfig:
+    vocab_size: int = 65024
+    hidden_size: int = 4544
+    num_attention_heads: int = 71
+    num_kv_heads: int = 1  # 1 = multi-query (7B); >1 = grouped (40B/180B)
+    num_hidden_layers: int = 32
+    max_position_embeddings: int = 2048
+    layer_norm_epsilon: float = 1e-5
+    rope_theta: float = 10000.0
+    new_decoder_architecture: bool = False  # True: separate ln_attn/ln_mlp
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    attention_backend: str = "xla"
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def q_per_kv(self):
+        return self.num_attention_heads // self.num_kv_heads
+
+
+FALCON_CONFIGS = {
+    "test": dict(vocab_size=256, hidden_size=64, num_attention_heads=4, num_kv_heads=1,
+                 num_hidden_layers=2, max_position_embeddings=128),
+    "test-gqa": dict(vocab_size=256, hidden_size=64, num_attention_heads=4, num_kv_heads=2,
+                     num_hidden_layers=2, max_position_embeddings=128,
+                     new_decoder_architecture=True),
+    "7b": dict(hidden_size=4544, num_attention_heads=71, num_kv_heads=1,
+               num_hidden_layers=32),
+    "40b": dict(hidden_size=8192, num_attention_heads=128, num_kv_heads=8,
+                num_hidden_layers=60, new_decoder_architecture=True),
+}
+
+
+def get_falcon_config(name: str, **overrides) -> FalconConfig:
+    return config_from(FALCON_CONFIGS, FalconConfig, name, **overrides)
+
+
+class FalconAttention(nn.Module):
+    config: FalconConfig
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        b, l, _ = x.shape
+        kv, g, d = cfg.num_kv_heads, cfg.q_per_kv, cfg.head_dim
+        # fused group-interleaved qkv: per kv group G query heads, one k, one v
+        qkv = nn.DenseGeneral(features=(kv, g + 2, d), axis=-1, use_bias=False,
+                              dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                              kernel_init=nn.with_logical_partitioning(
+                                  _init(), ("embed", "heads", None, "kv")),
+                              name="query_key_value")(x)
+        q = qkv[..., :g, :].reshape(b, l, kv * g, d)   # [B, L, H, D]
+        k = qkv[..., g, :]                             # [B, L, KV, D]
+        v = qkv[..., g + 1, :]
+        causal, decode_lengths = True, None
+        if self.decode:
+            cache_index = self.variable("cache", "cache_index", lambda: jnp.zeros([], jnp.int32))
+            idx = cache_index.value
+            positions = idx + jnp.broadcast_to(jnp.arange(l)[None, :], (b, l))
+            q = rotary_embedding(q, positions, cfg.rope_theta)
+            k = rotary_embedding(k, positions, cfg.rope_theta)
+            shape = (b, cfg.max_position_embeddings, kv, d)
+            cached_k = self.variable("cache", "cached_key", jnp.zeros, shape, k.dtype)
+            cached_v = self.variable("cache", "cached_value", jnp.zeros, shape, v.dtype)
+            cached_k.value = jax.lax.dynamic_update_slice(cached_k.value, k, (0, idx, 0, 0))
+            cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, v, (0, idx, 0, 0))
+            cache_index.value = idx + l
+            k, v = cached_k.value, cached_v.value
+            decode_lengths = jnp.broadcast_to(idx + l, (b,))
+            causal = False
+        else:
+            positions = jnp.broadcast_to(jnp.arange(l)[None, :], (b, l))
+            q = rotary_embedding(q, positions, cfg.rope_theta)
+            k = rotary_embedding(k, positions, cfg.rope_theta)
+        if g > 1 or kv != cfg.num_attention_heads:
+            k = jnp.repeat(k, g, axis=2)
+            v = jnp.repeat(v, g, axis=2)
+        out = dot_product_attention(q, k, v, backend=cfg.attention_backend,
+                                    causal=causal, decode_lengths=decode_lengths)
+        return nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1), use_bias=False,
+                               dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                               kernel_init=nn.with_logical_partitioning(
+                                   _init(), ("heads", "kv", "embed")),
+                               name="dense")(out)
+
+
+class FalconBlock(nn.Module):
+    config: FalconConfig
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                                       param_dtype=cfg.param_dtype, name=name)
+        if cfg.new_decoder_architecture:
+            attn_in = ln("ln_attn")(x)
+            mlp_in = ln("ln_mlp")(x)
+        else:
+            attn_in = mlp_in = ln("input_layernorm")(x)
+        attn_out = FalconAttention(cfg, self.decode, name="self_attention")(attn_in)
+        h = nn.Dense(features=4 * cfg.hidden_size, use_bias=False, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype,
+                     kernel_init=nn.with_logical_partitioning(_init(), ("embed", "mlp")),
+                     name="dense_h_to_4h")(mlp_in)
+        h = jax.nn.gelu(h, approximate=False)
+        h = nn.Dense(features=cfg.hidden_size, use_bias=False, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype,
+                     kernel_init=nn.with_logical_partitioning(_init(), ("mlp", "embed")),
+                     name="dense_4h_to_h")(h)
+        return x + attn_out + h  # parallel residual
+
+
+class FalconForCausalLM(nn.Module):
+    """Falcon with tied word-embedding head."""
+
+    config: FalconConfig
+
+    @nn.compact
+    def __call__(self, input_ids, *, deterministic: bool = True, decode: bool = False):
+        cfg = self.config
+        wte = self.param("word_embeddings", nn.with_logical_partitioning(_init(), ("vocab", "embed")),
+                         (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+        wte_v = wte.value if isinstance(wte, nn.meta.AxisMetadata) else wte
+        x = jnp.take(wte_v, input_ids, axis=0).astype(cfg.dtype)
+        block_cls = FalconBlock
+        if cfg.remat:
+            block_cls = nn.remat(FalconBlock, prevent_cse=False)
+        for i in range(cfg.num_hidden_layers):
+            x = block_cls(cfg, decode, name=f"h_{i}")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="ln_f")(x)
+        return jnp.einsum("ble,ve->blv", x, wte_v.astype(cfg.dtype),
+                          preferred_element_type=cfg.dtype)

@@ -342,11 +342,65 @@ def load_hf_t5(model_or_sd, cfg) -> dict:
     return params
 
 
+def load_hf_falcon(model_or_sd, cfg) -> dict:
+    """HF ``FalconForCausalLM`` → ``models.falcon.FalconForCausalLM`` params.
+    The fused qkv is group-interleaved: torch [(KV*(G+2))*D, E] transposes
+    and reshapes to [E, KV, G+2, D]; LN names follow
+    ``new_decoder_architecture`` (ln_attn/ln_mlp vs input_layernorm)."""
+    hf_cfg = getattr(model_or_sd, "config", None)
+    if hf_cfg is not None:
+        # reject variants this module does not model — converting them
+        # would produce plausible-looking but silently wrong logits
+        if getattr(hf_cfg, "alibi", False):
+            raise ValueError("falcon-rw style checkpoints (alibi=True) are not supported "
+                             "by models.falcon (rotary only); use the BLOOM family for "
+                             "alibi attention")
+        if not getattr(hf_cfg, "parallel_attn", True):
+            raise ValueError("sequential-attention Falcon variants (parallel_attn=False) "
+                             "are not supported by models.falcon (parallel residual only)")
+        if (not getattr(hf_cfg, "new_decoder_architecture", False)
+                and not getattr(hf_cfg, "multi_query", True)):
+            raise ValueError("per-head-interleaved Falcon QKV (multi_query=False without "
+                             "new_decoder_architecture) is not supported — the loader "
+                             "assumes the group-interleaved layout")
+    sd = _sd(model_or_sd)
+    pre = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    E, H, KV, D = (cfg.hidden_size, cfg.num_attention_heads,
+                   cfg.num_kv_heads, cfg.head_dim)
+    G = cfg.q_per_kv
+    ln = lambda name: _ln(sd, name)
+
+    params = {
+        "word_embeddings": jnp.asarray(sd[f"{pre}word_embeddings.weight"]),
+        "ln_f": ln(f"{pre}ln_f"),
+    }
+    for i in range(cfg.num_hidden_layers):
+        p = f"{pre}h.{i}."
+        layer = {
+            "self_attention": {
+                "query_key_value": {"kernel": jnp.asarray(
+                    sd[p + "self_attention.query_key_value.weight"].T
+                    .reshape(E, KV, G + 2, D))},
+                "dense": {"kernel": jnp.asarray(
+                    sd[p + "self_attention.dense.weight"].T.reshape(H, D, E))},
+            },
+            "dense_h_to_4h": {"kernel": jnp.asarray(sd[p + "mlp.dense_h_to_4h.weight"].T)},
+            "dense_4h_to_h": {"kernel": jnp.asarray(sd[p + "mlp.dense_4h_to_h.weight"].T)},
+        }
+        if cfg.new_decoder_architecture:
+            layer["ln_attn"] = ln(p + "ln_attn")
+            layer["ln_mlp"] = ln(p + "ln_mlp")
+        else:
+            layer["input_layernorm"] = ln(p + "input_layernorm")
+        params[f"h_{i}"] = layer
+    return params
+
+
 def load_hf_checkpoint(hf_model, arch: str, cfg) -> dict:
     """Dispatch by architecture (reference per-arch policy containers)."""
     loaders = {"gpt2": load_hf_gpt2, "llama": load_hf_llama, "opt": load_hf_opt,
                "gpt_neox": load_hf_gpt_neox, "gptneox": load_hf_gpt_neox,
-               "bloom": load_hf_bloom, "t5": load_hf_t5}
+               "bloom": load_hf_bloom, "t5": load_hf_t5, "falcon": load_hf_falcon}
     if arch not in loaders:
         raise ValueError(f"no HF converter for architecture {arch!r}; available: {sorted(loaders)}")
     return loaders[arch](hf_model, cfg)
