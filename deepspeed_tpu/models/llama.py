@@ -15,7 +15,7 @@ TPU-first notes, same conventions as ``models/gpt2.py``:
 """
 
 import dataclasses
-from typing import Any
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax
@@ -40,6 +40,10 @@ class LlamaConfig:
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
     remat: bool = False
+    # jax.checkpoint policy name + selective application (same semantics as
+    # GPT2Config.remat_policy/remat_every; runtime/activation_checkpointing)
+    remat_policy: Optional[str] = None
+    remat_every: int = 1
     attention_backend: str = "xla"
     attention_bias: bool = False  # Qwen2-style biased q/k/v projections
     # Mixtral-style sparse MoE FFN (reference GPT-MoE wiring; MoE every
@@ -259,12 +263,19 @@ class LlamaForCausalLM(nn.Module):
 
         layer_cls = LlamaDecoderLayer
         if cfg.remat and not decode:
-            layer_cls = nn.remat(LlamaDecoderLayer, static_argnums=(3, 5), prevent_cse=False)
+            from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
+                get_remat_policy)
+            layer_cls = nn.remat(LlamaDecoderLayer, static_argnums=(3, 5), prevent_cse=False,
+                                 policy=get_remat_policy(cfg.remat_policy))
         aux_total = jnp.zeros([], jnp.float32)
         for i in range(cfg.num_hidden_layers):
             use_moe = (cfg.moe_num_experts > 0
                        and i % max(cfg.moe_layer_freq, 1) == max(cfg.moe_layer_freq, 1) - 1)
-            x, l_aux = layer_cls(cfg, use_moe, name=f"layers_{i}")(
+            # selective checkpointing: every remat_every-th block recomputes
+            block_cls = (layer_cls if (cfg.remat and not decode
+                                       and i % max(cfg.remat_every, 1) == 0)
+                         else LlamaDecoderLayer)
+            x, l_aux = block_cls(cfg, use_moe, name=f"layers_{i}")(
                 x, positions, decode, attention_mask, deterministic)
             aux_total = aux_total + l_aux
         x = RMSNorm(cfg, name="norm")(x)
