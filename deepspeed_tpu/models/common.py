@@ -38,6 +38,20 @@ def dense_init(scale: float = 0.02):
     return nn.initializers.normal(stddev=scale)
 
 
+def maybe_remat(block_cls, cfg, layer_idx: int, static_argnums=(), enabled=None):
+    """Zoo-shared selective activation checkpointing: wrap ``block_cls`` in
+    ``jax.checkpoint`` (with the config's ``remat_policy``) when remat is on
+    and ``layer_idx`` hits the ``remat_every`` stride; otherwise return the
+    class unchanged. ``enabled`` overrides ``cfg.remat`` for callers with
+    extra conditions (e.g. llama skips remat during decode)."""
+    enabled = getattr(cfg, "remat", False) if enabled is None else enabled
+    if not enabled or layer_idx % max(getattr(cfg, "remat_every", 1), 1) != 0:
+        return block_cls
+    from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import get_remat_policy
+    return nn.remat(block_cls, static_argnums=static_argnums, prevent_cse=False,
+                    policy=get_remat_policy(getattr(cfg, "remat_policy", None)))
+
+
 def rms_norm(x, weight, eps: float, out_dtype):
     """Shared RMS-norm core (LLaMA RMSNorm, T5 LayerNorm): fp32 accumulate,
     scale, cast back."""

@@ -246,16 +246,11 @@ class GPT2LMHeadModel(nn.Module):
         if not deterministic and cfg.dropout > 0.0:
             x = nn.Dropout(rate=cfg.dropout)(x, deterministic=False)
 
-        remat_cls = Block
-        if cfg.remat:
-            from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import get_remat_policy
-            remat_cls = nn.remat(Block, static_argnums=(2,), prevent_cse=False,
-                                 policy=get_remat_policy(cfg.remat_policy))
+        from deepspeed_tpu.models.common import maybe_remat
         aux_total = jnp.zeros([], jnp.float32)
         for i in range(cfg.n_layer):
             use_moe = cfg.moe_num_experts > 0 and (i % cfg.moe_layer_freq == cfg.moe_layer_freq - 1)
-            # selective checkpointing: only every remat_every-th block recomputes
-            block_cls = remat_cls if (cfg.remat and i % max(cfg.remat_every, 1) == 0) else Block
+            block_cls = maybe_remat(Block, cfg, i, static_argnums=(2,))
             x, l_aux = block_cls(cfg, use_moe, decode, name=f"h_{i}")(x, deterministic)
             aux_total = aux_total + l_aux
         x = LayerNorm(cfg, name="ln_f")(x)

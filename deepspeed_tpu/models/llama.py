@@ -261,20 +261,13 @@ class LlamaForCausalLM(nn.Module):
         wte_value = wte.value if isinstance(wte, nn.meta.AxisMetadata) else wte
         x = jnp.take(wte_value, input_ids, axis=0).astype(cfg.dtype)
 
-        layer_cls = LlamaDecoderLayer
-        if cfg.remat and not decode:
-            from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
-                get_remat_policy)
-            layer_cls = nn.remat(LlamaDecoderLayer, static_argnums=(3, 5), prevent_cse=False,
-                                 policy=get_remat_policy(cfg.remat_policy))
+        from deepspeed_tpu.models.common import maybe_remat
         aux_total = jnp.zeros([], jnp.float32)
         for i in range(cfg.num_hidden_layers):
             use_moe = (cfg.moe_num_experts > 0
                        and i % max(cfg.moe_layer_freq, 1) == max(cfg.moe_layer_freq, 1) - 1)
-            # selective checkpointing: every remat_every-th block recomputes
-            block_cls = (layer_cls if (cfg.remat and not decode
-                                       and i % max(cfg.remat_every, 1) == 0)
-                         else LlamaDecoderLayer)
+            block_cls = maybe_remat(LlamaDecoderLayer, cfg, i, static_argnums=(3, 5),
+                                    enabled=cfg.remat and not decode)
             x, l_aux = block_cls(cfg, use_moe, name=f"layers_{i}")(
                 x, positions, decode, attention_mask, deterministic)
             aux_total = aux_total + l_aux

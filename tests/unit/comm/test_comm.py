@@ -109,3 +109,42 @@ def test_comms_logger():
     summary = dist.comms_logger.log_all(print_log=False)
     assert "all_reduce" in summary
     dist.configure(enabled=False)
+
+
+def test_product_reduce_and_inference_alias(topo):
+    def body(x):
+        p = dist.all_reduce(x, op=ReduceOp.PRODUCT, group=FSDP_AXIS)
+        i = dist.inference_all_reduce(x, group=FSDP_AXIS)
+        return p, i
+
+    f = _shmap(topo, body, (P(FSDP_AXIS),), (P(), P()))
+    x = jnp.arange(1, 9, dtype=jnp.float32)
+    prod, summ = f(x)
+    np.testing.assert_allclose(float(prod[0]), 40320.0, rtol=1e-4)  # exp-log product
+    assert float(summ[0]) == 36.0  # inference_all_reduce defaults to SUM
+
+
+def test_all_gather_into_tensor_matches_all_gather(topo):
+    def body(x):
+        return dist.all_gather_into_tensor(x, group=FSDP_AXIS), \
+               dist.all_gather(x, group=FSDP_AXIS)
+
+    f = jax.jit(jax.shard_map(body, mesh=topo.mesh, in_specs=(P(FSDP_AXIS),),
+                              out_specs=(P(), P()), check_vma=False))
+    x = jnp.arange(8, dtype=jnp.float32)
+    a, b = f(x)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(a), np.arange(8, dtype=np.float32))
+
+
+def test_axis_index_and_size(topo):
+    def body(x):
+        idx = dist.get_axis_index(FSDP_AXIS)
+        size = dist.get_axis_size(FSDP_AXIS)
+        return x * 0 + idx.astype(jnp.float32), x * 0 + jnp.float32(size)
+
+    f = _shmap(topo, body, (P(FSDP_AXIS),), (P(FSDP_AXIS), P(FSDP_AXIS)))
+    idxs, sizes = f(jnp.zeros((8,), jnp.float32))
+    np.testing.assert_array_equal(np.asarray(idxs), np.arange(8, dtype=np.float32))
+    assert (np.asarray(sizes) == 8).all()
+

@@ -247,6 +247,8 @@ def test_llama_remat_policy_same_numerics():
         cfg = get_llama_config("test", **kw)
         out = LlamaForCausalLM(cfg).apply({"params": params}, ids)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
-        # gradients flow through the remat wrapper
+        # remat contract: gradients equal the non-remat reference, not just finite
         g = jax.grad(lambda p: LlamaForCausalLM(cfg).apply({"params": p}, ids).sum())(params)
-        assert all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(g))
+        g_ref = jax.grad(lambda p: LlamaForCausalLM(base).apply({"params": p}, ids).sum())(params)
+        for a, b in zip(jax.tree.leaves(g), jax.tree.leaves(g_ref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
