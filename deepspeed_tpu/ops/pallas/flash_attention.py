@@ -112,7 +112,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
         l = l_ref[:, 0]
         l_safe = jnp.maximum(l, 1e-37)
         o_ref[...] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
-        lse_ref[...] = (m_ref[:, 0] + jnp.log(l_safe))[:, None]
+        # lse rides a [B,H,L] array (ref block [1, blk_q]): a trailing
+        # [..., 1] dim would tile-pad to 128 lanes — 128x the HBM held as
+        # backward residuals (128 MB/layer at b=16,h=16,L=1024)
+        lse_ref[...] = (m_ref[:, 0] + jnp.log(l_safe))[None, :]
 
 
 def _kv_index_map(causal, blk_q, blk_k, off, nk):
@@ -149,11 +152,16 @@ def _flash_fwd(q, k, v, scale, causal, blk_q, blk_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((None, None, blk_q, d), lambda bi, hi, qi, j: (bi, hi, qi, 0)),
-            pl.BlockSpec((None, None, blk_q, 1), lambda bi, hi, qi, j: (bi, hi, qi, 0)),
+            # stats ride a [B,H,1,L] array — Mosaic accepts the size-1 block
+            # dim because it equals the array dim, and the caller squeezes to
+            # a compact [B,H,L] residual. A trailing [..., 1] dim instead
+            # would tile-pad to 128 lanes (128 MB/layer of backward
+            # residuals at b=16,h=16,L=1024).
+            pl.BlockSpec((None, None, 1, blk_q), lambda bi, hi, qi, j: (bi, hi, 0, qi)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, lq, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, lq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, 1, lq), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((blk_q, 1), jnp.float32),   # running max
@@ -162,7 +170,7 @@ def _flash_fwd(q, k, v, scale, causal, blk_q, blk_k, interpret):
         ],
         interpret=interpret,
     )(q, k, v)
-    return o, lse
+    return o, lse.reshape(b, h, lq)
 
 
 # ---------------------------------------------------------------------------
@@ -183,8 +191,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_
     def _block():
         q = q_ref[...].astype(jnp.float32) * scale
         do = do_ref[...].astype(jnp.float32)
-        lse = lse_ref[...][:, 0]
-        delta = delta_ref[...][:, 0]
+        lse = lse_ref[0, :]
+        delta = delta_ref[0, :]
         k = k_ref[...].astype(jnp.float32)
         v = v_ref[...].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
@@ -223,8 +231,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         v = v_ref[...].astype(jnp.float32)
         q = q_ref[...].astype(jnp.float32) * scale
         do = do_ref[...].astype(jnp.float32)
-        lse = lse_ref[...][:, 0]
-        delta = delta_ref[...][:, 0]
+        lse = lse_ref[0, :]
+        delta = delta_ref[0, :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         if causal:
             s = _apply_causal_mask(s, i, ki, blk_q, blk_k, off)
@@ -248,7 +256,11 @@ def _flash_bwd(res, g, scale, causal, blk_q, blk_k, interpret):
     lk = k.shape[2]
     nq, nk = lq // blk_q, lk // blk_k
     do = g
-    delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(axis=-1, keepdims=True)  # [B,H,Lq,1]
+    delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(axis=-1)  # [B,H,Lq]
+    # size-1 dim ahead of Lq (not after): blocks (None, None, 1, blk_q) pass
+    # Mosaic's tiling rule and the buffers pad 8x (sublane) instead of 128x
+    lse4 = lse.reshape(b, h, 1, lq)
+    delta4 = delta.reshape(b, h, 1, lq)
 
     off = lk - lq
     kv_idx = _kv_index_map(causal, blk_q, blk_k, off, nk)
@@ -261,14 +273,14 @@ def _flash_bwd(res, g, scale, causal, blk_q, blk_k, interpret):
             pl.BlockSpec((None, None, blk_k, d), kv_idx),
             pl.BlockSpec((None, None, blk_k, d), kv_idx),
             pl.BlockSpec((None, None, blk_q, d), lambda bi, hi, qi, j: (bi, hi, qi, 0)),
-            pl.BlockSpec((None, None, blk_q, 1), lambda bi, hi, qi, j: (bi, hi, qi, 0)),
-            pl.BlockSpec((None, None, blk_q, 1), lambda bi, hi, qi, j: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, 1, blk_q), lambda bi, hi, qi, j: (bi, hi, 0, qi)),
+            pl.BlockSpec((None, None, 1, blk_q), lambda bi, hi, qi, j: (bi, hi, 0, qi)),
         ],
         out_specs=pl.BlockSpec((None, None, blk_q, d), lambda bi, hi, qi, j: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse4, delta4)
 
     if causal:
         # steps before this K block's first live Q block clamp their Q/dO/
@@ -276,9 +288,16 @@ def _flash_bwd(res, g, scale, causal, blk_q, blk_k, interpret):
         def q_idx(bi, hi, ki, i):
             first = jnp.maximum((ki * blk_k - off) // blk_q, 0)
             return (bi, hi, jnp.maximum(i, first), 0)
+
+        def stat_idx(bi, hi, ki, i):
+            first = jnp.maximum((ki * blk_k - off) // blk_q, 0)
+            return (bi, hi, 0, jnp.maximum(i, first))
     else:
         def q_idx(bi, hi, ki, i):
             return (bi, hi, i, 0)
+
+        def stat_idx(bi, hi, ki, i):
+            return (bi, hi, 0, i)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, blk_q=blk_q,
@@ -289,8 +308,8 @@ def _flash_bwd(res, g, scale, causal, blk_q, blk_k, interpret):
             pl.BlockSpec((None, None, blk_k, d), lambda bi, hi, ki, i: (bi, hi, ki, 0)),
             pl.BlockSpec((None, None, blk_k, d), lambda bi, hi, ki, i: (bi, hi, ki, 0)),
             pl.BlockSpec((None, None, blk_q, d), q_idx),
-            pl.BlockSpec((None, None, blk_q, 1), q_idx),
-            pl.BlockSpec((None, None, blk_q, 1), q_idx),
+            pl.BlockSpec((None, None, 1, blk_q), stat_idx),
+            pl.BlockSpec((None, None, 1, blk_q), stat_idx),
         ],
         out_specs=[
             pl.BlockSpec((None, None, blk_k, d), lambda bi, hi, ki, i: (bi, hi, ki, 0)),
@@ -303,7 +322,7 @@ def _flash_bwd(res, g, scale, causal, blk_q, blk_k, interpret):
         scratch_shapes=[pltpu.VMEM((blk_k, d), jnp.float32),
                         pltpu.VMEM((blk_k, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse4, delta4)
     return dq, dk, dv
 
 

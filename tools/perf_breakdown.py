@@ -1,0 +1,111 @@
+"""One clean-exit TPU breakdown: times fwd, fwd+bwd, and the full engine
+step as separate compiled programs, each iterated with CHAINED data
+dependencies (output feeds next input) so the axon tunnel's identical-
+dispatch dedupe can't fake the numbers. Attribution without
+jax.profiler.trace (a killed trace session wedges the tunnel).
+
+Run: timeout 2000 python tools/perf_breakdown.py
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+try:
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_comp_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:
+    pass
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+
+MODEL = os.environ.get("BENCH_MODEL", "350m")
+MB = int(os.environ.get("BENCH_MICRO_BS", "4"))
+SEQ = int(os.environ.get("BENCH_SEQ", "1024"))
+N = 10
+
+
+def timed(tag, fn, carry):
+    """fn: carry -> carry with chained deps. Times N iterations."""
+    carry = fn(carry)  # warmup (compile)
+    jax.block_until_ready(carry)
+    t0 = time.time()
+    for _ in range(N):
+        carry = fn(carry)
+    jax.block_until_ready(carry)
+    dt = (time.time() - t0) / N
+    print(json.dumps({"tag": tag, "ms": round(dt * 1e3, 1)}), flush=True)
+    return dt
+
+
+def main():
+    cfg = get_gpt2_config(MODEL, n_positions=SEQ, remat=True,
+                          attention_backend="flash", dtype=jnp.bfloat16)
+    model = GPT2LMHeadModel(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_batch_size": MB,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        "zero_optimization": {"stage": 0},
+        "steps_per_print": 10**9,
+    })
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (MB, SEQ)).astype(np.int32)
+    batch = {"input_ids": ids}
+    engine.initialize_state(batch)
+    params = engine.state.params
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    print(f"# breakdown {MODEL} params={n_params / 1e6:.1f}M mb={MB} seq={SEQ}",
+          flush=True)
+    key = jax.random.PRNGKey(0)
+
+    def loss_fn(p, ids_dev):
+        logits = model.apply({"params": p}, ids_dev, deterministic=True)
+        tgt = ids_dev[:, 1:]
+        lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+        return -jnp.mean(jnp.take_along_axis(lp, tgt[..., None], axis=-1))
+
+    ids_dev = jnp.asarray(ids)
+
+    # 1) forward only — chain: perturb ids by loss-derived int so each
+    # dispatch differs and depends on the previous result (params passed
+    # explicitly so jit doesn't bake them in as program constants)
+    @jax.jit
+    def fwd(p, carry):
+        ids_c, acc = carry
+        l = loss_fn(p, ids_c)
+        shift = (l * 0).astype(jnp.int32)  # data dep, value-neutral
+        return (ids_c + shift, acc + l)
+
+    timed("fwd", lambda c: fwd(params, c), (ids_dev, jnp.float32(0)))
+
+    # 2) fwd + bwd (grads reduced to a scalar to keep transfer off the timing)
+    @jax.jit
+    def fwdbwd(p, carry):
+        ids_c, acc = carry
+        l, g = jax.value_and_grad(loss_fn)(p, ids_c)
+        gsum = sum(jnp.sum(x.astype(jnp.float32)) for x in jax.tree.leaves(g))
+        shift = (gsum * 0).astype(jnp.int32)
+        return (ids_c + shift, acc + l)
+
+    timed("fwd_bwd", lambda c: fwdbwd(params, c), (ids_dev, jnp.float32(0)))
+
+    # 3) full engine step (state donation chains deps naturally)
+    def full(carry):
+        engine.train_batch(batch)
+        return engine.state.params
+
+    timed("engine_step", full, None)
+    print("# DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()
