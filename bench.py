@@ -81,6 +81,9 @@ def run_child():
         overrides["vocab_size"] = vocab_override
     if os.environ.get("BENCH_EMBED_ONEHOT", "1") == "1":
         overrides["embed_onehot_grad"] = True
+    # chunked fused LM-head loss (no [B,L,V] logits buffer) — opt-in knob
+    if os.environ.get("BENCH_FUSED_XENT", "0") == "1":
+        overrides["fused_head_loss_chunk"] = int(os.environ.get("BENCH_XENT_CHUNK", "1024"))
     cfg_model = get_gpt2_config(model_name, n_positions=seq, remat=remat,
                                 attention_backend=attn, dtype=jnp.bfloat16,
                                 **overrides)

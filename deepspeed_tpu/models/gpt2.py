@@ -49,6 +49,10 @@ class GPT2Config:
     # backward of the token-embedding gather as a one-hot matmul instead of
     # a scatter-add (MXU-friendly; ~V*T*E extra FLOPs) — perf knob
     embed_onehot_grad: bool = False
+    # >0: when called with ``labels=``, compute the loss via the chunked
+    # fused LM head (models/common.py fused_lm_head_loss) — never
+    # materializes [B, L, V] logits; the value is tokens per chunk
+    fused_head_loss_chunk: int = 0
     # MoE (reference GPT-MoE configs: every other layer is an MoE FFN)
     moe_num_experts: int = 0  # 0 = dense model
     moe_layer_freq: int = 2  # MoE every Nth block (reference expert-interval)
@@ -222,7 +226,8 @@ class GPT2LMHeadModel(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, input_ids, *, deterministic: bool = True, decode: bool = False):
+    def __call__(self, input_ids, *, deterministic: bool = True, decode: bool = False,
+                 labels=None):
         cfg = self.config
         wte = self.param("wte", nn.with_logical_partitioning(_dense_init(), ("vocab", "embed")),
                          (cfg.vocab_size, cfg.n_embd), cfg.param_dtype)
@@ -254,6 +259,20 @@ class GPT2LMHeadModel(nn.Module):
             x, l_aux = block_cls(cfg, use_moe, decode, name=f"h_{i}")(x, deterministic)
             aux_total = aux_total + l_aux
         x = LayerNorm(cfg, name="ln_f")(x)
+        if labels is not None and cfg.fused_head_loss_chunk > 0:
+            # chunked fused head: next-token NLL straight from hidden
+            # states, no [B,L,V] logits buffer (fused_lm_head_loss). The
+            # MoE aux loss rides along pre-scaled, as in the engine's
+            # default loss path.
+            from deepspeed_tpu.models.common import fused_lm_head_loss
+            loss = fused_lm_head_loss(x[:, :-1], wte_value.astype(cfg.dtype),
+                                      labels[:, 1:],
+                                      chunk=cfg.fused_head_loss_chunk)
+            if cfg.moe_num_experts > 0 and not deterministic:
+                # training only — eval reports pure CE, matching the
+                # engine's unfused eval branch which strips the aux loss
+                loss = loss + aux_total * cfg.moe_aux_loss_coef
+            return loss
         # tied LM head. Logits stay at the COMPUTE dtype: [B,L,V] is the
         # single largest activation (824MB fp32 at bs4/seq1024/GPT-2 vocab)
         # and the loss does its softmax reductions in fp32 anyway

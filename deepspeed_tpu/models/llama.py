@@ -46,6 +46,11 @@ class LlamaConfig:
     remat_every: int = 1
     attention_backend: str = "xla"
     attention_bias: bool = False  # Qwen2-style biased q/k/v projections
+    # >0: when called with ``labels=``, compute the loss via the chunked
+    # fused LM head (models/common.py fused_lm_head_loss) — never
+    # materializes [B, L, V] logits (32k-152k vocabs make that the
+    # dominant buffer); the value is tokens per chunk
+    fused_head_loss_chunk: int = 0
     # Mixtral-style sparse MoE FFN (reference GPT-MoE wiring; MoE every
     # moe_layer_freq-th layer replaces the SwiGLU MLP with experts)
     moe_num_experts: int = 0  # 0 = dense
@@ -243,6 +248,22 @@ class LlamaDecoderLayer(nn.Module):
 from deepspeed_tpu.models.common import init_cache  # noqa: E402  (re-export)
 
 
+class _LMHeadKernel(nn.Module):
+    """Declares the LM-head kernel at the same param path as
+    ``nn.Dense(name="lm_head")`` ([E, V], same init/partitioning) so the
+    fused-loss branch shares weights with the logits branch."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self):
+        cfg = self.config
+        kernel = self.param("kernel",
+                            nn.with_logical_partitioning(_init(), ("embed", "vocab")),
+                            (cfg.hidden_size, cfg.vocab_size), cfg.param_dtype)
+        return kernel.value if isinstance(kernel, nn.meta.AxisMetadata) else kernel
+
+
 class LlamaForCausalLM(nn.Module):
     """LLaMA with an untied LM head. Returns logits [B, L, V].
 
@@ -254,7 +275,7 @@ class LlamaForCausalLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, *, deterministic: bool = True, decode: bool = False,
-                 positions=None, attention_mask=None):
+                 positions=None, attention_mask=None, labels=None):
         cfg = self.config
         wte = self.param("embed_tokens", nn.with_logical_partitioning(_init(), ("vocab", "embed")),
                          (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
@@ -272,6 +293,21 @@ class LlamaForCausalLM(nn.Module):
                 x, positions, decode, attention_mask, deterministic)
             aux_total = aux_total + l_aux
         x = RMSNorm(cfg, name="norm")(x)
+        if labels is not None and cfg.fused_head_loss_chunk > 0:
+            # chunked fused head on the [E, V] Dense kernel — same param
+            # path ("lm_head"/"kernel") as the unfused branch, so
+            # checkpoints and HF converters are unaffected
+            from deepspeed_tpu.models.common import fused_lm_head_loss
+            kernel = _LMHeadKernel(cfg, name="lm_head")()
+            loss = fused_lm_head_loss(x[:, :-1], kernel.astype(cfg.dtype),
+                                      labels[:, 1:],
+                                      chunk=cfg.fused_head_loss_chunk,
+                                      vocab_major=False)
+            if cfg.moe_num_experts > 0 and not deterministic:
+                # training only — eval reports pure CE, matching the
+                # engine's unfused eval branch which strips the aux loss
+                loss = loss + aux_total * cfg.moe_aux_loss_coef
+            return loss
         # logits at compute dtype: the loss reduces in fp32 (PERF.md #2)
         logits = nn.Dense(features=cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                           param_dtype=cfg.param_dtype,

@@ -781,6 +781,13 @@ class DeepSpeedEngine:
         mcfg = getattr(self.module, "config", None)
         has_dropout = mcfg is not None and getattr(mcfg, "dropout", 0.0) > 0.0
         has_moe = mcfg is not None and getattr(mcfg, "moe_num_experts", 0) > 0
+        # fused-head models compute the loss inside apply() (no [B,L,V]
+        # logits); only the default loss path knows that contract
+        fused_head = (self.loss_fn is default_causal_lm_loss and mcfg is not None
+                      and getattr(mcfg, "fused_head_loss_chunk", 0) > 0)
+        if fused_head:
+            extra = dict(extra,
+                         labels=mb.get("labels", ids) if isinstance(mb, dict) else mb)
         if train and (has_dropout or has_moe):
             drop_key, gate_key = jax.random.split(key)
             outputs = self.module.apply({"params": cparams}, ids, deterministic=False,
@@ -791,7 +798,7 @@ class DeepSpeedEngine:
             outputs = self.module.apply({"params": cparams}, ids, deterministic=True, **extra)
             if has_moe and isinstance(outputs, (tuple, list)):
                 outputs = outputs[0]
-        loss = self.loss_fn(outputs, mb)
+        loss = outputs if fused_head else self.loss_fn(outputs, mb)
         return (loss * scale).astype(jnp.float32), loss
 
     def _cond_apply_updates(self, overflow, grads, opt_state, params):

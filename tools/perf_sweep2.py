@@ -33,13 +33,15 @@ FUSED = 10
 MODEL = os.environ.get("BENCH_MODEL", "350m")
 
 
-def run_config(tag, mb, vocab=None, onehot=False, remat=True):
+def run_config(tag, mb, vocab=None, onehot=False, remat=True, xent_chunk=0):
     t_start = time.time()
     overrides = {}
     if vocab:
         overrides["vocab_size"] = vocab
     if onehot:
         overrides["embed_onehot_grad"] = True
+    if xent_chunk:
+        overrides["fused_head_loss_chunk"] = xent_chunk
     cfg = get_gpt2_config(MODEL, n_positions=SEQ, remat=remat,
                           attention_backend="flash", dtype=jnp.bfloat16,
                           **overrides)
@@ -78,9 +80,8 @@ def run_config(tag, mb, vocab=None, onehot=False, remat=True):
 def main():
     print(f"# sweep2 model={MODEL} seq={SEQ} fused={FUSED}", flush=True)
     configs = [
-        ("mb8_vocab50304_onehot", dict(mb=8, vocab=50304, onehot=True)),
-        ("mb16_vocab50304_onehot", dict(mb=16, vocab=50304, onehot=True)),
-        ("mb16", dict(mb=16)),
+        ("mb8_fusedxent", dict(mb=8, vocab=50304, onehot=True, xent_chunk=1024)),
+        ("mb16_fusedxent", dict(mb=16, vocab=50304, onehot=True, xent_chunk=1024)),
     ]
     for tag, kw in configs:
         try:
