@@ -260,3 +260,19 @@ def fused_lm_head_loss(x, embedding, labels, *, chunk: int = 1024,
                                 jnp.dtype(embedding.dtype).name,
                                 int(chunk), int(ignore_index), bool(vocab_major))
     return fn(x, embedding, labels)
+
+
+def fused_head_loss_output(x, weight, labels, aux_total, deterministic, cfg, *,
+                           vocab_major: bool):
+    """Shared fused-head dispatch for causal-LM model families: applies the
+    next-token shift, runs :func:`fused_lm_head_loss`, and adds the MoE aux
+    loss in training only (eval reports pure CE, matching the engine's
+    unfused eval branch). Keeping the shift convention and aux policy here
+    means every family adopting ``fused_head_loss_chunk`` stays in
+    lockstep."""
+    loss = fused_lm_head_loss(x[:, :-1], weight, labels[:, 1:],
+                              chunk=cfg.fused_head_loss_chunk,
+                              vocab_major=vocab_major)
+    if getattr(cfg, "moe_num_experts", 0) > 0 and not deterministic:
+        loss = loss + aux_total * cfg.moe_aux_loss_coef
+    return loss

@@ -261,18 +261,12 @@ class GPT2LMHeadModel(nn.Module):
         x = LayerNorm(cfg, name="ln_f")(x)
         if labels is not None and cfg.fused_head_loss_chunk > 0:
             # chunked fused head: next-token NLL straight from hidden
-            # states, no [B,L,V] logits buffer (fused_lm_head_loss). The
-            # MoE aux loss rides along pre-scaled, as in the engine's
-            # default loss path.
-            from deepspeed_tpu.models.common import fused_lm_head_loss
-            loss = fused_lm_head_loss(x[:, :-1], wte_value.astype(cfg.dtype),
-                                      labels[:, 1:],
-                                      chunk=cfg.fused_head_loss_chunk)
-            if cfg.moe_num_experts > 0 and not deterministic:
-                # training only — eval reports pure CE, matching the
-                # engine's unfused eval branch which strips the aux loss
-                loss = loss + aux_total * cfg.moe_aux_loss_coef
-            return loss
+            # states, no [B,L,V] logits buffer (shift/aux policy lives in
+            # fused_head_loss_output, shared across families)
+            from deepspeed_tpu.models.common import fused_head_loss_output
+            return fused_head_loss_output(x, wte_value.astype(cfg.dtype), labels,
+                                          aux_total, deterministic, cfg,
+                                          vocab_major=True)
         # tied LM head. Logits stay at the COMPUTE dtype: [B,L,V] is the
         # single largest activation (824MB fp32 at bs4/seq1024/GPT-2 vocab)
         # and the loss does its softmax reductions in fp32 anyway

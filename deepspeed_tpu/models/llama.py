@@ -296,18 +296,14 @@ class LlamaForCausalLM(nn.Module):
         if labels is not None and cfg.fused_head_loss_chunk > 0:
             # chunked fused head on the [E, V] Dense kernel — same param
             # path ("lm_head"/"kernel") as the unfused branch, so
-            # checkpoints and HF converters are unaffected
-            from deepspeed_tpu.models.common import fused_lm_head_loss
+            # checkpoints and HF converters are unaffected (shift/aux
+            # policy lives in fused_head_loss_output, shared across
+            # families)
+            from deepspeed_tpu.models.common import fused_head_loss_output
             kernel = _LMHeadKernel(cfg, name="lm_head")()
-            loss = fused_lm_head_loss(x[:, :-1], kernel.astype(cfg.dtype),
-                                      labels[:, 1:],
-                                      chunk=cfg.fused_head_loss_chunk,
-                                      vocab_major=False)
-            if cfg.moe_num_experts > 0 and not deterministic:
-                # training only — eval reports pure CE, matching the
-                # engine's unfused eval branch which strips the aux loss
-                loss = loss + aux_total * cfg.moe_aux_loss_coef
-            return loss
+            return fused_head_loss_output(x, kernel.astype(cfg.dtype), labels,
+                                          aux_total, deterministic, cfg,
+                                          vocab_major=False)
         # logits at compute dtype: the loss reduces in fp32 (PERF.md #2)
         logits = nn.Dense(features=cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                           param_dtype=cfg.param_dtype,

@@ -1428,6 +1428,18 @@ class DeepSpeedEngine:
         gn = getattr(self, "_last_grad_norm", None)
         return None if gn is None else float(gn)
 
+    @property
+    def cur_scale(self):
+        """Current loss scale (reference ``engine.py`` exposes
+        ``optimizer.cur_scale``; 1.0 outside fp16 mode). Before the first
+        batch the configured initial scale reports, as in the reference."""
+        if self.state is not None and self.state.loss_scale is not None:
+            return float(self.state.loss_scale.loss_scale)
+        return float(self._ls_state0.loss_scale)
+
+    def get_loss_scale(self):
+        return self.cur_scale
+
     def _drain_overflows(self):
         """Resolve deferred per-step overflow flags (host sync happens HERE,
         off the dispatch critical path)."""

@@ -1,0 +1,153 @@
+"""Launcher host-logic tests: hostfile parsing, include/exclude filters,
+world-info encoding, node-rank inference, child-env contract (reference
+``tests/unit/launcher`` + ``launcher/runner.py:199,254,352``,
+``launcher/launch.py:132``)."""
+import base64
+import json
+import socket
+
+import pytest
+
+from deepspeed_tpu.launcher.launch import build_child_env, infer_node_rank
+from deepspeed_tpu.launcher.runner import (encode_world_info, fetch_hostfile,
+                                           parse_resource_filter)
+
+
+# ---------------------------------------------------------------------------
+# hostfile
+# ---------------------------------------------------------------------------
+def test_fetch_hostfile_parses_slots_and_skips_comments(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("# cluster\nworker-0 slots=4\n\nworker-1 slots=8\n")
+    assert fetch_hostfile(str(hf)) == {"worker-0": 4, "worker-1": 8}
+
+
+def test_fetch_hostfile_preserves_order(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("z slots=1\na slots=2\nm slots=3\n")
+    assert list(fetch_hostfile(str(hf))) == ["z", "a", "m"]
+
+
+def test_fetch_hostfile_malformed_line_raises(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("worker-0 gpus=4\n")
+    with pytest.raises(ValueError, match="malformed"):
+        fetch_hostfile(str(hf))
+
+
+def test_fetch_hostfile_duplicate_host_raises(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("w slots=4\nw slots=2\n")
+    with pytest.raises(ValueError, match="duplicate"):
+        fetch_hostfile(str(hf))
+
+
+def test_fetch_hostfile_missing_returns_empty(tmp_path):
+    assert fetch_hostfile(str(tmp_path / "nope")) == {}
+
+
+# ---------------------------------------------------------------------------
+# --include / --exclude (reference runner.py:254 semantics)
+# ---------------------------------------------------------------------------
+POOL = {"w0": 4, "w1": 4, "w2": 2}
+
+
+def test_filter_noop_copies_pool():
+    out = parse_resource_filter(dict(POOL))
+    assert out == POOL
+
+
+def test_include_whole_host_and_slot_list():
+    out = parse_resource_filter(dict(POOL), include_str="w0@w2:0,1")
+    assert out == {"w0": 4, "w2": 2}
+
+
+def test_exclude_whole_host():
+    out = parse_resource_filter(dict(POOL), exclude_str="w1")
+    assert out == {"w0": 4, "w2": 2}
+
+
+def test_exclude_slot_subset_shrinks_host():
+    out = parse_resource_filter(dict(POOL), exclude_str="w0:0,1")
+    assert out == {"w0": 2, "w1": 4, "w2": 2}
+
+
+def test_include_and_exclude_mutually_exclusive():
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        parse_resource_filter(dict(POOL), include_str="w0", exclude_str="w1")
+
+
+def test_include_unknown_host_raises():
+    with pytest.raises(ValueError, match="not in hostfile"):
+        parse_resource_filter(dict(POOL), include_str="ghost")
+
+
+def test_include_out_of_range_slot_raises():
+    with pytest.raises(ValueError, match="invalid"):
+        parse_resource_filter(dict(POOL), include_str="w2:0,3")
+
+
+# ---------------------------------------------------------------------------
+# world info + child env
+# ---------------------------------------------------------------------------
+def test_encode_world_info_round_trips():
+    blob = encode_world_info(POOL)
+    assert json.loads(base64.urlsafe_b64decode(blob)) == POOL
+
+
+def test_build_child_env_contract():
+    env = build_child_env(node_rank=2, nnodes=4, master_addr="10.0.0.1",
+                          master_port=29500, num_chips=8)
+    assert env["COORDINATOR_ADDRESS"] == "10.0.0.1:29500"
+    assert env["JAX_PROCESS_ID"] == "2" and env["JAX_NUM_PROCESSES"] == "4"
+    # reference-compatible names for user scripts
+    assert env["RANK"] == "2" and env["WORLD_SIZE"] == "4"
+    assert env["MASTER_ADDR"] == "10.0.0.1" and env["MASTER_PORT"] == "29500"
+    assert env["DS_TPU_NUM_CHIPS"] == "8"
+
+
+# ---------------------------------------------------------------------------
+# node-rank inference (launch.py:21; round-1 advisor fix)
+# ---------------------------------------------------------------------------
+def test_scheduler_env_wins(monkeypatch):
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "3")
+    monkeypatch.setenv("DS_NODE_LIST", "a,b,c,d,e")
+    assert infer_node_rank() == 3
+
+
+def test_slurm_nodeid(monkeypatch):
+    for var in ("OMPI_COMM_WORLD_RANK", "PMI_RANK"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("SLURM_NODEID", "1")
+    assert infer_node_rank() == 1
+
+
+def test_single_host_node_list_is_rank_zero(monkeypatch):
+    for var in ("OMPI_COMM_WORLD_RANK", "PMI_RANK", "SLURM_NODEID"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("DS_NODE_LIST", "whatever-name")
+    assert infer_node_rank() == 0
+
+
+def test_node_list_position_by_hostname(monkeypatch):
+    for var in ("OMPI_COMM_WORLD_RANK", "PMI_RANK", "SLURM_NODEID"):
+        monkeypatch.delenv(var, raising=False)
+    me = socket.gethostname()
+    monkeypatch.setenv("DS_NODE_LIST", f"other-0,{me},other-2")
+    assert infer_node_rank() == 1
+
+
+def test_node_list_without_this_host_is_hard_error(monkeypatch):
+    for var in ("OMPI_COMM_WORLD_RANK", "PMI_RANK", "SLURM_NODEID"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("DS_NODE_LIST", "other-0,other-1")
+    with pytest.raises(RuntimeError, match="does not contain this"):
+        infer_node_rank()
+
+
+def test_no_signal_falls_back_to_default(monkeypatch):
+    for var in ("OMPI_COMM_WORLD_RANK", "PMI_RANK", "SLURM_NODEID", "DS_NODE_LIST"):
+        monkeypatch.delenv(var, raising=False)
+    assert infer_node_rank(default=0) == 0
+    with pytest.raises(RuntimeError, match="not determinable"):
+        infer_node_rank(default=-1)

@@ -1,0 +1,92 @@
+"""Shared measurement core for the TPU perf tools (perf_sweep2, perf_ladder):
+one engine-building + fused-scan-timing + TFLOPS-reporting methodology so
+the tools' numbers stay comparable. All timings chain data dependencies
+inside one scanned program — per-dispatch loops are NOT trustworthy on the
+axon tunnel (its dedupe cache fakes them, PERF.md session 3)."""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+BASELINE_TFLOPS = 64.0  # reference headline, BASELINE.md
+
+
+def enable_compile_cache():
+    try:
+        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_comp_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+
+def build_engine(model_name, mb, seq, ds_overrides=None, **cfg_overrides):
+    """GPT-2 engine + batch at the bench methodology's defaults
+    (bf16, flash attention, remat). Returns (engine, batch, n_params)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+
+    cfg = get_gpt2_config(model_name, n_positions=seq, remat=True,
+                          attention_backend="flash", dtype=jnp.bfloat16,
+                          **cfg_overrides)
+    ds = {
+        "train_batch_size": mb,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        "zero_optimization": {"stage": 0},
+        "steps_per_print": 10**9,
+    }
+    ds.update(ds_overrides or {})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2LMHeadModel(cfg), config=ds)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (mb, seq)).astype(np.int32)}
+    engine.initialize_state(batch)
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(engine.state.params))
+    return engine, batch, n_params
+
+
+def time_fused(engine, batch, fused=10, timed_dispatches=2):
+    """Compile+warm one fused-scan program, then time ``timed_dispatches``
+    back-to-back dispatches. Returns (n_steps, seconds, compile_seconds)."""
+    t_start = time.time()
+    stack = jax.tree.map(lambda x: np.broadcast_to(x, (fused,) + np.shape(x)), batch)
+    engine.train_batches(stack)
+    jax.block_until_ready(engine.state.params)
+    compile_s = time.time() - t_start
+    t0 = time.time()
+    for _ in range(timed_dispatches):
+        engine.train_batches(stack)
+    jax.block_until_ready(engine.state.params)
+    return fused * timed_dispatches, time.time() - t0, compile_s
+
+
+def time_per_dispatch(engine, batch, steps):
+    """Per-dispatch loop for host-driven schedules (offload, 1-bit phases)
+    where the scan path is unavailable. Subject to tunnel-dedupe caveats."""
+    engine.train_batch(batch)
+    jax.block_until_ready(engine.state.params)
+    t0 = time.time()
+    for _ in range(steps):
+        engine.train_batch(batch)
+    jax.block_until_ready(engine.state.params)
+    return steps, time.time() - t0, None
+
+
+def report(tag, mb, seq, n_params, n_steps, seconds, compile_s=None, **extra):
+    tok = mb * seq * n_steps / seconds
+    tflops = 6.0 * n_params * tok / 1e12
+    line = {"tag": tag, "params_m": round(n_params / 1e6, 1), "mb": mb,
+            "step_ms": round(seconds / n_steps * 1e3, 1),
+            "tokens_per_s": round(tok, 1), "tflops": round(tflops, 2),
+            "vs_baseline": round(tflops / BASELINE_TFLOPS, 3)}
+    if compile_s is not None:
+        line["compile_s"] = round(compile_s, 1)
+    line.update(extra)
+    print(json.dumps(line), flush=True)
+    return tflops

@@ -4,11 +4,13 @@ process wedges the axon tunnel claim (PERF.md wedge #3: a 760m fused-10
 compile alone can exceed 25 min). The script instead checks an INTERNAL
 deadline between rungs and exits cleanly; a rung whose compile is in
 flight is allowed to finish. Each rung is try/except-isolated; results
-print as they land.
+print as they land. Measurement methodology is shared with the other
+perf tools via bench_core.
 
 Run: python tools/perf_ladder.py            (background it; poll stdout)
 Env: LADDER=760m_mb4,760m_mb8,xl_offload_mb1  (comma list; default 760m)
      LADDER_DEADLINE=3600  (seconds; checked between rungs only)
+     LADDER_FUSED=10       (steps per fused dispatch; lower = faster compile)
 """
 import json
 import os
@@ -16,76 +18,31 @@ import sys
 import time
 import traceback
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import numpy as np
-import jax
-import jax.numpy as jnp
-
-try:
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_comp_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-except Exception:
-    pass
-
-import deepspeed_tpu
-from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+from bench_core import (build_engine, enable_compile_cache, report,
+                        time_fused, time_per_dispatch)
 
 SEQ = 1024
 
 
-def run_rung(tag, model_name, mb, fused=10, offload=False, steps=None):
-    t_start = time.time()
-    cfg = get_gpt2_config(model_name, n_positions=SEQ, remat=True,
-                          attention_backend="flash", dtype=jnp.bfloat16,
-                          vocab_size=50304, embed_onehot_grad=True)
-    model = GPT2LMHeadModel(cfg)
-    ds = {
-        "train_batch_size": mb,
-        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.01}},
-        "bf16": {"enabled": True},
-        "gradient_clipping": 1.0,
-        "zero_optimization": {"stage": 0},
-        "steps_per_print": 10**9,
-    }
+def run_rung(tag, model_name, mb, offload=False, steps=None):
+    ds_overrides = {}
     if offload:
-        ds["zero_optimization"] = {
+        ds_overrides["zero_optimization"] = {
             "stage": 2,
             "offload_optimizer": {"device": "cpu", "pin_memory": True},
         }
-    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds)
-    rng = np.random.default_rng(0)
-    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (mb, SEQ)).astype(np.int32)}
-    engine.initialize_state(batch)
-    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(engine.state.params))
+    engine, batch, n_params = build_engine(
+        model_name, mb, SEQ, ds_overrides=ds_overrides,
+        vocab_size=50304, embed_onehot_grad=True)
     if offload:
         # host-driven schedule: per-step dispatch is the real path here
-        n = steps or 3
-        engine.train_batch(batch)  # warmup/compile
-        jax.block_until_ready(engine.state.params)
-        t0 = time.time()
-        for _ in range(n):
-            engine.train_batch(batch)
-        jax.block_until_ready(engine.state.params)
-        dt, n_steps = time.time() - t0, n
+        n_steps, dt, compile_s = time_per_dispatch(engine, batch, steps or 3)
     else:
-        stack = {"input_ids": np.broadcast_to(batch["input_ids"],
-                                              (fused,) + batch["input_ids"].shape)}
-        engine.train_batches(stack)
-        jax.block_until_ready(engine.state.params)
-        t0 = time.time()
-        engine.train_batches(stack)
-        engine.train_batches(stack)
-        jax.block_until_ready(engine.state.params)
-        dt, n_steps = time.time() - t0, 2 * fused
-    compile_s = time.time() - t_start - dt
-    tok = mb * SEQ * n_steps / dt
-    tflops = 6.0 * n_params * tok / 1e12
-    print(json.dumps({"tag": tag, "params_m": round(n_params / 1e6, 1),
-                      "mb": mb, "step_ms": round(dt / n_steps * 1e3, 1),
-                      "tokens_per_s": round(tok, 1), "tflops": round(tflops, 2),
-                      "vs_baseline": round(tflops / 64.0, 3),
-                      "compile_s": round(compile_s, 1)}), flush=True)
+        fused = int(os.environ.get("LADDER_FUSED", "10"))
+        n_steps, dt, compile_s = time_fused(engine, batch, fused=fused)
+    report(tag, mb, SEQ, n_params, n_steps, dt, compile_s)
 
 
 RUNGS = {
@@ -97,6 +54,7 @@ RUNGS = {
 
 
 def main():
+    enable_compile_cache()
     deadline = time.time() + int(os.environ.get("LADDER_DEADLINE", "3600"))
     want = os.environ.get("LADDER", "760m_mb4,760m_mb8").split(",")
     print(f"# ladder seq={SEQ}: {want}", flush=True)

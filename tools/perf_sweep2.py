@@ -1,40 +1,27 @@
-"""One clean-exit TPU sweep over the round-3 perf levers: micro-batch,
-one-hot embedding backward, lane-aligned vocab. Each config is an
-independent engine build inside THIS process (try/except per config, so
-an OOM on mb=16 doesn't lose the earlier results); results print
-immediately. Never kill this process — a killed TPU process wedges the
-axon tunnel claim. Budget: ~4 compiles; exit is clean even on failure.
+"""One clean-exit TPU sweep over the single-chip perf levers (micro-batch,
+one-hot embedding backward, lane-aligned vocab, fused LM-head loss). Each
+config is an independent engine build inside THIS process (try/except per
+config, so an OOM doesn't lose earlier results); results print as they
+land. NEVER wrap in `timeout` and never kill mid-run — a killed TPU
+process wedges the axon tunnel claim.
 
-Run: timeout 2800 python tools/perf_sweep2.py
+Run: python tools/perf_sweep2.py   (background it; poll stdout)
 """
 import json
 import os
 import sys
-import time
 import traceback
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import numpy as np
-import jax
-import jax.numpy as jnp
-
-try:
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_comp_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-except Exception:
-    pass
-
-import deepspeed_tpu
-from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+from bench_core import build_engine, enable_compile_cache, report, time_fused
 
 SEQ = 1024
 FUSED = 10
 MODEL = os.environ.get("BENCH_MODEL", "350m")
 
 
-def run_config(tag, mb, vocab=None, onehot=False, remat=True, xent_chunk=0):
-    t_start = time.time()
+def run_config(tag, mb, vocab=None, onehot=False, xent_chunk=0):
     overrides = {}
     if vocab:
         overrides["vocab_size"] = vocab
@@ -42,42 +29,13 @@ def run_config(tag, mb, vocab=None, onehot=False, remat=True, xent_chunk=0):
         overrides["embed_onehot_grad"] = True
     if xent_chunk:
         overrides["fused_head_loss_chunk"] = xent_chunk
-    cfg = get_gpt2_config(MODEL, n_positions=SEQ, remat=remat,
-                          attention_backend="flash", dtype=jnp.bfloat16,
-                          **overrides)
-    model = GPT2LMHeadModel(cfg)
-    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
-        "train_batch_size": mb,
-        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.01}},
-        "bf16": {"enabled": True},
-        "gradient_clipping": 1.0,
-        "zero_optimization": {"stage": 0},
-        "steps_per_print": 10**9,
-    })
-    rng = np.random.default_rng(0)
-    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (mb, SEQ)).astype(np.int32)}
-    engine.initialize_state(batch)
-    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(engine.state.params))
-    stack = {"input_ids": np.broadcast_to(batch["input_ids"],
-                                          (FUSED,) + batch["input_ids"].shape)}
-    engine.train_batches(stack)  # compile + warmup
-    jax.block_until_ready(engine.state.params)
-    compile_s = time.time() - t_start
-    t0 = time.time()
-    engine.train_batches(stack)
-    engine.train_batches(stack)
-    jax.block_until_ready(engine.state.params)
-    dt = time.time() - t0
-    steps = 2 * FUSED
-    tok = mb * SEQ * steps / dt
-    tflops = 6.0 * n_params * tok / 1e12
-    print(json.dumps({"tag": tag, "mb": mb, "step_ms": round(dt / steps * 1e3, 1),
-                      "tokens_per_s": round(tok, 1), "tflops": round(tflops, 2),
-                      "compile_s": round(compile_s, 1)}), flush=True)
-    return tflops
+    engine, batch, n_params = build_engine(MODEL, mb, SEQ, **overrides)
+    n_steps, dt, compile_s = time_fused(engine, batch, fused=FUSED)
+    report(tag, mb, SEQ, n_params, n_steps, dt, compile_s)
 
 
 def main():
+    enable_compile_cache()
     print(f"# sweep2 model={MODEL} seq={SEQ} fused={FUSED}", flush=True)
     configs = [
         ("mb8_fusedxent", dict(mb=8, vocab=50304, onehot=True, xent_chunk=1024)),
@@ -87,7 +45,7 @@ def main():
         try:
             run_config(tag, **kw)
         except Exception as e:  # noqa: BLE001 — keep sweeping past OOMs
-            print(json.dumps({"tag": tag, "error": f"{type(e).__name__}: {e}"}),
+            print(json.dumps({"tag": tag, "error": f"{type(e).__name__}: {str(e)[:300]}"}),
                   flush=True)
             traceback.print_exc(file=sys.stderr)
     print("# DONE", flush=True)
