@@ -53,6 +53,11 @@ class GPT2Config:
     # fused LM head (models/common.py fused_lm_head_loss) — never
     # materializes [B, L, V] logits; the value is tokens per chunk
     fused_head_loss_chunk: int = 0
+    # progressive layer drop (arXiv:2010.13369; reference
+    # ``runtime/progressive_layer_drop.py``): when True and the engine
+    # passes ``pld_theta``, each sublayer is stochastically skipped at
+    # train time with depth-scaled keep probability
+    progressive_layer_drop: bool = False
     # MoE (reference GPT-MoE configs: every other layer is an MoE FFN)
     moe_num_experts: int = 0  # 0 = dense model
     moe_layer_freq: int = 2  # MoE every Nth block (reference expert-interval)
@@ -192,13 +197,27 @@ class Block(nn.Module):
     use_moe: bool = False
     decode: bool = False
 
+    def _pld_gate(self, branch, keep):
+        """Switchable-Transformer gate (PLD paper §3): keep the sublayer
+        with probability ``keep`` and rescale by 1/keep so expectations
+        match; a dropped sublayer contributes nothing (and its FLOPs are
+        still spent under jit — the benefit on TPU is regularization
+        parity, not wall-clock, which is why the engine anneals theta
+        in-graph rather than re-tracing)."""
+        if keep is None:
+            return branch
+        b = jax.random.bernoulli(self.make_rng("pld"), keep)
+        return jnp.where(b, branch / keep, jnp.zeros_like(branch))
+
     @nn.compact
-    def __call__(self, x, deterministic: bool = True):
+    def __call__(self, x, deterministic: bool = True, pld_keep=None):
         # deterministic is positional (not kw-only) so nn.remat can mark it
         # static (static_argnums below)
         cfg = self.config
-        x = x + SelfAttention(cfg, self.decode, name="attn")(LayerNorm(cfg, name="ln_1")(x),
-                                                             deterministic=deterministic)
+        keep = None if (deterministic or pld_keep is None) else pld_keep
+        attn_out = SelfAttention(cfg, self.decode, name="attn")(LayerNorm(cfg, name="ln_1")(x),
+                                                                deterministic=deterministic)
+        x = x + self._pld_gate(attn_out, keep)
         h = LayerNorm(cfg, name="ln_2")(x)
         if self.use_moe:
             from deepspeed_tpu.moe import MoE
@@ -214,9 +233,9 @@ class Block(nn.Module):
                                     drop_tokens=cfg.moe_drop_tokens,
                                     use_rts=cfg.moe_use_rts,
                                     name="moe")(h, deterministic=deterministic)
-            x = x + moe_out
+            x = x + self._pld_gate(moe_out, keep)
             return x, l_aux
-        x = x + MLP(cfg, name="mlp")(h, deterministic=deterministic)
+        x = x + self._pld_gate(MLP(cfg, name="mlp")(h, deterministic=deterministic), keep)
         return x, jnp.zeros([], jnp.float32)
 
 
@@ -227,7 +246,7 @@ class GPT2LMHeadModel(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, *, deterministic: bool = True, decode: bool = False,
-                 labels=None):
+                 labels=None, pld_theta=None):
         cfg = self.config
         wte = self.param("wte", nn.with_logical_partitioning(_dense_init(), ("vocab", "embed")),
                          (cfg.vocab_size, cfg.n_embd), cfg.param_dtype)
@@ -253,10 +272,13 @@ class GPT2LMHeadModel(nn.Module):
 
         from deepspeed_tpu.models.common import maybe_remat
         aux_total = jnp.zeros([], jnp.float32)
+        use_pld = cfg.progressive_layer_drop and pld_theta is not None and not deterministic
         for i in range(cfg.n_layer):
             use_moe = cfg.moe_num_experts > 0 and (i % cfg.moe_layer_freq == cfg.moe_layer_freq - 1)
             block_cls = maybe_remat(Block, cfg, i, static_argnums=(2,))
-            x, l_aux = block_cls(cfg, use_moe, decode, name=f"h_{i}")(x, deterministic)
+            # PLD depth scaling (paper eq. 6): deeper blocks drop more often
+            keep_i = 1.0 - (i + 1) / cfg.n_layer * (1.0 - pld_theta) if use_pld else None
+            x, l_aux = block_cls(cfg, use_moe, decode, name=f"h_{i}")(x, deterministic, keep_i)
             aux_total = aux_total + l_aux
         x = LayerNorm(cfg, name="ln_f")(x)
         if labels is not None and cfg.fused_head_loss_chunk > 0:

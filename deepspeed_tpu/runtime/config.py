@@ -194,6 +194,10 @@ class DeepSpeedConfig:
         self.data_efficiency_config = param_dict.get(C.DATA_EFFICIENCY, {})
         self.curriculum_learning_legacy = param_dict.get(C.CURRICULUM_LEARNING_LEGACY, {})
         self.curriculum_enabled_legacy = bool(self.curriculum_learning_legacy.get("enabled", False))
+        pld = param_dict.get(C.PROGRESSIVE_LAYER_DROP, {})
+        self.pld_enabled = bool(pld.get("enabled", False))
+        self.pld_params = {"theta": float(pld.get("theta", 0.5)),
+                           "gamma": float(pld.get("gamma", 0.001))}
 
     # ------------------------------------------------------------------
     def _resolve_batch_size(self, world_size: Optional[int]):

@@ -126,5 +126,6 @@ ELASTICITY = "elasticity"
 COMPRESSION_TRAINING = "compression_training"
 DATA_EFFICIENCY = "data_efficiency"
 CURRICULUM_LEARNING_LEGACY = "curriculum_learning"
+PROGRESSIVE_LAYER_DROP = "progressive_layer_drop"
 CHECKPOINT = "checkpoint"
 DATA_TYPES = "data_types"

@@ -203,6 +203,26 @@ class DeepSpeedEngine:
             log_dist(f"curriculum learning enabled: metric={self.curriculum_metric} "
                      f"schedule={cl_cfg.get('schedule_type')}")
 
+        # progressive layer drop (reference _configure_progressive_layer_drop;
+        # engine.progressive_layer_drop is the host mirror users read, the
+        # in-graph theta is computed from state.step in the train step so the
+        # fused multi-step dispatch anneals it without recompiling)
+        self.progressive_layer_drop = None
+        if config.pld_enabled:
+            import inspect
+            from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+            self.progressive_layer_drop = ProgressiveLayerDrop(**config.pld_params)
+            accepts = "pld_theta" in inspect.signature(type(self.module).__call__).parameters
+            flag_on = bool(getattr(getattr(self.module, "config", None),
+                                   "progressive_layer_drop", False))
+            if not (accepts and flag_on):
+                logger.warning("progressive_layer_drop enabled but the model will not "
+                               "drop layers (model accepts pld_theta: %s, model config "
+                               "progressive_layer_drop flag: %s) — set "
+                               "progressive_layer_drop=True on a supporting model "
+                               "config, e.g. GPT2Config; theta will anneal but no "
+                               "layers will drop", accepts, flag_on)
+
         log_dist(f"DeepSpeedEngine: zero_stage={config.zero_optimization_stage} "
                  f"dtype={self.compute_dtype.__name__} mesh={dict(self.mesh.shape)}")
 
@@ -427,15 +447,27 @@ class DeepSpeedEngine:
     # (reference stage_1_and_2 cpu_offload / stage3 + swap_tensor; SURVEY §7.3)
     # ------------------------------------------------------------------
     def _accumulate_grads(self, params, batch, rng, scale, grad_shardings, gas, clip, fp16,
-                          params_transform=None):
+                          params_transform=None, model_extra=None):
         """The shared fwd+bwd core: GAS microbatch scan, 1/gas averaging,
         quantized or full-precision ZeRO reduction, clipping, overflow.
         Used by the fused on-device step AND the offload grads-only step so
         the two paths cannot drift. ``params_transform`` (compression-in-
         forward) runs INSIDE the grad closure so masks gate gradients and
-        the quantization STE applies."""
+        the quantization STE applies. ``model_extra`` (traced scalars such
+        as the PLD theta) merges into every microbatch dict so
+        ``_module_kwargs`` forwards it to the model."""
         keys = jax.random.split(rng, gas)
         loss_for = self._loss_for
+        if model_extra:
+            base_loss_for_extra = loss_for
+
+            def loss_for(p, mb, key, scale, train=True):
+                # raw-array batches are normalized to a dict so the extras
+                # (pld_theta) still reach the model
+                mb = dict(mb, **model_extra) if isinstance(mb, dict) \
+                    else dict({"input_ids": mb}, **model_extra)
+                return base_loss_for_extra(p, mb, key, scale, train=train)
+        loss_for_with_extra = loss_for
         if params_transform is not None:
             base_loss_for = loss_for
 
@@ -449,8 +481,10 @@ class DeepSpeedEngine:
             # partition_parameters.py:628)
             from deepspeed_tpu.runtime.zero.qcomm import qcomm_accumulate
             zc = self.config.zero_config
+            # the model_extra wrapper (PLD theta) rides into the qcomm trace;
+            # params_transform stays fused-path-only (warning at setup)
             fn = qcomm_accumulate(
-                self._loss_for, self.mesh, self.plan.param_specs, self.plan.grad_specs,
+                loss_for_with_extra, self.mesh, self.plan.param_specs, self.plan.grad_specs,
                 batch, self._batch_spec(with_gas_dim=True), gas=gas,
                 quantized_weights=bool(zc.zero_quantized_weights),
                 quantized_gradients=bool(zc.zero_quantized_gradients),
@@ -788,10 +822,18 @@ class DeepSpeedEngine:
         if fused_head:
             extra = dict(extra,
                          labels=mb.get("labels", ids) if isinstance(mb, dict) else mb)
-        if train and (has_dropout or has_moe):
-            drop_key, gate_key = jax.random.split(key)
+        has_pld = "pld_theta" in extra  # only set when the module accepts it
+        if train and (has_dropout or has_moe or has_pld):
+            # 2-way split preserved when PLD is off: existing dropout/gating
+            # rng streams are a reproducibility contract
+            if has_pld:
+                drop_key, gate_key, pld_key = jax.random.split(key, 3)
+                rngs = {"dropout": drop_key, "gating": gate_key, "pld": pld_key}
+            else:
+                drop_key, gate_key = jax.random.split(key)
+                rngs = {"dropout": drop_key, "gating": gate_key}
             outputs = self.module.apply({"params": cparams}, ids, deterministic=False,
-                                        rngs={"dropout": drop_key, "gating": gate_key}, **extra)
+                                        rngs=rngs, **extra)
         else:
             # eval: deterministic gating (eval capacity factor, no RTS/noise);
             # the aux loss is a training-only regularizer — report pure CE
@@ -945,9 +987,17 @@ class DeepSpeedEngine:
             scale = state.loss_scale.loss_scale if fp16 else jnp.float32(1.0)
             ctrans = self._compression_transform
             pt = (lambda p: ctrans(p, state.step)) if ctrans is not None else None
+            extra = None
+            if self.progressive_layer_drop is not None:
+                # reference theta schedule, computed in-graph from the step
+                # counter so the fused scan anneals without recompiles
+                pld = self.progressive_layer_drop
+                theta = ((1.0 - pld.theta) * jnp.exp(-pld.gamma * state.step.astype(jnp.float32))
+                         + pld.theta)
+                extra = {"pld_theta": theta}
             losses, grads, gnorm, overflow = self._accumulate_grads(
                 state.params, batch, rng, scale, grad_shardings, gas, clip, fp16,
-                params_transform=pt)
+                params_transform=pt, model_extra=extra)
 
             # overflow → skip update (reference stage step-skip semantics).
             # Applied in every dtype mode: for bf16/fp32 `overflow` is a
@@ -1384,6 +1434,9 @@ class DeepSpeedEngine:
         # step cannot be enqueued behind a host sync). Device arrays are
         # stashed and resolved lazily — in accessors, at steps_per_print
         # boundaries, or when the pending-overflow window fills.
+        if self.progressive_layer_drop is not None:
+            # host mirror of the in-graph schedule (reference update_state)
+            self.progressive_layer_drop.update_state(self.global_steps)
         if "compressed_update_norm" in metrics:
             self._last_compressed_update_norm = metrics["compressed_update_norm"]
         if "grad_norm" in metrics:

@@ -1,0 +1,32 @@
+"""Progressive Layer Dropping (PLD) — API parity with the reference
+``runtime/progressive_layer_drop.py`` (theta/gamma schedule, ``get_state``
+kwargs for the model), arXiv:2010.13369.
+
+The schedule itself is host-side and mirrors the reference exactly:
+``theta(t) = (1 - theta) * exp(-gamma * t) + theta`` — the expected keep
+ratio anneals from 1.0 toward ``theta``. The engine additionally computes
+the same expression IN-GRAPH from ``state.step`` and feeds it to models
+that accept ``pld_theta`` (GPT2Config.progressive_layer_drop), so the
+fused multi-step dispatch advances theta per step without recompiling;
+this class is the host mirror users and monitors read."""
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class ProgressiveLayerDrop:
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+        log_dist(f"Enabled progressive layer dropping (theta = {self.theta})")
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self):
+        return self.current_theta
+
+    def update_state(self, global_step: int):
+        self.current_theta = (1.0 - self.theta) * float(np.exp(-self.gamma * global_step)) + self.theta

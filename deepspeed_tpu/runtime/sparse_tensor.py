@@ -1,0 +1,83 @@
+"""Compressed sparse (IndexedSlices-style) row tensor — parity with the
+reference ``runtime/sparse_tensor.py``, used for exchanging embedding-row
+gradients without shipping the dense [V, E] matrix.
+
+TPU notes: XLA wants static shapes, so unlike the reference (whose
+``nonzero`` yields a data-dependent count) the canonical construction is
+``from_rows(indices, values)`` with the row count fixed by the batch's
+token count — exactly what an embedding-gather VJP produces (row ids =
+the input ids). ``from_dense`` keeps reference semantics for host-side
+use (np-based, data-dependent size). ``to_dense`` is a segment-sum, which
+XLA lowers efficiently; duplicated indices accumulate, matching the
+reference's ``scatter_add_``. ``all_gather_rows`` is the comm pattern the
+reference's ``sparse_allreduce_bucket`` implements with NCCL gathers."""
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class SparseTensor:
+    """Compressed sparse row slices of a 2-D dense tensor."""
+
+    def __init__(self, dense_tensor=None):
+        self.orig_dense_tensor = dense_tensor
+        self.is_sparse = False
+        if dense_tensor is not None:
+            dense = np.asarray(dense_tensor)
+            nz = np.flatnonzero(np.abs(dense).sum(axis=1))
+            self.indices = jnp.asarray(nz, jnp.int32)
+            self.values = jnp.asarray(dense[nz])
+            self.dense_size = list(dense.shape)
+        else:
+            self.indices = None
+            self.values = None
+            self.dense_size = None
+
+    @classmethod
+    def from_rows(cls, indices, values, dense_size: Sequence[int]) -> "SparseTensor":
+        """Static-shape construction (jit-friendly): ``indices`` [N] row
+        ids (duplicates fine — they accumulate), ``values`` [N, E]."""
+        st = cls()
+        st.indices = jnp.asarray(indices, jnp.int32)
+        st.values = jnp.asarray(values)
+        st.dense_size = list(dense_size)
+        return st
+
+    @staticmethod
+    def type() -> str:
+        return "deepspeed.SparseTensor"
+
+    def to_dense(self):
+        return jax.ops.segment_sum(self.values, self.indices,
+                                   num_segments=self.dense_size[0])
+
+    def sparse_size(self):
+        index_size = int(self.indices.shape[0])
+        value_size = int(self.values.shape[0]) * int(self.values.shape[1])
+        dense_size = self.dense_size[0] * self.dense_size[1]
+        return index_size + value_size, dense_size
+
+    def add(self, b: "SparseTensor"):
+        assert self.dense_size == b.dense_size, "unmatched sparse tensor sizes"
+        self.indices = jnp.concatenate([self.indices, b.indices])
+        self.values = jnp.concatenate([self.values, b.values])
+
+    def __str__(self):
+        sparse_size, dense_size = self.sparse_size()
+        return (f"DeepSpeed.SparseTensor(indices_size={self.indices.shape}, "
+                f"values_size={self.values.shape}, dense_size={self.dense_size}, "
+                f"reduction_factor={dense_size / sparse_size:.2f})")
+
+    __repr__ = __str__
+
+
+def all_gather_rows(st: SparseTensor, axis_name) -> SparseTensor:
+    """Inside ``shard_map``: gather every rank's (indices, values) along
+    ``axis_name`` — the sparse "allreduce" (concatenated slices accumulate
+    on ``to_dense``, reference ``engine.sparse_allreduce``)."""
+    idx = jax.lax.all_gather(st.indices, axis_name, tiled=True)
+    vals = jax.lax.all_gather(st.values, axis_name, tiled=True)
+    return SparseTensor.from_rows(idx, vals, st.dense_size)

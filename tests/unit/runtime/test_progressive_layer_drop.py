@@ -1,0 +1,96 @@
+"""Progressive layer drop (reference ``runtime/progressive_layer_drop.py``,
+arXiv:2010.13369): schedule parity, engine wiring, eval unaffected."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+
+
+def test_theta_schedule_matches_reference_formula():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.001)
+    assert pld.get_theta() == 1.0
+    for step in (0, 10, 1000, 100000):
+        pld.update_state(step)
+        want = (1.0 - 0.5) * np.exp(-0.001 * step) + 0.5
+        assert pld.get_theta() == pytest.approx(want)
+    assert pld.get_state() == {"progressive_layer_drop": True,
+                               "pld_theta": pld.get_theta()}
+
+
+def _engine(pld_enabled, model_flag=True, seed_cfg=None):
+    cfg = get_gpt2_config("test", dtype=jnp.bfloat16,
+                          progressive_layer_drop=model_flag, **(seed_cfg or {}))
+    ds = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "steps_per_print": 10**9,
+    }
+    if pld_enabled:
+        # gamma large so theta visibly anneals within a few steps
+        ds["progressive_layer_drop"] = {"enabled": True, "theta": 0.5, "gamma": 0.5}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2LMHeadModel(cfg), config=ds)
+    return engine
+
+
+def make_batch(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, 250, (8, 64)).astype(np.int32)}
+
+
+def test_engine_trains_with_pld_and_theta_anneals():
+    engine = _engine(pld_enabled=True)
+    batch = make_batch()
+    losses = [float(engine.train_batch(batch)) for _ in range(6)]
+    assert all(np.isfinite(losses))
+    # host mirror annealed from 1.0 toward theta=0.5
+    theta = engine.progressive_layer_drop.get_theta()
+    assert 0.5 < theta < 1.0
+    # training still makes progress despite dropped layers
+    assert losses[-1] < losses[0]
+
+
+def test_pld_changes_training_but_not_eval():
+    batch = make_batch()
+    e_pld = _engine(pld_enabled=True)
+    e_ref = _engine(pld_enabled=False)
+    # same init (same seed path) -> eval before any training is identical:
+    # PLD gates only engage on the train path
+    e_pld.initialize_state(batch)
+    e_ref.initialize_state(batch)
+    ev_p = float(e_pld.eval_batch(batch))
+    ev_r = float(e_ref.eval_batch(batch))
+    assert ev_p == pytest.approx(ev_r, rel=1e-6)
+    # train losses diverge once drops engage (theta < 1 after step 1)
+    lp = [float(e_pld.train_batch(batch)) for _ in range(3)]
+    lr = [float(e_ref.train_batch(batch)) for _ in range(3)]
+    assert lp[2] != pytest.approx(lr[2], rel=1e-4)
+
+
+def test_fused_multi_step_dispatch_anneals_in_graph():
+    engine = _engine(pld_enabled=True)
+    batch = make_batch()
+    stack = {"input_ids": np.broadcast_to(batch["input_ids"], (4,) + batch["input_ids"].shape)}
+    losses = engine.train_batches(stack)
+    assert losses.shape == (4,)
+    assert bool(jnp.all(jnp.isfinite(losses)))
+    assert engine.global_steps == 4
+    # host mirror tracked all 4 steps
+    want = (1.0 - 0.5) * np.exp(-0.5 * 4) + 0.5
+    assert engine.progressive_layer_drop.get_theta() == pytest.approx(want)
+
+
+def test_warns_when_model_lacks_pld_support():
+    from deepspeed_tpu.models.bert import BertForMaskedLM, get_bert_config
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=BertForMaskedLM(get_bert_config("test")),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "progressive_layer_drop": {"enabled": True}})
+    assert engine.progressive_layer_drop is not None
