@@ -98,11 +98,27 @@ class InferenceEngine:
         if params is None:
             params = nn.meta.unbox(
                 self.module.init(self._rng, example, **example_extra)["params"])
-        if config.dtype is not None:
+        # int8 dtype means QUANTIZED weights (reference dtype=torch.int8):
+        # floats are cast to the serve dtype here and quantized after TP
+        # sharding below — a raw astype(int8) would destroy the weights
+        quant_on = bool(config.quant.enabled) or config.dtype == jnp.int8
+        cast_dtype = (jnp.bfloat16 if config.dtype == jnp.int8 else config.dtype)
+        if cast_dtype is not None:
             params = jax.tree.map(
-                lambda p: p.astype(config.dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+                lambda p: p.astype(cast_dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
         # -- TP weight placement (ReplaceWithTensorSlicing / AutoTP)
         self.params, self.param_specs = tp_shard_params(params, self.module, topology, example)
+
+        # -- int8 weight quantization (reference WeightQuantization applied
+        # at checkpoint load; here on the already-sharded tree, engine.py:299)
+        self._wq_scales = None
+        self._serve_dtype = cast_dtype or jnp.float32
+        if quant_on:
+            from deepspeed_tpu.runtime.weight_quantizer import WeightQuantization
+            wq = WeightQuantization(mp_size=topology.tensor_parallel_size)
+            self.params, self._wq_scales = wq.model_quantize(
+                self.params, quantize_bits=config.quant.bits,
+                group_size=max(1, config.quant.group_size))
 
         self._forward_fn = None
         self._prefill_fn = None
@@ -127,10 +143,19 @@ class InferenceEngine:
             return jax.device_put(ids, NamedSharding(self.mesh, P(("expert", "data", "fsdp"))))
         return jax.device_put(ids, NamedSharding(self.mesh, P()))
 
+    def _mparams(self, params):
+        """Runtime view of the weights: dequantizes int8 leaves in-graph
+        (the HBM copy stays int8; XLA materializes the serve-dtype view
+        per program, reference dequant-gemm kernels)."""
+        if self._wq_scales is None:
+            return params
+        from deepspeed_tpu.runtime.weight_quantizer import dequantize_tree
+        return dequantize_tree(params, self._wq_scales, self._serve_dtype)
+
     def _apply_decode(self, params, cache, ids):
         """One cached decode step; single source of the MoE logits unwrap."""
-        logits, upd = self.module.apply({"params": params, "cache": cache}, ids,
-                                        decode=True, mutable=["cache"])
+        logits, upd = self.module.apply({"params": self._mparams(params), "cache": cache},
+                                        ids, decode=True, mutable=["cache"])
         return _unwrap_logits(logits), upd
 
     # ------------------------------------------------------------------
@@ -138,7 +163,7 @@ class InferenceEngine:
         """Full-sequence logits (no cache) — reference ``engine.py:592``."""
         if self._forward_fn is None:
             def fwd(params, ids):
-                return _unwrap_logits(self.module.apply({"params": params}, ids))
+                return _unwrap_logits(self.module.apply({"params": self._mparams(params)}, ids))
             self._forward_fn = jax.jit(fwd)
         ids = self._place_batch(jnp.asarray(np.asarray(input_ids), jnp.int32))
         return self._forward_fn(self.params, ids)
@@ -294,10 +319,11 @@ class InferenceEngine:
         eos = -1 if eos_token_id is None else int(eos_token_id)
 
         def encode(params, enc_ids):
-            return model.apply({"params": params}, enc_ids, method=type(model).encode)
+            return model.apply({"params": self._mparams(params)}, enc_ids,
+                               method=type(model).encode)
 
         def step(params, cache, enc_out, tok):
-            logits, upd = model.apply({"params": params, "cache": cache},
+            logits, upd = model.apply({"params": self._mparams(params), "cache": cache},
                                       decoder_input_ids=tok, encoder_outputs=enc_out,
                                       decode=True, mutable=["cache"])
             return _unwrap_logits(logits), upd["cache"]

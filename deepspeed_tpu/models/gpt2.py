@@ -203,11 +203,13 @@ class Block(nn.Module):
         match; a dropped sublayer contributes nothing (and its FLOPs are
         still spent under jit — the benefit on TPU is regularization
         parity, not wall-clock, which is why the engine anneals theta
-        in-graph rather than re-tracing)."""
+        in-graph rather than re-tracing). Returns the gated branch and
+        the keep decision (so callers can gate side outputs such as the
+        MoE aux loss)."""
         if keep is None:
-            return branch
+            return branch, None
         b = jax.random.bernoulli(self.make_rng("pld"), keep)
-        return jnp.where(b, branch / keep, jnp.zeros_like(branch))
+        return jnp.where(b, branch / keep, jnp.zeros_like(branch)), b
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True, pld_keep=None):
@@ -217,7 +219,8 @@ class Block(nn.Module):
         keep = None if (deterministic or pld_keep is None) else pld_keep
         attn_out = SelfAttention(cfg, self.decode, name="attn")(LayerNorm(cfg, name="ln_1")(x),
                                                                 deterministic=deterministic)
-        x = x + self._pld_gate(attn_out, keep)
+        gated_attn, _ = self._pld_gate(attn_out, keep)
+        x = x + gated_attn
         h = LayerNorm(cfg, name="ln_2")(x)
         if self.use_moe:
             from deepspeed_tpu.moe import MoE
@@ -233,9 +236,15 @@ class Block(nn.Module):
                                     drop_tokens=cfg.moe_drop_tokens,
                                     use_rts=cfg.moe_use_rts,
                                     name="moe")(h, deterministic=deterministic)
-            x = x + self._pld_gate(moe_out, keep)
+            gated_moe, b = self._pld_gate(moe_out, keep)
+            x = x + gated_moe
+            if b is not None:
+                # a dropped expert layer must not push balancing gradients
+                # into its router either
+                l_aux = jnp.where(b, l_aux, jnp.zeros_like(l_aux))
             return x, l_aux
-        x = x + self._pld_gate(MLP(cfg, name="mlp")(h, deterministic=deterministic), keep)
+        gated_mlp, _ = self._pld_gate(MLP(cfg, name="mlp")(h, deterministic=deterministic), keep)
+        x = x + gated_mlp
         return x, jnp.zeros([], jnp.float32)
 
 

@@ -18,6 +18,7 @@ import dataclasses
 from typing import Any, Optional
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import flax.linen as nn
@@ -41,8 +42,11 @@ def replace_transformer_layer(model: nn.Module, config) -> nn.Module:
     if mcfg is None or not dataclasses.is_dataclass(mcfg):
         return model
     updates = {}
-    if config.dtype is not None and hasattr(mcfg, "dtype") and mcfg.dtype != config.dtype:
-        updates["dtype"] = config.dtype
+    # int8 means QUANTIZED WEIGHTS (reference dtype=torch.int8), not int8
+    # compute — the module computes at bf16 over dequantized views
+    compute_dtype = jnp.bfloat16 if config.dtype == jnp.int8 else config.dtype
+    if compute_dtype is not None and hasattr(mcfg, "dtype") and mcfg.dtype != compute_dtype:
+        updates["dtype"] = compute_dtype
     if (config.replace_with_kernel_inject and config.use_flash_prefill
             and hasattr(mcfg, "attention_backend") and mcfg.attention_backend != "flash"):
         # Pallas flash kernel for full-sequence forward() calls; the decode

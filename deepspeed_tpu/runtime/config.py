@@ -198,6 +198,7 @@ class DeepSpeedConfig:
         self.pld_enabled = bool(pld.get("enabled", False))
         self.pld_params = {"theta": float(pld.get("theta", 0.5)),
                            "gamma": float(pld.get("gamma", 0.001))}
+        self.quantize_training_config = param_dict.get(C.QUANTIZE_TRAINING, {})
 
     # ------------------------------------------------------------------
     def _resolve_batch_size(self, world_size: Optional[int]):

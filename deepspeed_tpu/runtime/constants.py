@@ -127,5 +127,6 @@ COMPRESSION_TRAINING = "compression_training"
 DATA_EFFICIENCY = "data_efficiency"
 CURRICULUM_LEARNING_LEGACY = "curriculum_learning"
 PROGRESSIVE_LAYER_DROP = "progressive_layer_drop"
+QUANTIZE_TRAINING = "quantize_training"
 CHECKPOINT = "checkpoint"
 DATA_TYPES = "data_types"

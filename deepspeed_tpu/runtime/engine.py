@@ -186,6 +186,10 @@ class DeepSpeedEngine:
         # compression-in-forward (set via compression.init_compression)
         self._compression_pending = False
         self._compression_config = None
+        if config.quantize_training_config.get("enabled", False):
+            # MoQ via config alone (no init_compression call) still resolves
+            # once the param tree exists
+            self._compression_pending = True
         self._compression_transform = None
 
         # -- curriculum learning (reference legacy surface,
@@ -222,6 +226,10 @@ class DeepSpeedEngine:
                                "progressive_layer_drop=True on a supporting model "
                                "config, e.g. GPT2Config; theta will anneal but no "
                                "layers will drop", accepts, flag_on)
+            if config.zero_config.offload_optimizer is not None:
+                logger.warning("progressive_layer_drop only applies on the fused "
+                               "train_batch path; the offload-optimizer step runs "
+                               "without layer dropping (theta still anneals)")
 
         log_dist(f"DeepSpeedEngine: zero_stage={config.zero_optimization_stage} "
                  f"dtype={self.compute_dtype.__name__} mesh={dict(self.mesh.shape)}")
@@ -960,8 +968,20 @@ class DeepSpeedEngine:
         # tree once shapes are known (compression.init_compression)
         if self._compression_pending and self.state is not None:
             from deepspeed_tpu.compression.compress import build_compression_transform
-            self._compression_transform = build_compression_transform(
-                self.state.params, self._compression_config)
+            self._compression_transform = (
+                build_compression_transform(self.state.params, self._compression_config)
+                if self._compression_config is not None else None)
+            # MoQ (quantize_training) chains after compression masks/quant —
+            # both are (params, step) -> params transforms
+            moq = None
+            if self.config.quantize_training_config.get("enabled", False):
+                from deepspeed_tpu.runtime.quantize import build_moq_transform
+                moq = build_moq_transform(self.state.params,
+                                          self.config.quantize_training_config)
+            if moq is not None:
+                comp = self._compression_transform
+                self._compression_transform = (
+                    moq if comp is None else (lambda p, s: moq(comp(p, s), s)))
             self._compression_pending = False
             if self._compression_transform is not None and self._use_qcomm:
                 log_dist("warning: compression-in-forward does not compose with the "

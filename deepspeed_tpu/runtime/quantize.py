@@ -1,0 +1,227 @@
+"""MoQ — Mixture-of-Quantization QAT (reference ``runtime/quantize.py``):
+anneal weight bit-width during training from ``start_bits`` to
+``target_bits``, halving-period style (each 1-bit reduction doubles the
+next period), with optional mixed-fp16 blending and ternary/binary floors.
+
+TPU redesign: the reference mutates ``p.data`` between steps from Python.
+Here the ENTIRE schedule is a pure function of the step counter, compiled
+into the train step via the engine's compression-in-forward hook
+(``build_moq_transform`` → ``params_transform(params, step)``): bit-width,
+period crossings, and the mixed-fp16 ratio are computed in-graph, so the
+fused multi-step dispatch anneals precision with zero recompiles and the
+quantization STE applies through autodiff. The ``Quantizer`` class keeps
+the reference's host API (``quantize(parameter_group, overflow, ...)``,
+``q_period`` doubling, eigenvalue factor) for direct users."""
+import math
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+TWO_D_PARAMS = 6
+
+
+def _grouped(x, groups: int):
+    return x.reshape(groups, -1)
+
+
+def _highbit_fake_quant(flat, bits, symmetric: bool, stochastic: bool, rng):
+    """Group-wise fake quant at a (possibly traced) float bit-width."""
+    q_range = jnp.exp2(bits)
+    g_min = flat.min(axis=-1, keepdims=True)
+    g_max = flat.max(axis=-1, keepdims=True)
+    p = (jax.random.uniform(rng, flat.shape, flat.dtype, -0.5, 0.5)
+         if stochastic else 0.0)
+    if symmetric:
+        scale = 2 * jnp.maximum(jnp.abs(g_min), jnp.abs(g_max)) / q_range
+        scale = jnp.maximum(scale, 1e-20)
+        return jnp.clip(jnp.round(flat / scale + p),
+                        -(q_range / 2), q_range / 2 - 1) * scale
+    scale = jnp.maximum((g_max - g_min) / q_range, 1e-20)
+    zero = jnp.round(g_min / scale) * scale
+    return jnp.clip(jnp.round((flat - zero) / scale + p),
+                    0, q_range - 1) * scale + zero
+
+
+def _ternary_fake_quant(flat):
+    n = flat.shape[-1]
+    m = jnp.sum(jnp.abs(flat), axis=-1, keepdims=True) / n
+    thres = 0.7 * m
+    mask = jnp.abs(flat) > thres
+    alpha = (jnp.sum(jnp.where(mask, jnp.abs(flat), 0), axis=-1, keepdims=True)
+             / jnp.maximum(jnp.sum(mask, axis=-1, keepdims=True), 1))
+    return jnp.where(flat > thres, alpha, 0) + jnp.where(flat < -thres, -alpha, 0)
+
+
+def _binary_fake_quant(flat):
+    m = jnp.mean(jnp.abs(flat), axis=-1, keepdims=True)
+    return jnp.sign(flat) * m
+
+
+def moq_bits_at(step, start_bits: int, target_bits: int, period: int):
+    """In-graph bit schedule: first reduction once ``step >= period``, each
+    further reduction after a doubled period (reference ``q_period <<= 1``)
+    — ``bits(t) = start - (floor(log2(t/period)) + 1)`` clamped to target."""
+    t = jnp.maximum(step.astype(jnp.float32), 1.0)
+    crossings = jnp.where(t < period, 0.0,
+                          jnp.floor(jnp.log2(t / period)) + 1.0)
+    return jnp.clip(start_bits - crossings, target_bits, start_bits)
+
+
+def fake_quantize_stepped(x, step, *, start_bits: int, target_bits: int,
+                          period: int, groups: int = 1, symmetric: bool = True,
+                          stochastic: bool = False, mixed_fp16: bool = False,
+                          change_ratio: float = 0.001, rng=None):
+    """Fake-quantize ``x`` at the schedule's bit-width for ``step`` —
+    fully traced (no recompiles as bits anneal). Ternary (2-bit) and
+    binary (1-bit) floors use the reference's dedicated forms."""
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat = _grouped(x.astype(jnp.float32), groups)
+    bits = moq_bits_at(step, start_bits, target_bits, period)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    high = _highbit_fake_quant(flat, jnp.maximum(bits, 3.0), symmetric, stochastic, rng)
+    out = high
+    if target_bits <= 2:
+        out = jnp.where(bits <= 2.0, _ternary_fake_quant(flat), out)
+    if target_bits <= 1:
+        out = jnp.where(bits <= 1.0, _binary_fake_quant(flat), out)
+    if mixed_fp16:
+        # ratio re-arms to 1.0 at each bit reduction and decays per step
+        t = jnp.maximum(step.astype(jnp.float32), 1.0)
+        crossings = jnp.where(t < period, 0.0, jnp.floor(jnp.log2(t / period)) + 1.0)
+        last_reduction = jnp.where(crossings > 0,
+                                   jnp.exp2(crossings - 1.0) * period, 0.0)
+        ratio = jnp.maximum(1.0 - change_ratio * (t - last_reduction), 0.0)
+        near_target = bits >= (target_bits - 1)
+        out = jnp.where(near_target, ratio * flat + (1.0 - ratio) * out, out)
+    return out.reshape(orig_shape).astype(orig_dtype)
+
+
+def build_moq_transform(params, config: Dict[str, Any]):
+    """Resolve a ``quantize_training`` config block against the live param
+    tree → ``(params, step) -> params`` for the engine's compression-in-
+    forward hook. Quantizes >=2-D floating leaves (the reference's
+    ``len(p.size()) > 1`` rule)."""
+    if not config or not config.get("enabled", False):
+        return None
+    bits_cfg = config.get("quantize_bits", config)
+    start_bits = int(bits_cfg.get("start_bits", 16))
+    target_bits = int(bits_cfg.get("target_bits", 8))
+    sched = config.get("quantize_schedule", {})
+    period = int(config.get("quantize_period", sched.get("quantize_period", 100)))
+    groups = int(config.get("quantize_groups", 1))
+    algo = config.get("quantize_algo", {})
+    symmetric = (algo.get("q_type", config.get("quantizer_type", "symmetric"))
+                 == "symmetric")
+    stochastic = (algo.get("rounding", config.get("rounding", "nearest"))
+                  not in ("nearest", "nearest_neighbor"))
+    mixed = bool(config.get("fp16_mixed_quantize", {}).get("enabled", False))
+    change_ratio = float(config.get("fp16_mixed_quantize", {})
+                         .get("quantize_change_ratio", 0.001))
+    offset = int(config.get("schedule_offset", sched.get("schedule_offset", 0)))
+
+    flat_paths = {"/".join(str(k.key) if hasattr(k, "key") else str(k) for k in path)
+                  for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]
+                  if hasattr(leaf, "ndim") and leaf.ndim > 1
+                  and jnp.issubdtype(leaf.dtype, jnp.floating)}
+    if not flat_paths:
+        return None
+    log_dist(f"MoQ enabled: {start_bits}->{target_bits} bits, period={period}, "
+             f"groups={groups}, {'symmetric' if symmetric else 'asymmetric'}, "
+             f"{len(flat_paths)} tensors")
+
+    def transform(p, step):
+        eff = jnp.maximum(step - offset, 0)
+        # per-step, per-tensor stochastic-rounding noise: a fixed key would
+        # turn the rounding error into a deterministic bias
+        step_key = jax.random.fold_in(jax.random.PRNGKey(7919), step)
+        counter = [0]
+
+        def q(path, leaf):
+            key = "/".join(str(k.key) if hasattr(k, "key") else str(k) for k in path)
+            if key not in flat_paths:
+                return leaf
+            counter[0] += 1
+            g = groups if leaf.size % groups == 0 else 1
+            return fake_quantize_stepped(
+                leaf, eff, start_bits=start_bits, target_bits=target_bits,
+                period=period, groups=g, symmetric=symmetric,
+                stochastic=stochastic, mixed_fp16=mixed, change_ratio=change_ratio,
+                rng=jax.random.fold_in(step_key, counter[0]))
+
+        return jax.tree_util.tree_map_with_path(q, p)
+
+    return transform
+
+
+class Quantizer:
+    """Reference host-API parity (``runtime/quantize.py:14``): mutable
+    per-call schedule with ``q_period`` doubling and eigenvalue factor.
+    ``parameter_group`` is a list of lists of dicts with keys
+    ``value``/``start_bits``/``target_bits``/``q_period`` (the TPU stand-in
+    for tensors carrying ``start_bits`` attributes)."""
+
+    def __init__(self, q_groups=1, q_mixed_fp16=False, q_change_ratio=0.01,
+                 q_type="symmetric", q_rounding="nearest", q_verbose=False,
+                 q_eigenvalue=False, use_quantizer_kernel=False, layer_num=0):
+        self.q_groups = q_groups
+        self.q_mixed_fp16 = q_mixed_fp16
+        self.q_change_ratio = q_change_ratio
+        self.q_type = q_type
+        self.q_rounding = q_rounding
+        self.q_verbose = q_verbose
+        self.q_eigenvalue = q_eigenvalue
+        self.use_quantizer_kernel = use_quantizer_kernel
+        self.layer_num = layer_num
+        self.qsteps = 0
+        self.quantize_real_ratio = 1.0
+
+    def step(self):
+        self.qsteps += 1
+
+    def update_fp16_ratio(self):
+        if self.q_mixed_fp16:
+            self.quantize_real_ratio = max(0.0, self.quantize_real_ratio - self.q_change_ratio)
+
+    def quantize(self, parameter_group: List[List[dict]], overflow: bool,
+                 eigenvalue_enabled: bool, block_eigenvalue: Optional[dict] = None):
+        if overflow and not eigenvalue_enabled:
+            return
+        self.step()
+        self.update_fp16_ratio()
+        for group in parameter_group:
+            for p in group:
+                if np.ndim(p["value"]) <= 1:
+                    continue
+                eig = (block_eigenvalue or {}).get(p.get("name"), None)
+                factor = 1 + math.floor(eig * 4) if eig is not None else 1
+                p["value"] = self._compute_quantization(p, factor)
+
+    def _compute_quantization(self, p: dict, factor: int = 1):
+        if p["start_bits"] != p["target_bits"] and self.qsteps >= p["q_period"]:
+            self.quantize_real_ratio = 1.0
+            p["q_period"] = (p["q_period"] << 1) * factor
+            p["start_bits"] -= 1
+            if self.q_verbose:
+                logger.info(f"MoQ: bits={p['start_bits']} step={self.qsteps} "
+                            f"period={p['q_period']}")
+        assert p["start_bits"] >= p["target_bits"], \
+            "Quantization bit is lower than target precision bits!"
+        x = jnp.asarray(p["value"])
+        flat = _grouped(x.astype(jnp.float32), self.q_groups)
+        bits = p["start_bits"]
+        if bits >= 3:
+            out = _highbit_fake_quant(flat, float(bits), self.q_type == "symmetric",
+                                      self.q_rounding not in ("nearest", "nearest_neighbor"),
+                                      jax.random.PRNGKey(self.qsteps))
+        elif bits == 2:
+            out = _ternary_fake_quant(flat)
+        else:
+            out = _binary_fake_quant(flat)
+        if self.q_mixed_fp16 and bits >= p["target_bits"] - 1:
+            out = self.quantize_real_ratio * flat + (1 - self.quantize_real_ratio) * out
+        return out.reshape(x.shape).astype(x.dtype)

@@ -1,0 +1,106 @@
+"""Post-training weight quantization for serving — reference
+``runtime/weight_quantizer.py`` (``WeightQuantization``: int8 weights +
+per-group scales applied while loading inference checkpoints).
+
+TPU shape: quantize the flax param tree AFTER tensor-parallel sharding
+(each op runs on the already-placed global arrays, one-time at engine
+init) to int8 leaves plus a parallel tree of fp32 group scales; serving
+functions dequantize on the fly inside jit (W8AX: weights live in HBM at
+1 byte, matmuls run at the serve dtype — the memory win is the point, as
+in the reference's int8 checkpoints). Embeddings, LM heads, and <2-D
+leaves stay at the serve dtype (the reference policy zoo likewise only
+quantizes attention/MLP weights)."""
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.quantizer.core import divisor_groups
+from deepspeed_tpu.utils.logging import log_dist
+
+_SKIP_TOKENS = ("wte", "wpe", "embed", "shared", "lm_head", "word_embeddings",
+                "position_embeddings", "token_type")
+
+
+def _path_str(path) -> str:
+    return "/".join(str(k.key) if hasattr(k, "key") else str(k) for k in path)
+
+
+def _is_quantizable(path: str, leaf) -> bool:
+    if not hasattr(leaf, "ndim") or leaf.ndim < 2:
+        return False
+    if not jnp.issubdtype(leaf.dtype, jnp.floating):
+        return False
+    low = path.lower()
+    return not any(tok in low for tok in _SKIP_TOKENS)
+
+
+class WeightQuantization:
+    """Reference API parity plus pytree-level quantize/dequantize."""
+
+    def __init__(self, mlp_extra_grouping: bool = True, mp_size: int = 1):
+        self.mlp_extra_grouping = mlp_extra_grouping
+        self.mp_size = mp_size
+
+    def quantize_data(self, data, quantize_bits: int, groups: int,
+                      key: Optional[str] = None) -> Tuple[jax.Array, jax.Array]:
+        """Group-wise symmetric int quantization (reference
+        ``quantize_data``: scale = 2^bits / (2*max|g| + eps))."""
+        flat = jnp.asarray(data).astype(jnp.float32).reshape(groups, -1)
+        max_d = jnp.maximum(flat.max(axis=-1, keepdims=True),
+                            jnp.abs(flat.min(axis=-1, keepdims=True)))
+        scale = float(1 << quantize_bits) / (2.0 * max_d + 1e-5)
+        qmin = -(1 << (quantize_bits - 1))
+        qmax = (1 << (quantize_bits - 1)) - 1
+        q = jnp.clip(jnp.round(flat * scale), qmin, qmax)
+        return (q.reshape(jnp.shape(data)).astype(jnp.int8), scale[:, 0])
+
+    def is_mlp(self, data, merge_count: int = 1) -> bool:
+        r0 = (self.mp_size * data.shape[0] * merge_count) / data.shape[1]
+        r1 = (self.mp_size * data.shape[1] * merge_count) / data.shape[0]
+        return r0 == 4 or r1 == 4
+
+    def is_qkv(self, data) -> bool:
+        r0 = (self.mp_size * data.shape[0]) / data.shape[1]
+        r1 = (self.mp_size * data.shape[1]) / data.shape[0]
+        return r0 == 3 or r1 == 3
+
+    def model_quantize(self, params, quantize_bits: int = 8,
+                       group_size: int = 64,
+                       groups: Optional[int] = None) -> Tuple[Any, Dict[str, jax.Array]]:
+        """Quantize every eligible leaf of a flax param tree. Returns the
+        mixed int8/float tree and a path→scales dict. ``group_size`` is
+        elements per group (inference config semantics); ``groups`` — a
+        fixed group COUNT, the reference ``quantize_grouping`` arg — wins
+        when given. MLP weights get 2x the groups when
+        ``mlp_extra_grouping`` (reference behavior)."""
+        scales: Dict[str, jax.Array] = {}
+
+        def q(path, leaf):
+            key = _path_str(path)
+            if not _is_quantizable(key, leaf):
+                return leaf
+            g = (divisor_groups(leaf.size, max(1, leaf.size // groups))
+                 if groups else divisor_groups(leaf.size, max(group_size, 1)))
+            if self.mlp_extra_grouping and self.is_mlp(leaf):
+                g = divisor_groups(leaf.size, max(1, leaf.size // (2 * g)))
+            qleaf, s = self.quantize_data(leaf, quantize_bits, g, key)
+            scales[key] = s
+            return qleaf
+
+        qtree = jax.tree_util.tree_map_with_path(q, params)
+        log_dist(f"WeightQuantization: {len(scales)} tensors -> int{quantize_bits}")
+        return qtree, scales
+
+
+def dequantize_tree(params, scales: Dict[str, jax.Array], dtype) -> Any:
+    """Inverse of ``model_quantize`` — runs traced inside the serving jit,
+    so the HBM-resident weights stay int8."""
+    def dq(path, leaf):
+        s = scales.get(_path_str(path))
+        if s is None:
+            return leaf
+        flat = leaf.astype(jnp.float32).reshape(s.shape[0], -1)
+        return (flat / s[:, None]).reshape(leaf.shape).astype(dtype)
+
+    return jax.tree_util.tree_map_with_path(dq, params)

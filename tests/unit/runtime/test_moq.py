@@ -1,0 +1,102 @@
+"""MoQ quantization-aware training (reference ``runtime/quantize.py``):
+in-graph bit schedule, quantization floors, engine wiring, host API."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.quantize import (Quantizer, build_moq_transform,
+                                            fake_quantize_stepped, moq_bits_at)
+
+
+def test_bit_schedule_halving_periods():
+    """start=8, target=4, period=100: reductions at 100, 200, 400, 800
+    (each reduction doubles the next period) — reference q_period <<= 1."""
+    steps = jnp.asarray([1, 99, 100, 199, 200, 399, 400, 799, 800, 10_000])
+    bits = [float(moq_bits_at(s, 8, 4, 100)) for s in steps]
+    assert bits == [8, 8, 7, 7, 6, 6, 5, 5, 4, 4]
+
+
+def test_fake_quant_reduces_distinct_values():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(64, 64)), jnp.float32)
+    early = fake_quantize_stepped(x, jnp.asarray(1), start_bits=8, target_bits=4,
+                                  period=10)
+    late = fake_quantize_stepped(x, jnp.asarray(10_000), start_bits=8, target_bits=4,
+                                 period=10)
+    n_early = len(np.unique(np.asarray(early)))
+    n_late = len(np.unique(np.asarray(late)))
+    assert n_late <= 16 < n_early <= 256
+    # quantization error stays bounded by a coarse step size
+    assert float(jnp.max(jnp.abs(late - x))) < 0.5
+
+
+def test_ternary_and_binary_floors():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(32, 32)), jnp.float32)
+    tern = fake_quantize_stepped(x, jnp.asarray(10**6), start_bits=8, target_bits=2,
+                                 period=2)
+    assert len(np.unique(np.asarray(tern))) <= 3
+    binary = fake_quantize_stepped(x, jnp.asarray(10**6), start_bits=8, target_bits=1,
+                                   period=2)
+    assert len(np.unique(np.asarray(binary))) <= 2
+
+
+def test_build_transform_targets_matrices_only():
+    params = {"wte": jnp.ones((8, 4)), "bias": jnp.ones((4,)),
+              "scalar": jnp.ones([])}
+    t = build_moq_transform(params, {"enabled": True,
+                                     "quantize_bits": {"start_bits": 8, "target_bits": 4},
+                                     "quantize_period": 10})
+    out = t(params, jnp.asarray(1000))
+    np.testing.assert_array_equal(np.asarray(out["bias"]), np.ones(4))  # untouched
+    assert out["wte"].shape == (8, 4)
+    assert build_moq_transform(params, {"enabled": False}) is None
+
+
+def test_engine_trains_with_moq_config():
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(get_gpt2_config("test", dtype=jnp.bfloat16)),
+        config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "bf16": {"enabled": True},
+            "quantize_training": {"enabled": True,
+                                  "quantize_bits": {"start_bits": 8, "target_bits": 4},
+                                  "quantize_period": 2,
+                                  "quantize_groups": 4},
+            "steps_per_print": 10**9,
+        })
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 250, (8, 32)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch)) for _ in range(4)]
+    assert all(np.isfinite(losses))
+    assert engine._compression_transform is not None
+
+
+def test_host_quantizer_api_parity():
+    """Reference host API: q_period doubles per reduction, eigenvalue
+    factor stretches it, mixed ratio re-arms."""
+    q = Quantizer(q_groups=2, q_mixed_fp16=True, q_change_ratio=0.1)
+    rng = np.random.default_rng(2)
+    p = {"value": jnp.asarray(rng.normal(size=(4, 8)), jnp.float32),
+         "start_bits": 6, "target_bits": 4, "q_period": 1, "name": "w"}
+    group = [[p]]
+    q.quantize(group, overflow=False, eigenvalue_enabled=False)
+    assert p["start_bits"] == 5 and p["q_period"] == 2
+    assert q.quantize_real_ratio == 1.0  # re-armed at the reduction
+    # overflow without eigenvalue: no step taken
+    before = p["start_bits"]
+    q.quantize(group, overflow=True, eigenvalue_enabled=False)
+    assert p["start_bits"] == before and q.qsteps == 1
+    # eigenvalue factor stretches the next period
+    q2 = Quantizer()
+    p2 = {"value": jnp.ones((4, 4)), "start_bits": 6, "target_bits": 4,
+          "q_period": 1, "name": "w"}
+    q2.quantize([[p2]], overflow=False, eigenvalue_enabled=True,
+                block_eigenvalue={"w": 1.0})
+    assert p2["q_period"] == 2 * (1 + 4)
