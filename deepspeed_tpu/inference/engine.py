@@ -115,7 +115,9 @@ class InferenceEngine:
         self._serve_dtype = cast_dtype or jnp.float32
         if quant_on:
             from deepspeed_tpu.runtime.weight_quantizer import WeightQuantization
-            wq = WeightQuantization(mp_size=topology.tensor_parallel_size)
+            # mp_size=1: JAX sharded arrays keep their GLOBAL shape, so the
+            # reference's local-shard ratio recovery must not re-multiply
+            wq = WeightQuantization(mp_size=1)
             self.params, self._wq_scales = wq.model_quantize(
                 self.params, quantize_bits=config.quant.bits,
                 group_size=max(1, config.quant.group_size))

@@ -33,6 +33,11 @@ class BertConfig:
     param_dtype: Any = jnp.float32
     remat: bool = False
     attention_backend: str = "xla"
+    # progressive layer drop (arXiv:2010.13369 targets BERT; reference
+    # ``runtime/progressive_layer_drop.py``): stochastically skip sublayers
+    # at train time with depth-scaled keep probability when the engine
+    # passes ``pld_theta``
+    progressive_layer_drop: bool = False
 
     @property
     def head_dim(self):
@@ -104,11 +109,17 @@ class BertLayer(nn.Module):
 
     config: BertConfig
 
+    def _pld_gate(self, branch, keep):
+        # post-LN form: LN(x + b·f(x)/keep)
+        from deepspeed_tpu.models.common import pld_gate
+        return pld_gate(self, branch, keep)[0]
+
     @nn.compact
-    def __call__(self, x, attention_mask=None, deterministic: bool = True):
+    def __call__(self, x, attention_mask=None, deterministic: bool = True, pld_keep=None):
         cfg = self.config
+        keep = None if (deterministic or pld_keep is None) else pld_keep
         attn = BertSelfAttention(cfg, name="attention")(x, attention_mask, deterministic)
-        x = BertLayerNorm(cfg, name="attention_ln")(x + attn)
+        x = BertLayerNorm(cfg, name="attention_ln")(x + self._pld_gate(attn, keep))
         h = nn.Dense(features=cfg.intermediate_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                      kernel_init=nn.with_logical_partitioning(_init(), ("embed", "mlp")),
                      bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("mlp",)),
@@ -120,7 +131,7 @@ class BertLayer(nn.Module):
                      name="output")(h)
         if not deterministic and cfg.hidden_dropout_prob > 0:
             h = nn.Dropout(rate=cfg.hidden_dropout_prob)(h, deterministic=False)
-        return BertLayerNorm(cfg, name="output_ln")(x + h)
+        return BertLayerNorm(cfg, name="output_ln")(x + self._pld_gate(h, keep))
 
 
 class BertModel(nn.Module):
@@ -130,7 +141,7 @@ class BertModel(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
-                 deterministic: bool = True):
+                 deterministic: bool = True, pld_theta=None):
         cfg = self.config
         word = self.param("word_embeddings", nn.with_logical_partitioning(_init(), ("vocab", "embed")),
                           (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
@@ -151,8 +162,12 @@ class BertModel(nn.Module):
         layer_cls = BertLayer
         if cfg.remat:
             layer_cls = nn.remat(BertLayer, static_argnums=(3,), prevent_cse=False)
+        use_pld = cfg.progressive_layer_drop and pld_theta is not None and not deterministic
         for i in range(cfg.num_hidden_layers):
-            x = layer_cls(cfg, name=f"layer_{i}")(x, attention_mask, deterministic)
+            # PLD depth scaling (paper eq. 6): deeper blocks drop more often
+            keep_i = (1.0 - (i + 1) / cfg.num_hidden_layers * (1.0 - pld_theta)
+                      if use_pld else None)
+            x = layer_cls(cfg, name=f"layer_{i}")(x, attention_mask, deterministic, keep_i)
 
         pooled = nn.Dense(features=cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                           kernel_init=nn.with_logical_partitioning(_init(), ("embed", "embed2")),
@@ -170,10 +185,11 @@ class BertForMaskedLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
-                 deterministic: bool = True):
+                 deterministic: bool = True, pld_theta=None):
         cfg = self.config
         encoder = BertModel(cfg, name="bert")
-        x, _, wte = encoder(input_ids, token_type_ids, attention_mask, deterministic)
+        x, _, wte = encoder(input_ids, token_type_ids, attention_mask, deterministic,
+                            pld_theta=pld_theta)
         x = nn.Dense(features=cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                      kernel_init=nn.with_logical_partitioning(_init(), ("embed", "embed2")),
                      bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),

@@ -52,6 +52,21 @@ def maybe_remat(block_cls, cfg, layer_idx: int, static_argnums=(), enabled=None)
                     policy=get_remat_policy(getattr(cfg, "remat_policy", None)))
 
 
+def pld_gate(module: nn.Module, branch, keep):
+    """Zoo-shared Switchable-Transformer gate (PLD, arXiv:2010.13369 §3):
+    keep the sublayer output with probability ``keep`` and rescale by
+    1/keep so expectations match; a dropped sublayer contributes nothing.
+    Returns ``(gated_branch, keep_decision)`` — the decision lets callers
+    gate side outputs (e.g. a dropped MoE layer's router aux loss). The
+    FLOPs are still spent under jit; the TPU benefit is regularization
+    parity, which is why the engine anneals theta in-graph instead of
+    re-tracing."""
+    if keep is None:
+        return branch, None
+    b = jax.random.bernoulli(module.make_rng("pld"), keep)
+    return jnp.where(b, branch / keep, jnp.zeros_like(branch)), b
+
+
 def rms_norm(x, weight, eps: float, out_dtype):
     """Shared RMS-norm core (LLaMA RMSNorm, T5 LayerNorm): fp32 accumulate,
     scale, cast back."""

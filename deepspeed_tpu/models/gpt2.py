@@ -198,18 +198,8 @@ class Block(nn.Module):
     decode: bool = False
 
     def _pld_gate(self, branch, keep):
-        """Switchable-Transformer gate (PLD paper §3): keep the sublayer
-        with probability ``keep`` and rescale by 1/keep so expectations
-        match; a dropped sublayer contributes nothing (and its FLOPs are
-        still spent under jit — the benefit on TPU is regularization
-        parity, not wall-clock, which is why the engine anneals theta
-        in-graph rather than re-tracing). Returns the gated branch and
-        the keep decision (so callers can gate side outputs such as the
-        MoE aux loss)."""
-        if keep is None:
-            return branch, None
-        b = jax.random.bernoulli(self.make_rng("pld"), keep)
-        return jnp.where(b, branch / keep, jnp.zeros_like(branch)), b
+        from deepspeed_tpu.models.common import pld_gate
+        return pld_gate(self, branch, keep)
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True, pld_keep=None):

@@ -98,7 +98,11 @@ def fake_quantize_stepped(x, step, *, start_bits: int, target_bits: int,
         ratio = jnp.maximum(1.0 - change_ratio * (t - last_reduction), 0.0)
         near_target = bits >= (target_bits - 1)
         out = jnp.where(near_target, ratio * flat + (1.0 - ratio) * out, out)
-    return out.reshape(orig_shape).astype(orig_dtype)
+    out = out.reshape(orig_shape).astype(orig_dtype)
+    # straight-through estimator: round/clip have zero gradient, so the
+    # quantized value must carry the ORIGINAL weight's gradient or QAT
+    # silently stalls (same pattern as compression/basic_layer.py)
+    return x + jax.lax.stop_gradient(out - x)
 
 
 def build_moq_transform(params, config: Dict[str, Any]):
@@ -146,7 +150,9 @@ def build_moq_transform(params, config: Dict[str, Any]):
             if key not in flat_paths:
                 return leaf
             counter[0] += 1
-            g = groups if leaf.size % groups == 0 else 1
+            from deepspeed_tpu.ops.quantizer.core import divisor_groups
+            g = (groups if leaf.size % groups == 0
+                 else divisor_groups(leaf.size, max(1, leaf.size // max(groups, 1))))
             return fake_quantize_stepped(
                 leaf, eff, start_bits=start_bits, target_bits=target_bits,
                 period=period, groups=g, symmetric=symmetric,

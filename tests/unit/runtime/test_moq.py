@@ -35,12 +35,14 @@ def test_fake_quant_reduces_distinct_values():
 def test_ternary_and_binary_floors():
     rng = np.random.default_rng(1)
     x = jnp.asarray(rng.normal(size=(32, 32)), jnp.float32)
+    # the STE re-adds x - x, so values match the quantized levels only to
+    # float rounding — count unique values after rounding that away
     tern = fake_quantize_stepped(x, jnp.asarray(10**6), start_bits=8, target_bits=2,
                                  period=2)
-    assert len(np.unique(np.asarray(tern))) <= 3
+    assert len(np.unique(np.round(np.asarray(tern), 5))) <= 3
     binary = fake_quantize_stepped(x, jnp.asarray(10**6), start_bits=8, target_bits=1,
                                    period=2)
-    assert len(np.unique(np.asarray(binary))) <= 2
+    assert len(np.unique(np.round(np.asarray(binary), 5))) <= 2
 
 
 def test_build_transform_targets_matrices_only():
@@ -53,6 +55,25 @@ def test_build_transform_targets_matrices_only():
     np.testing.assert_array_equal(np.asarray(out["bias"]), np.ones(4))  # untouched
     assert out["wte"].shape == (8, 4)
     assert build_moq_transform(params, {"enabled": False}) is None
+
+
+def test_ste_gradients_flow_through_quantization():
+    """round/clip have zero gradient — the straight-through estimator must
+    carry the full weight gradient or QAT silently stalls."""
+    rng = np.random.default_rng(3)
+    w = jnp.asarray(rng.normal(size=(16, 16)), jnp.float32)
+
+    def loss(w_):
+        q = fake_quantize_stepped(w_, jnp.asarray(1000), start_bits=8,
+                                  target_bits=4, period=10)
+        return jnp.sum(q * q)
+
+    g = jax.grad(loss)(w)
+    # STE: gradient equals d/dw of sum(q^2) evaluated with q treated as w
+    np.testing.assert_allclose(np.asarray(g), 2 * np.asarray(
+        fake_quantize_stepped(w, jnp.asarray(1000), start_bits=8,
+                              target_bits=4, period=10)), atol=1e-6)
+    assert float(jnp.sum(jnp.abs(g))) > 1.0  # decidedly nonzero
 
 
 def test_engine_trains_with_moq_config():

@@ -85,6 +85,26 @@ def test_fused_multi_step_dispatch_anneals_in_graph():
     assert engine.progressive_layer_drop.get_theta() == pytest.approx(want)
 
 
+def test_bert_pld_trains():
+    """Reference PLD targets BERT: MLM training under the theta schedule."""
+    from deepspeed_tpu.models.bert import BertForMaskedLM, get_bert_config
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=BertForMaskedLM(get_bert_config("test", dtype=jnp.bfloat16,
+                                              progressive_layer_drop=True)),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "bf16": {"enabled": True},
+                "progressive_layer_drop": {"enabled": True, "theta": 0.6, "gamma": 0.4},
+                "steps_per_print": 10**9})
+    rng = np.random.default_rng(3)
+    batch = {"input_ids": rng.integers(0, 250, (8, 32)).astype(np.int32),
+             "labels": rng.integers(0, 250, (8, 32)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch)) for _ in range(4)]
+    assert all(np.isfinite(losses))
+    assert 0.6 < engine.progressive_layer_drop.get_theta() < 1.0
+
+
 def test_warns_when_model_lacks_pld_support():
     from deepspeed_tpu.models.bert import BertForMaskedLM, get_bert_config
 
