@@ -2,6 +2,7 @@
 (reference ``deepspeed/module_inject/``)."""
 
 from deepspeed_tpu.module_inject.auto_tp import AutoTP
+from deepspeed_tpu.module_inject.layers import LinearAllreduce, LinearLayer
 from deepspeed_tpu.module_inject.load_checkpoint import (load_hf_checkpoint, load_hf_gpt2,
                                                          load_hf_llama, load_hf_opt,
                                                          load_hf_gpt_neox, load_hf_bloom, load_hf_t5,
@@ -9,5 +10,5 @@ from deepspeed_tpu.module_inject.load_checkpoint import (load_hf_checkpoint, loa
 from deepspeed_tpu.module_inject.replace_module import (generic_injection, replace_transformer_layer,
                                                         tp_shard_params)
 
-__all__ = ["AutoTP", "load_hf_checkpoint", "load_hf_gpt2", "load_hf_llama", "load_hf_opt", "load_hf_gpt_neox", "load_hf_bloom", "load_hf_t5", "load_hf_falcon", "generic_injection",
+__all__ = ["AutoTP", "LinearAllreduce", "LinearLayer", "load_hf_checkpoint", "load_hf_gpt2", "load_hf_llama", "load_hf_opt", "load_hf_gpt_neox", "load_hf_bloom", "load_hf_t5", "load_hf_falcon", "generic_injection",
            "replace_transformer_layer", "tp_shard_params"]

@@ -1,0 +1,68 @@
+"""Tensor-parallel building-block layers — reference
+``module_inject/layers.py`` (``LinearLayer`` column-parallel at :32,
+``LinearAllreduce`` row-parallel at :15, used by kernel injection and by
+users hand-building TP models).
+
+TPU redesign: the reference slices weights per rank and inserts explicit
+``all_reduce`` calls; here each layer is an ``nn.Dense`` whose kernel
+carries LOGICAL axis names (``parallel/sharding.DEFAULT_LOGICAL_RULES``
+maps "mlp" to the tensor axis) and GSPMD inserts the collective — a
+column-parallel ``LinearLayer`` feeding a row-parallel
+``LinearAllreduce`` compiles to exactly one psum over the tensor axis,
+same wire traffic as the reference pair, with no rank arithmetic in user
+code."""
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+
+import flax.linen as nn
+
+from deepspeed_tpu.models.common import dense_init
+
+
+def _dense(features, use_bias, dtype, param_dtype, kernel_init,
+           kernel_axes, bias_axes, name=None):
+    return nn.Dense(
+        features=features, use_bias=use_bias, dtype=dtype, param_dtype=param_dtype,
+        kernel_init=nn.with_logical_partitioning(kernel_init or dense_init(), kernel_axes),
+        bias_init=nn.with_logical_partitioning(nn.initializers.zeros, bias_axes),
+        name=name)
+
+
+class LinearLayer(nn.Module):
+    """Column-parallel linear: output features shard over the tensor axis
+    (logical "mlp"); the input stays replicated across TP ranks. Follow
+    with :class:`LinearAllreduce` to return to replicated activations."""
+
+    features: int
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    kernel_init: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x):
+        dense = _dense(self.features, self.use_bias, self.dtype, self.param_dtype,
+                       self.kernel_init, ("embed", "mlp"), ("mlp",))
+        nn.share_scope(self, dense)  # params at <name>/kernel, not <name>/Dense_0/...
+        return dense(x)
+
+
+class LinearAllreduce(nn.Module):
+    """Row-parallel linear: input features shard over the tensor axis, and
+    the partial products sum across ranks (GSPMD materializes the psum the
+    reference calls explicitly after its sliced matmul). The replicated
+    bias applies after the reduction, as in the reference."""
+
+    features: int
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    kernel_init: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x):
+        dense = _dense(self.features, self.use_bias, self.dtype, self.param_dtype,
+                       self.kernel_init, ("mlp", "embed"), ("embed",))
+        nn.share_scope(self, dense)  # params at <name>/kernel, not <name>/Dense_0/...
+        return dense(x)

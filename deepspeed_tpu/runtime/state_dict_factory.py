@@ -39,8 +39,18 @@ def default_checkpoint_loader(path: str) -> Dict[str, Any]:
 
 def _np_tree(sd):
     def conv(v):
-        if hasattr(v, "detach"):
-            return v.detach().cpu().numpy()
+        if hasattr(v, "detach"):  # torch tensor
+            t = v.detach().cpu()
+            try:
+                return t.numpy()
+            except TypeError:
+                # numpy has no bf16/fp8 — round-trip through fp32 and
+                # restore the logical dtype via ml_dtypes (what jnp uses)
+                import ml_dtypes
+                name = str(t.dtype).replace("torch.", "")
+                target = getattr(ml_dtypes, name, None)
+                arr = t.to(dtype=__import__("torch").float32).numpy()
+                return arr.astype(target) if target is not None else arr
         return v
     return {k: conv(v) if not isinstance(v, dict) else _np_tree(v) for k, v in sd.items()}
 
@@ -77,16 +87,24 @@ class SDLoaderBase(ABC):
         self.ckpt_list = ckpt_list
         self.version = version
         self.checkpoint_engine = checkpoint_engine or default_checkpoint_loader
+        self._first_sd = None  # check_ckpt_list's load, reused once (multi-GB files)
         self.check_ckpt_list()
+
+    def _load_file(self, path: str):
+        if path == self.ckpt_list[0] and self._first_sd is not None:
+            sd, self._first_sd = self._first_sd, None
+            return sd
+        return self.checkpoint_engine(path)
 
     def load(self, mp_world_size: int, mp_rank: int, module_key=AUTO_MODULE_KEY):
         """Reference ``SDLoaderBase.load``: same degree → plain load; more
-        files than ranks → merge; fewer → split."""
+        files than ranks → merge; fewer → split. Tensors always come back
+        numpy (torch checkpoints are converted on every path)."""
         self.module_key = module_key
         num_ckpt = len(self.ckpt_list)
         if num_ckpt == mp_world_size:
-            sd = self.checkpoint_engine(self.ckpt_list[mp_rank])
-            return sd, None
+            sd = self._load_file(self.ckpt_list[mp_rank])
+            return self.set_module(sd, _np_tree(self.get_module(sd))), None
         if num_ckpt > mp_world_size:
             return self.merge_state_dict(mp_world_size, mp_rank)
         return self.split_state_dict(mp_world_size, mp_rank)
@@ -97,7 +115,7 @@ class SDLoaderBase(ABC):
         num_to_merge = num_ckpt // mp_world_size
         files = self.ckpt_list[num_to_merge * mp_rank:num_to_merge * (mp_rank + 1)]
         logger.info(f"mp_rank {mp_rank} merging {files}")
-        return [self.checkpoint_engine(f) for f in files]
+        return [self._load_file(f) for f in files]
 
     def get_split_state_dict(self, mp_world_size: int, mp_rank: int):
         num_ckpt = len(self.ckpt_list)
@@ -107,7 +125,7 @@ class SDLoaderBase(ABC):
         ckpt_offset = mp_rank % num_to_split
         logger.info(f"mp_rank {mp_rank} splitting {self.ckpt_list[ckpt_index]} "
                     f"offset {ckpt_offset}/{num_to_split}")
-        return self.checkpoint_engine(self.ckpt_list[ckpt_index]), num_to_split, ckpt_offset
+        return self._load_file(self.ckpt_list[ckpt_index]), num_to_split, ckpt_offset
 
     def _choose_module_key(self, sd):
         assert not ("module" in sd and "model" in sd), \
@@ -141,6 +159,7 @@ class SDLoaderBase(ABC):
             assert len(self.ckpt_list) == sd["mp_world_size"], \
                 (f"checkpoint count {len(self.ckpt_list)} differs from saved "
                  f"mp_world_size {sd['mp_world_size']}")
+        self._first_sd = sd
 
     @abstractmethod
     def merge_state_dict(self, mp_world_size, mp_rank):

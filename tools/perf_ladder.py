@@ -26,23 +26,26 @@ from bench_core import (build_engine, enable_compile_cache, report,
 SEQ = 1024
 
 
-def run_rung(tag, model_name, mb, offload=False, steps=None):
+def run_rung(tag, model_name, mb, offload=False, steps=None, seq=None,
+             fused_xent=False):
     ds_overrides = {}
     if offload:
         ds_overrides["zero_optimization"] = {
             "stage": 2,
             "offload_optimizer": {"device": "cpu", "pin_memory": True},
         }
+    overrides = {"vocab_size": 50304, "embed_onehot_grad": True}
+    if fused_xent:
+        overrides["fused_head_loss_chunk"] = 1024
     engine, batch, n_params = build_engine(
-        model_name, mb, SEQ, ds_overrides=ds_overrides,
-        vocab_size=50304, embed_onehot_grad=True)
+        model_name, mb, seq or SEQ, ds_overrides=ds_overrides, **overrides)
     if offload:
         # host-driven schedule: per-step dispatch is the real path here
         n_steps, dt, compile_s = time_per_dispatch(engine, batch, steps or 3)
     else:
         fused = int(os.environ.get("LADDER_FUSED", "10"))
         n_steps, dt, compile_s = time_fused(engine, batch, fused=fused)
-    report(tag, mb, SEQ, n_params, n_steps, dt, compile_s)
+    report(tag, mb, seq or SEQ, n_params, n_steps, dt, compile_s)
 
 
 RUNGS = {
@@ -50,6 +53,11 @@ RUNGS = {
     "760m_mb8": dict(model_name="760m", mb=8),
     "xl_offload_mb1": dict(model_name="xl", mb=1, offload=True, steps=2),
     "xl_offload_mb4": dict(model_name="xl", mb=4, offload=True, steps=2),
+    # long-context rungs: the gridded flash kernel streams K/V blocks, so
+    # VMEM no longer caps sequence length; fused xent keeps the logits
+    # buffers off the OOM line at long L
+    "350m_seq4k": dict(model_name="350m", mb=2, seq=4096, fused_xent=True),
+    "350m_seq8k": dict(model_name="350m", mb=1, seq=8192, fused_xent=True),
 }
 
 
