@@ -25,6 +25,19 @@ _LAZY = {
     "zero": ("deepspeed_tpu.runtime.zero", None),
     "DeepSpeedEngine": ("deepspeed_tpu.runtime.engine", "DeepSpeedEngine"),
     "DeepSpeedConfig": ("deepspeed_tpu.runtime.config", "DeepSpeedConfig"),
+    "DeepSpeedConfigError": ("deepspeed_tpu.runtime.config", "DeepSpeedConfigError"),
+    "DeepSpeedHybridEngine": ("deepspeed_tpu.runtime.hybrid_engine", "DeepSpeedHybridEngine"),
+    "PipelineEngine": ("deepspeed_tpu.runtime.pipe.engine", "PipelineEngine"),
+    "PipelineModule": ("deepspeed_tpu.runtime.pipe.module", "PipelineModule"),
+    "InferenceEngine": ("deepspeed_tpu.inference.engine", "InferenceEngine"),
+    "DeepSpeedInferenceConfig": ("deepspeed_tpu.inference.config", "DeepSpeedInferenceConfig"),
+    "DeepSpeedTransformerLayer": ("deepspeed_tpu.ops.transformer", "DeepSpeedTransformerLayer"),
+    "DeepSpeedTransformerConfig": ("deepspeed_tpu.ops.transformer", "DeepSpeedTransformerConfig"),
+    "checkpointing": ("deepspeed_tpu.runtime.activation_checkpointing.checkpointing", None),
+    "get_accelerator": ("deepspeed_tpu.accelerator", "get_accelerator"),
+    "init_distributed": ("deepspeed_tpu.comm.comm", "init_distributed"),
+    "OnDevice": ("deepspeed_tpu.utils.memory", "OnDevice"),
+    "module_inject": ("deepspeed_tpu.module_inject", None),
     "ops": ("deepspeed_tpu.ops", None),
     "moe": ("deepspeed_tpu.moe", None),
     "pipe": ("deepspeed_tpu.pipe", None),
@@ -45,3 +58,8 @@ def __getattr__(name):
         globals()[name] = obj
         return obj
     raise AttributeError(f"module 'deepspeed_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    # PEP 562: keep dir()/tab-completion aware of the lazy exports
+    return sorted(set(globals()) | set(_LAZY))

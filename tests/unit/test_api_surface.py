@@ -1,0 +1,31 @@
+"""Top-level import surface pins (reference ``deepspeed/__init__.py``
+exports): every public name a reference user reaches for must resolve."""
+import pytest
+
+import deepspeed_tpu as ds
+
+REFERENCE_EXPORTS = [
+    "initialize", "init_inference", "add_config_arguments",
+    "zero", "comm", "ops", "moe", "pipe", "module_inject",
+    "DeepSpeedEngine", "DeepSpeedConfig", "DeepSpeedConfigError",
+    "DeepSpeedHybridEngine", "PipelineEngine", "PipelineModule",
+    "InferenceEngine", "DeepSpeedInferenceConfig",
+    "DeepSpeedTransformerLayer", "DeepSpeedTransformerConfig",
+    "checkpointing", "get_accelerator", "init_distributed",
+    "OnDevice", "logger", "log_dist", "__version__",
+]
+
+
+@pytest.mark.parametrize("name", REFERENCE_EXPORTS)
+def test_reference_export_resolves(name):
+    assert getattr(ds, name) is not None
+
+
+def test_unknown_attribute_raises():
+    with pytest.raises(AttributeError, match="no attribute"):
+        ds.definitely_not_an_export
+
+
+def test_zero_namespace():
+    assert hasattr(ds.zero, "Init")
+    assert hasattr(ds.zero, "GatheredParameters")
