@@ -238,7 +238,8 @@ def main():
     rc, out, err = _run("child", env, cpu_timeout)
     result = _last_json_line(out)
     if rc == 0 and result is not None:
-        result["note"] = "CPU FALLBACK (accelerator unavailable): " + " | ".join(errors)
+        result["note"] = ("CPU FALLBACK (accelerator unavailable; last live-chip "
+                          "measurement documented in PERF.md): " + " | ".join(errors))
         print(json.dumps(result))
         return
     errors.append(f"cpu fallback: rc={rc} "

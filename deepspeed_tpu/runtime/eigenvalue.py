@@ -93,6 +93,10 @@ class Eigenvalue:
         keys = self._layer_keys(params)
         eigs = [abs(self._layer_eigenvalue(loss_fn, params, k, jax.random.fold_in(rng, i)))
                 for i, k in enumerate(keys)]
+        # a diverged power iteration (non-finite HVPs under low precision)
+        # must not poison the whole set — treat it as no-signal, like the
+        # reference's nan_to_num scrubbing
+        eigs = [e if np.isfinite(e) else 0.0 for e in eigs]
         max_eig = max(eigs) if any(e > 0 for e in eigs) else 1.0
         return [e if e > 0 else max_eig for e in eigs]
 

@@ -851,6 +851,61 @@ class DeepSpeedEngine:
         loss = outputs if fused_head else self.loss_fn(outputs, mb)
         return (loss * scale).astype(jnp.float32), loss
 
+    def _moq_eigenvalue_factors(self):
+        """Eigenvalue-modulated MoQ periods (reference ``engine.py`` wires
+        ``Eigenvalue`` into the quantizer at GAS boundaries; the TPU
+        schedule is compiled in-graph, so curvature is probed ONCE here on
+        a synthetic batch and baked in as per-layer period factors —
+        ``1 + floor(eig/max_eig * 4)``, high-curvature layers anneal
+        slower). Returns None unless the ``eigenvalue`` config block is
+        enabled alongside quantize_training."""
+        ev_cfg = (self.config.raw_dict or {}).get("eigenvalue", {})
+        if not ev_cfg.get("enabled", False):
+            return None
+        import math
+
+        from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+        mcfg = getattr(self.module, "config", None)
+        layer_name = ev_cfg.get("layer_name", "h")
+        layer_num = int(ev_cfg.get("layer_num",
+                                   getattr(mcfg, "n_layer",
+                                           getattr(mcfg, "num_hidden_layers", 0))))
+        if layer_num <= 0:
+            logger.warning("eigenvalue enabled but layer_num resolves to 0; skipping")
+            return None
+        seq = min(int(getattr(mcfg, "n_positions",
+                              getattr(mcfg, "max_position_embeddings", 128))), 128)
+        vocab = int(getattr(mcfg, "vocab_size", 256))
+        rng = np.random.default_rng(0)
+        probe = {"input_ids": rng.integers(
+            0, vocab, (self.config.train_micro_batch_size_per_gpu, seq)).astype(np.int32)}
+
+        def loss_fn(p):
+            _, loss = self._loss_for(p, probe, jax.random.PRNGKey(0),
+                                     jnp.float32(1.0), train=False)
+            return loss
+
+        ev = Eigenvalue(verbose=bool(ev_cfg.get("verbose", False)),
+                        max_iter=int(ev_cfg.get("max_iter", 10)),
+                        tol=float(ev_cfg.get("tol", 1e-2)),
+                        stability=float(ev_cfg.get("stability", 1e-6)),
+                        layer_name=layer_name, layer_num=layer_num)
+        try:
+            eigs = ev.compute_eigenvalue(loss_fn, self.state.params)
+        except KeyError as e:
+            logger.warning(f"eigenvalue: {e}; skipping MoQ period modulation")
+            return None
+        if not all(np.isfinite(e) for e in eigs):
+            logger.warning("eigenvalue returned non-finite values; skipping MoQ "
+                           "period modulation")
+            return None
+        max_eig = max(eigs) or 1.0
+        factors = {f"{layer_name}_{i}": 1.0 + math.floor(e / max_eig * 4)
+                   for i, e in enumerate(eigs)}
+        log_dist(f"MoQ eigenvalue period factors: {factors}")
+        return factors
+
     def _cond_apply_updates(self, overflow, grads, opt_state, params):
         """Optimizer update under an overflow gate: lax.cond runs ONE branch
         at runtime, so a skipped step costs nothing and a normal step avoids
@@ -977,7 +1032,8 @@ class DeepSpeedEngine:
             if self.config.quantize_training_config.get("enabled", False):
                 from deepspeed_tpu.runtime.quantize import build_moq_transform
                 moq = build_moq_transform(self.state.params,
-                                          self.config.quantize_training_config)
+                                          self.config.quantize_training_config,
+                                          period_factors=self._moq_eigenvalue_factors())
             if moq is not None:
                 comp = self._compression_transform
                 self._compression_transform = (

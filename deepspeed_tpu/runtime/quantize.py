@@ -105,11 +105,17 @@ def fake_quantize_stepped(x, step, *, start_bits: int, target_bits: int,
     return x + jax.lax.stop_gradient(out - x)
 
 
-def build_moq_transform(params, config: Dict[str, Any]):
+def build_moq_transform(params, config: Dict[str, Any],
+                        period_factors: Optional[Dict[str, float]] = None):
     """Resolve a ``quantize_training`` config block against the live param
     tree → ``(params, step) -> params`` for the engine's compression-in-
     forward hook. Quantizes >=2-D floating leaves (the reference's
-    ``len(p.size()) > 1`` rule)."""
+    ``len(p.size()) > 1`` rule).
+
+    ``period_factors`` maps a param-path PREFIX (e.g. ``h_3``) to a period
+    multiplier — the eigenvalue modulation of the reference
+    (``quantize.py`` ``factor = 1 + floor(eigenvalue * 4)`` stretching
+    ``q_period``): high-curvature layers anneal their bit-width slower."""
     if not config or not config.get("enabled", False):
         return None
     bits_cfg = config.get("quantize_bits", config)
@@ -153,9 +159,14 @@ def build_moq_transform(params, config: Dict[str, Any]):
             from deepspeed_tpu.ops.quantizer.core import divisor_groups
             g = (groups if leaf.size % groups == 0
                  else divisor_groups(leaf.size, max(1, leaf.size // max(groups, 1))))
+            leaf_period = period
+            for prefix, factor in (period_factors or {}).items():
+                if key == prefix or key.startswith(prefix + "/"):
+                    leaf_period = max(1, int(round(period * factor)))
+                    break
             return fake_quantize_stepped(
                 leaf, eff, start_bits=start_bits, target_bits=target_bits,
-                period=period, groups=g, symmetric=symmetric,
+                period=leaf_period, groups=g, symmetric=symmetric,
                 stochastic=stochastic, mixed_fp16=mixed, change_ratio=change_ratio,
                 rng=jax.random.fold_in(step_key, counter[0]))
 

@@ -35,3 +35,14 @@ def cpu_subprocess_env(n_virtual_devices: int = 0) -> dict:
         env["XLA_FLAGS"] = (
             flags + f" --xla_force_host_platform_device_count={n_virtual_devices}").strip()
     return env
+
+
+def pin_cpu_in_process(n_virtual_devices: int = 8) -> None:
+    """Pin THIS process to the CPU platform before jax is imported (example
+    scripts' --cpu mode): scrub the tunnel plugin vars and force
+    ``n_virtual_devices`` XLA host devices. Callers must still run
+    ``jax.config.update("jax_platforms", "cpu")`` after importing jax (the
+    session sitecustomize pins "axon,cpu" in jax config)."""
+    os.environ.update(cpu_subprocess_env(n_virtual_devices))
+    for var in _TPU_PLUGIN_VARS:
+        os.environ.pop(var, None)

@@ -29,11 +29,8 @@ def main():
     args = ap.parse_args()
 
     if args.cpu:
-        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
-                                   " --xla_force_host_platform_device_count=8").strip()
-        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-        os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
-        os.environ["JAX_PLATFORMS"] = "cpu"
+        from envutil import pin_cpu_in_process
+        pin_cpu_in_process(8)
 
     import jax
     if args.cpu:

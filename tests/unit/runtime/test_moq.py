@@ -99,6 +99,52 @@ def test_engine_trains_with_moq_config():
     assert engine._compression_transform is not None
 
 
+def test_engine_moq_with_eigenvalue_modulation():
+    """The eigenvalue config block stretches high-curvature layers' MoQ
+    periods (reference engine wiring of Eigenvalue into the quantizer)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(get_gpt2_config("test", dtype=jnp.bfloat16)),
+        config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "bf16": {"enabled": True},
+            "quantize_training": {"enabled": True,
+                                  "quantize_bits": {"start_bits": 8, "target_bits": 6},
+                                  "quantize_period": 4},
+            "eigenvalue": {"enabled": True, "max_iter": 4, "tol": 0.1,
+                           "layer_name": "h", "layer_num": 2},
+            "steps_per_print": 10**9,
+        })
+    rng = np.random.default_rng(1)
+    batch = {"input_ids": rng.integers(0, 250, (8, 32)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch)) for _ in range(3)]
+    assert all(np.isfinite(losses))
+    factors = engine._moq_eigenvalue_factors()
+    assert set(factors) == {"h_0", "h_1"}
+    assert all(1.0 <= f <= 5.0 for f in factors.values())
+    assert max(factors.values()) == 5.0  # the max-curvature layer hits 1+floor(4)
+
+
+def test_period_factors_stretch_schedule():
+    rng = np.random.default_rng(5)
+    params = {"h_0": {"w": jnp.asarray(rng.normal(size=(32, 32)), jnp.float32)},
+              "h_1": {"w": jnp.asarray(rng.normal(size=(32, 32)), jnp.float32)}}
+    cfg = {"enabled": True, "quantize_bits": {"start_bits": 8, "target_bits": 4},
+           "quantize_period": 10}
+    fast = build_moq_transform(params, cfg)
+    slow = build_moq_transform(params, cfg, period_factors={"h_1": 100.0})
+    step = jnp.asarray(500)  # fast schedule is at 4 bits; 100x period still at 8
+    out_f, out_s = fast(params, step), slow(params, step)
+    np.testing.assert_array_equal(np.asarray(out_f["h_0"]["w"]),
+                                  np.asarray(out_s["h_0"]["w"]))
+    n_fast = len(np.unique(np.round(np.asarray(out_f["h_1"]["w"]), 5)))
+    n_slow = len(np.unique(np.round(np.asarray(out_s["h_1"]["w"]), 5)))
+    assert n_fast <= 16 < n_slow  # 4-bit vs still-8-bit
+
+
 def test_host_quantizer_api_parity():
     """Reference host API: q_period doubles per reduction, eigenvalue
     factor stretches it, mixed ratio re-arms."""

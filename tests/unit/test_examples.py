@@ -8,11 +8,12 @@ import sys
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+from envutil import cpu_subprocess_env  # noqa: E402
 
 
 def _run(args, timeout=420):
-    sys.path.insert(0, REPO)
-    from envutil import cpu_subprocess_env
     return subprocess.run([sys.executable, *args], cwd=REPO, env=cpu_subprocess_env(),
                           capture_output=True, text=True, timeout=timeout)
 
