@@ -86,16 +86,20 @@ class Eigenvalue:
             log_dist(f"eigenvalue[{key}] = {eig:.6g}")
         return eig
 
-    def compute_eigenvalue(self, loss_fn: Callable, params, rng=None) -> List[float]:
-        """Per-layer top eigenvalues; post-processed like the reference
-        (abs, zeros replaced by the max so MoQ ratios stay finite)."""
+    def compute_eigenvalue(self, loss_fn: Callable, params, rng=None,
+                           scrub: bool = True) -> List[float]:
+        """Per-layer top eigenvalues. With ``scrub`` (default, reference
+        post-processing): non-finite values (diverged power iterations
+        under low precision) become no-signal zeros, and zeros are then
+        replaced by the max so MoQ ratios stay finite. ``scrub=False``
+        returns |eig| raw (incl. non-finite) so callers can apply their
+        own divergence policy."""
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         keys = self._layer_keys(params)
         eigs = [abs(self._layer_eigenvalue(loss_fn, params, k, jax.random.fold_in(rng, i)))
                 for i, k in enumerate(keys)]
-        # a diverged power iteration (non-finite HVPs under low precision)
-        # must not poison the whole set — treat it as no-signal, like the
-        # reference's nan_to_num scrubbing
+        if not scrub:
+            return eigs
         eigs = [e if np.isfinite(e) else 0.0 for e in eigs]
         max_eig = max(eigs) if any(e > 0 for e in eigs) else 1.0
         return [e if e > 0 else max_eig for e in eigs]

