@@ -144,3 +144,73 @@ def test_pipeline_trains():
     # forward/backward shims are rejected like the reference
     with pytest.raises(RuntimeError):
         engine.forward(batch)
+
+
+# ---------------------------------------------------------------------------
+# tied weights + checkpointing (reference tied-layer grads, pipe ckpt tests)
+# ---------------------------------------------------------------------------
+def test_tied_embedding_receives_both_gradient_paths():
+    """The tied wte is used by the prologue (lookup) AND the epilogue (LM
+    head). Its gradient must include both uses — zeroing the head
+    contribution would leave only the gather path, so compare against the
+    dense model's wte grad, which is the ground truth for the sum."""
+    cfg = get_gpt2_config("test", n_layer=2)
+    topo = MeshTopology(pipe=2, data=1, fsdp=4)
+    pipe = PipelineModule(layers=gpt2_pipe_layers(cfg), topology=topo)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=pipe, config={"train_batch_size": 8,
+                            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+        topology=topo)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (8, 32)).astype(np.int32)}
+    engine.initialize_state(batch)
+
+    ids = jnp.asarray(batch["input_ids"])
+    pipe_params = jax.device_get(engine.state.params)
+    fn = engine._pipeline_loss_fn()
+    ids_mb = ids[None]  # [micro=1, batch, seq]
+
+    def pipe_loss(p):
+        return fn(p, ids_mb, ids_mb)
+
+    with engine.mesh:
+        g_pipe = jax.jit(jax.grad(pipe_loss))(pipe_params)["tied_embed"]["wte"]
+
+    set_topology(None)
+    dense_params = _dense_params_from_pipe(pipe_params, cfg.n_layer)
+    model = GPT2LMHeadModel(cfg)
+
+    def dense_loss(p):
+        logits = model.apply({"params": p}, ids, deterministic=True)
+        return cross_entropy_loss(logits[:, :-1], ids[:, 1:])
+
+    g_dense = jax.grad(dense_loss)(dense_params)["wte"]
+    np.testing.assert_allclose(np.asarray(g_pipe, np.float32),
+                               np.asarray(g_dense, np.float32), atol=2e-5)
+
+
+def test_pipeline_checkpoint_roundtrip(tmp_path):
+    cfg = get_gpt2_config("test", n_layer=2)
+    topo = MeshTopology(pipe=2, data=1, fsdp=4)
+
+    def build():
+        pipe = PipelineModule(layers=gpt2_pipe_layers(cfg), topology=topo)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=pipe, config={"train_batch_size": 8,
+                                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+            topology=topo)
+        return engine
+
+    rng = np.random.default_rng(1)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (8, 32)).astype(np.int32)}
+    e1 = build()
+    for _ in range(2):
+        e1.train_batch(batch)
+    e1.save_checkpoint(str(tmp_path))
+
+    e2 = build()
+    e2.initialize_state(batch)
+    e2.load_checkpoint(str(tmp_path))
+    assert e2.global_steps == 2
+    l1, l2 = float(e1.train_batch(batch)), float(e2.train_batch(batch))
+    assert abs(l1 - l2) < 1e-6
