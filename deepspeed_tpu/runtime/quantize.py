@@ -20,7 +20,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.ops.quantizer.core import divisor_groups
 from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.tree import keypath_str
 
 TWO_D_PARAMS = 6
 
@@ -62,13 +64,17 @@ def _binary_fake_quant(flat):
     return jnp.sign(flat) * m
 
 
-def moq_bits_at(step, start_bits: int, target_bits: int, period: int):
-    """In-graph bit schedule: first reduction once ``step >= period``, each
-    further reduction after a doubled period (reference ``q_period <<= 1``)
-    — ``bits(t) = start - (floor(log2(t/period)) + 1)`` clamped to target."""
+def _period_crossings(step, period: int):
+    """How many bit reductions have happened by ``step``: the first once
+    ``step >= period``, each further one after a doubled period (reference
+    ``q_period <<= 1``) — ``floor(log2(t/period)) + 1``."""
     t = jnp.maximum(step.astype(jnp.float32), 1.0)
-    crossings = jnp.where(t < period, 0.0,
-                          jnp.floor(jnp.log2(t / period)) + 1.0)
+    return t, jnp.where(t < period, 0.0, jnp.floor(jnp.log2(t / period)) + 1.0)
+
+
+def moq_bits_at(step, start_bits: int, target_bits: int, period: int):
+    """In-graph bit schedule: ``bits(t) = start - crossings`` clamped."""
+    _, crossings = _period_crossings(step, period)
     return jnp.clip(start_bits - crossings, target_bits, start_bits)
 
 
@@ -91,8 +97,7 @@ def fake_quantize_stepped(x, step, *, start_bits: int, target_bits: int,
         out = jnp.where(bits <= 1.0, _binary_fake_quant(flat), out)
     if mixed_fp16:
         # ratio re-arms to 1.0 at each bit reduction and decays per step
-        t = jnp.maximum(step.astype(jnp.float32), 1.0)
-        crossings = jnp.where(t < period, 0.0, jnp.floor(jnp.log2(t / period)) + 1.0)
+        t, crossings = _period_crossings(step, period)
         last_reduction = jnp.where(crossings > 0,
                                    jnp.exp2(crossings - 1.0) * period, 0.0)
         ratio = jnp.maximum(1.0 - change_ratio * (t - last_reduction), 0.0)
@@ -134,7 +139,7 @@ def build_moq_transform(params, config: Dict[str, Any],
                          .get("quantize_change_ratio", 0.001))
     offset = int(config.get("schedule_offset", sched.get("schedule_offset", 0)))
 
-    flat_paths = {"/".join(str(k.key) if hasattr(k, "key") else str(k) for k in path)
+    flat_paths = {keypath_str(path)
                   for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]
                   if hasattr(leaf, "ndim") and leaf.ndim > 1
                   and jnp.issubdtype(leaf.dtype, jnp.floating)}
@@ -152,11 +157,10 @@ def build_moq_transform(params, config: Dict[str, Any],
         counter = [0]
 
         def q(path, leaf):
-            key = "/".join(str(k.key) if hasattr(k, "key") else str(k) for k in path)
+            key = keypath_str(path)
             if key not in flat_paths:
                 return leaf
             counter[0] += 1
-            from deepspeed_tpu.ops.quantizer.core import divisor_groups
             g = (groups if leaf.size % groups == 0
                  else divisor_groups(leaf.size, max(1, leaf.size // max(groups, 1))))
             leaf_period = period

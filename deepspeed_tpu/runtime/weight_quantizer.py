@@ -17,13 +17,10 @@ import jax.numpy as jnp
 
 from deepspeed_tpu.ops.quantizer.core import divisor_groups
 from deepspeed_tpu.utils.logging import log_dist
+from deepspeed_tpu.utils.tree import keypath_str as _path_str
 
 _SKIP_TOKENS = ("wte", "wpe", "embed", "shared", "lm_head", "word_embeddings",
                 "position_embeddings", "token_type")
-
-
-def _path_str(path) -> str:
-    return "/".join(str(k.key) if hasattr(k, "key") else str(k) for k in path)
 
 
 def _is_quantizable(path: str, leaf) -> bool:
