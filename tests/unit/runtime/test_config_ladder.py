@@ -23,6 +23,12 @@ def _lower(model, ds_config, topology, seq=128, batch=8):
     return engine, text
 
 
+def _param_count(engine):
+    import jax
+    return sum(int(np.prod(sh)) for sh in jax.tree.leaves(
+        engine.plan.param_shapes, is_leaf=lambda x: isinstance(x, tuple)))
+
+
 @pytest.mark.parametrize("stage", [2, 3])
 def test_gpt2_xl_lowers_under_zero(stage):
     """GPT-2-XL (1.5B) bf16 ZeRO-2/3 over fsdp=8 — the ladder's second rung."""
@@ -33,10 +39,7 @@ def test_gpt2_xl_lowers_under_zero(stage):
           "bf16": {"enabled": True},
           "zero_optimization": {"stage": stage}}
     engine, text = _lower(GPT2LMHeadModel(cfg), ds, MeshTopology(fsdp=8))
-    import jax
-    n = sum(int(np.prod(sh)) for sh in jax.tree.leaves(
-        engine.plan.param_shapes, is_leaf=lambda x: isinstance(x, tuple)))
-    assert n > 1.5e9
+    assert _param_count(engine) > 1.5e9
 
 
 def test_llama_1b_lowers_with_zeropp_and_tp():
@@ -52,3 +55,22 @@ def test_llama_1b_lowers_with_zeropp_and_tp():
                                 "zero_quantized_gradients": True}}
     engine, text = _lower(LlamaForCausalLM(cfg), ds, MeshTopology(fsdp=4, tensor=2))
     assert engine._use_qcomm, "qcomm must engage on a DP(+TP) mesh"
+
+
+def test_llama_7b_lowers_full_stack():
+    """The ladder's top rung at full scale: LLaMA-7B bf16, ZeRO-3 +
+    ZeRO++ quantized collectives, tensor=2 x sequence=2 x fsdp=2, remat,
+    fused LM-head loss — the training graph must build abstractly (no 7B
+    of host RAM touched; lower() only)."""
+    import jax.numpy as jnp
+    cfg = get_llama_config("7b", max_position_embeddings=128, dtype=jnp.bfloat16,
+                           remat=True, fused_head_loss_chunk=128)
+    ds = {"train_batch_size": 8,
+          "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+          "bf16": {"enabled": True},
+          "zero_optimization": {"stage": 3,
+                                "zero_quantized_weights": True,
+                                "zero_quantized_gradients": True}}
+    engine, text = _lower(LlamaForCausalLM(cfg), ds,
+                          MeshTopology(fsdp=2, tensor=2, sequence=2))
+    assert _param_count(engine) > 6e9  # the real 7B count, planned and sharded
