@@ -1,6 +1,7 @@
 """Shared model-zoo helpers."""
 
 import functools
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -291,3 +292,21 @@ def fused_head_loss_output(x, weight, labels, aux_total, deterministic, cfg, *,
     if getattr(cfg, "moe_num_experts", 0) > 0 and not deterministic:
         loss = loss + aux_total * cfg.moe_aux_loss_coef
     return loss
+
+
+class UntiedHeadKernel(nn.Module):
+    """Declares an untied LM-head kernel at the same param path as
+    ``nn.Dense(name=<name>)`` ([E, V], same init/partitioning) so a fused-
+    loss branch shares weights with the logits branch (used by LLaMA's
+    ``lm_head`` and GPT-NeoX's ``embed_out``)."""
+
+    in_features: int
+    out_features: int
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self):
+        kernel = self.param("kernel",
+                            nn.with_logical_partitioning(dense_init(), ("embed", "vocab")),
+                            (self.in_features, self.out_features), self.param_dtype)
+        return kernel.value if isinstance(kernel, nn.meta.AxisMetadata) else kernel

@@ -38,6 +38,9 @@ class FalconConfig:
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
     remat: bool = False
+    # >0: loss via the chunked fused LM head when called with labels=
+    # (models/common.py fused_lm_head_loss) — no [B, L, V] logits buffer
+    fused_head_loss_chunk: int = 0
     attention_backend: str = "xla"
 
     @property
@@ -149,7 +152,8 @@ class FalconForCausalLM(nn.Module):
     config: FalconConfig
 
     @nn.compact
-    def __call__(self, input_ids, *, deterministic: bool = True, decode: bool = False):
+    def __call__(self, input_ids, *, deterministic: bool = True, decode: bool = False,
+                 labels=None):
         cfg = self.config
         wte = self.param("word_embeddings", nn.with_logical_partitioning(_init(), ("vocab", "embed")),
                          (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
@@ -162,5 +166,9 @@ class FalconForCausalLM(nn.Module):
             x = block_cls(cfg, decode, name=f"h_{i}")(x)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="ln_f")(x)
+        if labels is not None and cfg.fused_head_loss_chunk > 0:
+            from deepspeed_tpu.models.common import fused_head_loss_output
+            return fused_head_loss_output(x, wte_v.astype(cfg.dtype), labels,
+                                          0.0, deterministic, cfg, vocab_major=True)
         return jnp.einsum("ble,ve->blv", x, wte_v.astype(cfg.dtype),
                           preferred_element_type=cfg.dtype)

@@ -37,6 +37,9 @@ class GPTNeoXConfig:
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
     remat: bool = False
+    # >0: loss via the chunked fused LM head when called with labels=
+    # (models/common.py fused_lm_head_loss) — no [B, L, V] logits buffer
+    fused_head_loss_chunk: int = 0
     attention_backend: str = "xla"
 
     @property
@@ -157,7 +160,8 @@ class GPTNeoXForCausalLM(nn.Module):
     config: GPTNeoXConfig
 
     @nn.compact
-    def __call__(self, input_ids, *, deterministic: bool = True, decode: bool = False):
+    def __call__(self, input_ids, *, deterministic: bool = True, decode: bool = False,
+                 labels=None):
         cfg = self.config
         embed_in = self.param("embed_in", nn.with_logical_partitioning(_init(), ("vocab", "embed")),
                               (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
@@ -170,6 +174,12 @@ class GPTNeoXForCausalLM(nn.Module):
             x = block_cls(cfg, decode, name=f"layers_{i}")(x)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="final_layer_norm")(x)
+        if labels is not None and cfg.fused_head_loss_chunk > 0:
+            from deepspeed_tpu.models.common import UntiedHeadKernel, fused_head_loss_output
+            kernel = UntiedHeadKernel(cfg.hidden_size, cfg.vocab_size,
+                                      cfg.param_dtype, name="embed_out")()
+            return fused_head_loss_output(x, kernel.astype(cfg.dtype), labels,
+                                          0.0, deterministic, cfg, vocab_major=False)
         return nn.Dense(features=cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                         param_dtype=cfg.param_dtype,
                         kernel_init=nn.with_logical_partitioning(_init(), ("embed", "vocab")),

@@ -248,22 +248,6 @@ class LlamaDecoderLayer(nn.Module):
 from deepspeed_tpu.models.common import init_cache  # noqa: E402  (re-export)
 
 
-class _LMHeadKernel(nn.Module):
-    """Declares the LM-head kernel at the same param path as
-    ``nn.Dense(name="lm_head")`` ([E, V], same init/partitioning) so the
-    fused-loss branch shares weights with the logits branch."""
-
-    config: LlamaConfig
-
-    @nn.compact
-    def __call__(self):
-        cfg = self.config
-        kernel = self.param("kernel",
-                            nn.with_logical_partitioning(_init(), ("embed", "vocab")),
-                            (cfg.hidden_size, cfg.vocab_size), cfg.param_dtype)
-        return kernel.value if isinstance(kernel, nn.meta.AxisMetadata) else kernel
-
-
 class LlamaForCausalLM(nn.Module):
     """LLaMA with an untied LM head. Returns logits [B, L, V].
 
@@ -299,8 +283,9 @@ class LlamaForCausalLM(nn.Module):
             # checkpoints and HF converters are unaffected (shift/aux
             # policy lives in fused_head_loss_output, shared across
             # families)
-            from deepspeed_tpu.models.common import fused_head_loss_output
-            kernel = _LMHeadKernel(cfg, name="lm_head")()
+            from deepspeed_tpu.models.common import UntiedHeadKernel, fused_head_loss_output
+            kernel = UntiedHeadKernel(cfg.hidden_size, cfg.vocab_size,
+                                      cfg.param_dtype, name="lm_head")()
             return fused_head_loss_output(x, kernel.astype(cfg.dtype), labels,
                                           aux_total, deterministic, cfg,
                                           vocab_major=False)

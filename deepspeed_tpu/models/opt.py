@@ -42,6 +42,9 @@ class OPTConfig:
     param_dtype: Any = jnp.float32
     remat: bool = False
     attention_backend: str = "xla"
+    # >0: loss via the chunked fused LM head when called with labels=
+    # (models/common.py fused_lm_head_loss) — no [B, L, V] logits buffer
+    fused_head_loss_chunk: int = 0
 
     @property
     def head_dim(self):
@@ -158,7 +161,8 @@ class OPTForCausalLM(nn.Module):
     config: OPTConfig
 
     @nn.compact
-    def __call__(self, input_ids, *, deterministic: bool = True, decode: bool = False):
+    def __call__(self, input_ids, *, deterministic: bool = True, decode: bool = False,
+                 labels=None):
         cfg = self.config
         embed_tokens = self.param(
             "embed_tokens", nn.with_logical_partitioning(_init(), ("vocab", "embed")),
@@ -197,5 +201,9 @@ class OPTForCausalLM(nn.Module):
                          param_dtype=cfg.param_dtype,
                          kernel_init=nn.with_logical_partitioning(_init(), ("mlp", "embed")),
                          name="project_out")(x)
+        if labels is not None and cfg.fused_head_loss_chunk > 0:
+            from deepspeed_tpu.models.common import fused_head_loss_output
+            return fused_head_loss_output(x, wte.astype(cfg.dtype), labels,
+                                          0.0, deterministic, cfg, vocab_major=True)
         return jnp.einsum("ble,ve->blv", x, wte.astype(cfg.dtype),
                           preferred_element_type=cfg.dtype)

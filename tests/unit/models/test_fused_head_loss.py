@@ -107,3 +107,28 @@ def test_engine_trains_with_fused_head(tmp_path):
         losses[tag] = [float(engine.train_batch(batch)) for _ in range(4)]
     assert losses["fused"][-1] < losses["fused"][0]
     np.testing.assert_allclose(losses["fused"], losses["plain"], rtol=2e-2)
+
+
+@pytest.mark.parametrize("family", ["opt", "gpt_neox", "bloom", "falcon"])
+def test_zoo_fused_head_matches_logits_path(family):
+    """Every causal-LM family's fused-head branch reproduces its
+    logits+cross_entropy loss on shared params (tied [V,E] heads for
+    OPT/BLOOM/Falcon, untied [E,V] embed_out for GPT-NeoX)."""
+    if family == "opt":
+        from deepspeed_tpu.models.opt import OPTForCausalLM as M, get_opt_config as C
+    elif family == "gpt_neox":
+        from deepspeed_tpu.models.gpt_neox import GPTNeoXForCausalLM as M, get_gpt_neox_config as C
+    elif family == "bloom":
+        from deepspeed_tpu.models.bloom import BloomForCausalLM as M, get_bloom_config as C
+    else:
+        from deepspeed_tpu.models.falcon import FalconForCausalLM as M, get_falcon_config as C
+
+    rng = np.random.default_rng(7)
+    cfg_plain = C("test", dtype=jnp.bfloat16)
+    cfg_fused = C("test", dtype=jnp.bfloat16, fused_head_loss_chunk=32)
+    ids = jnp.asarray(rng.integers(0, cfg_plain.vocab_size, (2, 64)), jnp.int32)
+    params = M(cfg_plain).init(jax.random.PRNGKey(0), ids)["params"]
+    loss_f = M(cfg_fused).apply({"params": params}, ids, labels=ids)
+    logits = M(cfg_plain).apply({"params": params}, ids)
+    loss_p = cross_entropy_loss(logits[:, :-1], ids[:, 1:])
+    np.testing.assert_allclose(np.asarray(loss_f), np.asarray(loss_p), rtol=2e-5)
