@@ -22,8 +22,8 @@ def test_theta_schedule_matches_reference_formula():
                                "pld_theta": pld.get_theta()}
 
 
-def _engine(pld_enabled, model_flag=True, seed_cfg=None):
-    cfg = get_gpt2_config("test", dtype=jnp.bfloat16,
+def _engine(pld_enabled, model_flag=True, seed_cfg=None, remat=False):
+    cfg = get_gpt2_config("test", dtype=jnp.bfloat16, remat=remat,
                           progressive_layer_drop=model_flag, **(seed_cfg or {}))
     ds = {
         "train_batch_size": 8,
@@ -83,6 +83,16 @@ def test_fused_multi_step_dispatch_anneals_in_graph():
     # host mirror tracked all 4 steps
     want = (1.0 - 0.5) * np.exp(-0.5 * 4) + 0.5
     assert engine.progressive_layer_drop.get_theta() == pytest.approx(want)
+
+
+def test_pld_composes_with_remat():
+    """The traced pld_keep operand must survive nn.remat's static_argnums
+    partitioning (deterministic stays static, keep stays traced)."""
+    engine = _engine(pld_enabled=True, remat=True)
+    batch = make_batch()
+    losses = [float(engine.train_batch(batch)) for _ in range(3)]
+    assert all(np.isfinite(losses))
+    assert engine.progressive_layer_drop.get_theta() < 1.0
 
 
 def test_bert_pld_trains():
