@@ -38,6 +38,11 @@ class BertConfig:
     # at train time with depth-scaled keep probability when the engine
     # passes ``pld_theta``
     progressive_layer_drop: bool = False
+    # [B, L] attention masks are treated as CONTIGUOUS right-padding (the
+    # HF standard) so the flash kernel can mask natively via per-sequence
+    # lengths. Set False for non-prefix [B, L] masks (e.g. left padding)
+    # to route them through the exact mask= path instead.
+    flash_prefix_padding: bool = True
 
     @property
     def head_dim(self):
@@ -90,8 +95,21 @@ class BertSelfAttention(nn.Module):
                                    name=name)
 
         q, k, v = proj("query")(x), proj("key")(x), proj("value")(x)
-        mask = normalize_padding_mask(attention_mask)
-        out = dot_product_attention(q, k, v, backend=cfg.attention_backend, causal=False, mask=mask)
+        if (attention_mask is not None and attention_mask.ndim == 2
+                and cfg.attention_backend == "flash" and cfg.flash_prefix_padding):
+            # [B, L] 0/1 padding mask: under the flash backend, pass the
+            # valid-prefix lengths so the kernel masks natively instead of
+            # falling back to XLA. Contract (cfg.flash_prefix_padding):
+            # [B, L] masks are CONTIGUOUS right-padding (the HF standard);
+            # left-padded or holey masks must set the flag False (or come
+            # in pre-broadcast [B,1,1,L]) to take the exact mask= path.
+            out = dot_product_attention(q, k, v, backend=cfg.attention_backend,
+                                        causal=False,
+                                        kv_lengths=attention_mask.sum(axis=-1).astype(jnp.int32))
+        else:
+            mask = normalize_padding_mask(attention_mask)
+            out = dot_product_attention(q, k, v, backend=cfg.attention_backend,
+                                        causal=False, mask=mask)
         out = nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype,
                               param_dtype=cfg.param_dtype,
                               kernel_init=nn.with_logical_partitioning(_init(), ("heads", "kv", "embed")),

@@ -49,6 +49,18 @@ def _last_k_block(qi, blk_q, blk_k, off, nk):
     return jnp.minimum(nk, (qi * blk_q + blk_q - 1 + off) // blk_k + 1)
 
 
+def _apply_kv_length_mask(s, j, blk_k, kv_len):
+    """Mask score columns at-or-beyond this sequence's valid K prefix
+    (right-padding contract: positions [0, kv_len) are real)."""
+    k_pos = j * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(k_pos < kv_len, s, NEG_INF)
+
+
+def _n_live_blocks(kv_len, blk_k):
+    """K blocks intersecting the valid prefix (>=1 so state initializes)."""
+    return jnp.maximum((kv_len + blk_k - 1) // blk_k, 1)
+
+
 def _pick_block(length: int, preferred: int = 512) -> int:
     for blk in (preferred, 256, 128, 64, 32, 16, 8):
         if blk <= length and length % blk == 0:
@@ -73,9 +85,15 @@ def _warn_fallback(reason: str):
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
-                scale, causal, blk_q, blk_k, nq, nk):
-    # grid (b, h, qi, j): one K/V block per step; m/l/acc ride VMEM scratch
+def _fwd_kernel(*refs, scale, causal, blk_q, blk_k, nq, nk, masked):
+    # grid (b, h, qi, j): one K/V block per step; m/l/acc ride VMEM scratch.
+    # With ``masked`` the first ref is the scalar-prefetched [B] kv-lengths.
+    if masked:
+        lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref = refs
+        kv_len = lens_ref[pl.program_id(0)]
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref = refs
+        kv_len = None
     qi, j = pl.program_id(2), pl.program_id(3)
     off = nk * blk_k - nq * blk_q  # kv-cache decode offset
 
@@ -86,6 +104,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     nk_eff = _last_k_block(qi, blk_q, blk_k, off, nk) if causal else nk
+    if masked:
+        nk_eff = jnp.minimum(nk_eff, _n_live_blocks(kv_len, blk_k))
 
     @pl.when(j < nk_eff)
     def _block():
@@ -96,11 +116,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
                                 preferred_element_type=jnp.float32)  # [blk_q, blk_k]
         if causal:
             s = _apply_causal_mask(s, qi, j, blk_q, blk_k, off)
+        if masked:
+            s = _apply_kv_length_mask(s, j, blk_k, kv_len)
         m = m_ref[:, 0]
         l = l_ref[:, 0]
         m_new = jnp.maximum(m, s.max(axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m - m_new)
+        # fully-masked score rows keep m = -inf; anchor the exp at 0 there
+        # so p stays finite (and exactly 0)
+        anchor = jnp.maximum(m_new, NEG_INF / 2)
+        p = jnp.exp(s - anchor[:, None])
+        alpha = jnp.exp(jnp.maximum(m, NEG_INF / 2) - anchor)
         l_new = l * alpha + p.sum(axis=-1)
         acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
@@ -110,74 +135,116 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
     @pl.when(j == nk - 1)
     def _finalize():
         l = l_ref[:, 0]
+        m = m_ref[:, 0]
         l_safe = jnp.maximum(l, 1e-37)
         o_ref[...] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
         # lse rides a [B,H,L] array (ref block [1, blk_q]): a trailing
         # [..., 1] dim would tile-pad to 128 lanes — 128x the HBM held as
-        # backward residuals (128 MB/layer at b=16,h=16,L=1024)
-        lse_ref[...] = (m_ref[:, 0] + jnp.log(l_safe))[None, :]
+        # backward residuals (128 MB/layer at b=16,h=16,L=1024).
+        # Rows with no live keys (query beyond every valid K) get a large
+        # FINITE negative lse so the backward's exp(s - lse) is exactly 0
+        # instead of exp(-inf + inf) = NaN.
+        lse_vec = jnp.where(l > 0, jnp.maximum(m, NEG_INF / 2) + jnp.log(l_safe),
+                            NEG_INF / 2)
+        lse_ref[...] = lse_vec[None, :]
 
 
-def _kv_index_map(causal, blk_q, blk_k, off, nk):
-    """K/V block index for grid step (qi, j). Causally dead steps CLAMP to
-    the last live block: the index map re-requests the already-resident
-    block, Mosaic elides the DMA, and the dead step moves no HBM bytes
-    (the `pl.when` in the kernel already skips its FLOPs)."""
-    if not causal:
+def _pad_idx(fn, masked):
+    """Under PrefetchScalarGridSpec, index maps receive the scalar-prefetch
+    refs as extra trailing args — drop them for maps that don't care."""
+    return (lambda *a: fn(*a[:-1])) if masked else fn
+
+
+def _length_call(kernel, grid, in_specs, out_specs, out_shape, scratch,
+                 interpret, kv_lengths, args):
+    """One pallas_call dispatch for the optional [B]-lengths scalar-prefetch
+    operand (shared by fwd and both bwd passes so the masked/unmasked
+    switch cannot drift between them)."""
+    if kv_lengths is not None:
+        return pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1, grid=grid, in_specs=in_specs,
+                out_specs=out_specs, scratch_shapes=scratch),
+            out_shape=out_shape, interpret=interpret,
+        )(kv_lengths.astype(jnp.int32), *args)
+    return pl.pallas_call(kernel, grid=grid, in_specs=in_specs,
+                          out_specs=out_specs, out_shape=out_shape,
+                          scratch_shapes=scratch, interpret=interpret)(*args)
+
+
+def _kv_index_map(causal, blk_q, blk_k, off, nk, masked=False):
+    """K/V block index for grid step (qi, j). Dead steps — causally dead
+    OR beyond the sequence's valid K prefix — CLAMP to the last live
+    block: the index map re-requests the already-resident block, Mosaic
+    elides the DMA, and the dead step moves no HBM bytes (the `pl.when`
+    in the kernel already skips its FLOPs)."""
+    if not causal and not masked:
         return lambda bi, hi, qi, j: (bi, hi, j, 0)
 
-    def index(bi, hi, qi, j):
-        last = jnp.minimum(nk - 1, (qi * blk_q + blk_q - 1 + off) // blk_k)
+    def index(bi, hi, qi, j, *lens):
+        last = nk - 1
+        if causal:
+            last = jnp.minimum(last, (qi * blk_q + blk_q - 1 + off) // blk_k)
+        if masked:
+            last = jnp.minimum(last, _n_live_blocks(lens[0][bi], blk_k) - 1)
         return (bi, hi, jnp.minimum(j, last), 0)
 
     return index
 
 
-def _flash_fwd(q, k, v, scale, causal, blk_q, blk_k, interpret):
-    # q,k,v: [B,H,L,D]
+def _flash_fwd(q, k, v, scale, causal, blk_q, blk_k, interpret, kv_lengths=None):
+    # q,k,v: [B,H,L,D]; kv_lengths: optional [B] valid-prefix lengths
     b, h, lq, d = q.shape
     lk = k.shape[2]
     nq, nk = lq // blk_q, lk // blk_k
     off = lk - lq
-    kv_idx = _kv_index_map(causal, blk_q, blk_k, off, nk)
+    masked = kv_lengths is not None
+    kv_idx = _kv_index_map(causal, blk_q, blk_k, off, nk, masked)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               blk_q=blk_q, blk_k=blk_k, nq=nq, nk=nk)
-    o, lse = pl.pallas_call(
-        kernel,
-        grid=(b, h, nq, nk),
-        in_specs=[
-            pl.BlockSpec((None, None, blk_q, d), lambda bi, hi, qi, j: (bi, hi, qi, 0)),
-            pl.BlockSpec((None, None, blk_k, d), kv_idx),
-            pl.BlockSpec((None, None, blk_k, d), kv_idx),
-        ],
-        out_specs=[
-            pl.BlockSpec((None, None, blk_q, d), lambda bi, hi, qi, j: (bi, hi, qi, 0)),
-            # stats ride a [B,H,1,L] array — Mosaic accepts the size-1 block
-            # dim because it equals the array dim, and the caller squeezes to
-            # a compact [B,H,L] residual. A trailing [..., 1] dim instead
-            # would tile-pad to 128 lanes (128 MB/layer of backward
-            # residuals at b=16,h=16,L=1024).
-            pl.BlockSpec((None, None, 1, blk_q), lambda bi, hi, qi, j: (bi, hi, 0, qi)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b, h, lq, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, 1, lq), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((blk_q, 1), jnp.float32),   # running max
-            pltpu.VMEM((blk_q, 1), jnp.float32),   # running denom
-            pltpu.VMEM((blk_q, d), jnp.float32),   # output accumulator
-        ],
-        interpret=interpret,
-    )(q, k, v)
+                               blk_q=blk_q, blk_k=blk_k, nq=nq, nk=nk,
+                               masked=masked)
+    qo_idx = _pad_idx(lambda bi, hi, qi, j: (bi, hi, qi, 0), masked)
+    in_specs = [
+        pl.BlockSpec((None, None, blk_q, d), qo_idx),
+        pl.BlockSpec((None, None, blk_k, d), kv_idx),
+        pl.BlockSpec((None, None, blk_k, d), kv_idx),
+    ]
+    out_specs = [
+        pl.BlockSpec((None, None, blk_q, d), qo_idx),
+        # stats ride a [B,H,1,L] array — Mosaic accepts the size-1 block
+        # dim because it equals the array dim, and the caller squeezes to
+        # a compact [B,H,L] residual. A trailing [..., 1] dim instead
+        # would tile-pad to 128 lanes (128 MB/layer of backward
+        # residuals at b=16,h=16,L=1024).
+        pl.BlockSpec((None, None, 1, blk_q),
+                     _pad_idx(lambda bi, hi, qi, j: (bi, hi, 0, qi), masked)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((b, h, lq, d), q.dtype),
+        jax.ShapeDtypeStruct((b, h, 1, lq), jnp.float32),
+    ]
+    scratch_shapes = [
+        pltpu.VMEM((blk_q, 1), jnp.float32),   # running max
+        pltpu.VMEM((blk_q, 1), jnp.float32),   # running denom
+        pltpu.VMEM((blk_q, d), jnp.float32),   # output accumulator
+    ]
+    o, lse = _length_call(kernel, (b, h, nq, nk), in_specs, out_specs,
+                          out_shape, scratch_shapes, interpret, kv_lengths,
+                          (q, k, v))
     return o, lse.reshape(b, h, lq)
 
 
 # ---------------------------------------------------------------------------
 # backward
 # ---------------------------------------------------------------------------
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref, *,
-                   scale, causal, blk_q, blk_k, nq, nk):
+def _bwd_dq_kernel(*refs, scale, causal, blk_q, blk_k, nq, nk, masked):
+    if masked:
+        lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref = refs
+        kv_len = lens_ref[pl.program_id(0)]
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref = refs
+        kv_len = None
     qi, j = pl.program_id(2), pl.program_id(3)
     off = nk * blk_k - nq * blk_q
 
@@ -186,6 +253,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     nk_eff = _last_k_block(qi, blk_q, blk_k, off, nk) if causal else nk
+    if masked:
+        nk_eff = jnp.minimum(nk_eff, _n_live_blocks(kv_len, blk_k))
 
     @pl.when(j < nk_eff)
     def _block():
@@ -198,6 +267,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         if causal:
             s = _apply_causal_mask(s, qi, j, blk_q, blk_k, off)
+        if masked:
+            s = _apply_kv_length_mask(s, j, blk_k, kv_len)
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
@@ -209,8 +280,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_
         dq_ref[...] = (acc_ref[...] * scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                    dk_acc, dv_acc, *, scale, causal, blk_q, blk_k, nq, nk):
+def _bwd_dkv_kernel(*refs, scale, causal, blk_q, blk_k, nq, nk, masked):
+    if masked:
+        (lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        kv_len = lens_ref[pl.program_id(0)]
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        kv_len = None
     ki, i = pl.program_id(2), pl.program_id(3)
     off = nk * blk_k - nq * blk_q
 
@@ -225,7 +303,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
     else:
         first = 0
 
-    @pl.when(i >= first)
+    live = (i >= first)
+    if masked:
+        # K blocks entirely beyond the valid prefix contribute nothing —
+        # skip all their FLOPs (their dk/dv stay at the zero-initialized acc)
+        live = live & (ki * blk_k < kv_len)
+
+    @pl.when(live)
     def _block():
         k = k_ref[...].astype(jnp.float32)
         v = v_ref[...].astype(jnp.float32)
@@ -236,6 +320,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         if causal:
             s = _apply_causal_mask(s, i, ki, blk_q, blk_k, off)
+        if masked:
+            s = _apply_kv_length_mask(s, ki, blk_k, kv_len)
         p = jnp.exp(s - lse[:, None])
         dv_acc[...] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                            preferred_element_type=jnp.float32)
@@ -251,10 +337,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
 
 
 def _flash_bwd(res, g, scale, causal, blk_q, blk_k, interpret):
-    q, k, v, o, lse = res
+    q, k, v, o, lse, kv_lengths = res
     b, h, lq, d = q.shape
     lk = k.shape[2]
     nq, nk = lq // blk_q, lk // blk_k
+    masked = kv_lengths is not None
     do = g
     delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(axis=-1)  # [B,H,Lq]
     # size-1 dim ahead of Lq (not after): blocks (None, None, 1, blk_q) pass
@@ -263,81 +350,99 @@ def _flash_bwd(res, g, scale, causal, blk_q, blk_k, interpret):
     delta4 = delta.reshape(b, h, 1, lq)
 
     off = lk - lq
-    kv_idx = _kv_index_map(causal, blk_q, blk_k, off, nk)
-    dq = pl.pallas_call(
+    kv_idx = _kv_index_map(causal, blk_q, blk_k, off, nk, masked)
+    qo_idx = _pad_idx(lambda bi, hi, qi, j: (bi, hi, qi, 0), masked)
+    stat_q_idx = _pad_idx(lambda bi, hi, qi, j: (bi, hi, 0, qi), masked)
+
+    def _call(kernel, grid, in_specs, out_specs, out_shape, scratch, args):
+        return _length_call(kernel, grid, in_specs, out_specs, out_shape,
+                            scratch, interpret, kv_lengths, args)
+
+    dq = _call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, blk_q=blk_q,
-                          blk_k=blk_k, nq=nq, nk=nk),
-        grid=(b, h, nq, nk),
-        in_specs=[
-            pl.BlockSpec((None, None, blk_q, d), lambda bi, hi, qi, j: (bi, hi, qi, 0)),
+                          blk_k=blk_k, nq=nq, nk=nk, masked=masked),
+        (b, h, nq, nk),
+        [
+            pl.BlockSpec((None, None, blk_q, d), qo_idx),
             pl.BlockSpec((None, None, blk_k, d), kv_idx),
             pl.BlockSpec((None, None, blk_k, d), kv_idx),
-            pl.BlockSpec((None, None, blk_q, d), lambda bi, hi, qi, j: (bi, hi, qi, 0)),
-            pl.BlockSpec((None, None, 1, blk_q), lambda bi, hi, qi, j: (bi, hi, 0, qi)),
-            pl.BlockSpec((None, None, 1, blk_q), lambda bi, hi, qi, j: (bi, hi, 0, qi)),
+            pl.BlockSpec((None, None, blk_q, d), qo_idx),
+            pl.BlockSpec((None, None, 1, blk_q), stat_q_idx),
+            pl.BlockSpec((None, None, 1, blk_q), stat_q_idx),
         ],
-        out_specs=pl.BlockSpec((None, None, blk_q, d), lambda bi, hi, qi, j: (bi, hi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
-        interpret=interpret,
-    )(q, k, v, do, lse4, delta4)
+        pl.BlockSpec((None, None, blk_q, d), qo_idx),
+        jax.ShapeDtypeStruct(q.shape, q.dtype),
+        [pltpu.VMEM((blk_q, d), jnp.float32)],
+        (q, k, v, do, lse4, delta4))
 
-    if causal:
-        # steps before this K block's first live Q block clamp their Q/dO/
-        # lse/delta fetches to that first block (DMA elided on dead steps)
-        def q_idx(bi, hi, ki, i):
-            first = jnp.maximum((ki * blk_k - off) // blk_q, 0)
-            return (bi, hi, jnp.maximum(i, first), 0)
+    def _q_block(bi, ki, i, lens):
+        """Q block to fetch for dkv step (ki, i): causally-dead steps clamp
+        forward to the first live Q block; length-dead K blocks clamp to a
+        constant so their whole i-loop re-requests one resident block (DMA
+        elided — the kernel skips those steps' FLOPs too)."""
+        i_eff = i
+        if causal:
+            i_eff = jnp.maximum(i_eff, jnp.maximum((ki * blk_k - off) // blk_q, 0))
+        if masked:
+            i_eff = jnp.where(ki * blk_k < lens[bi], i_eff, 0)
+        return i_eff
 
-        def stat_idx(bi, hi, ki, i):
-            first = jnp.maximum((ki * blk_k - off) // blk_q, 0)
-            return (bi, hi, 0, jnp.maximum(i, first))
-    else:
-        def q_idx(bi, hi, ki, i):
-            return (bi, hi, i, 0)
+    def q_idx(bi, hi, ki, i, *lens):
+        return (bi, hi, _q_block(bi, ki, i, lens[0] if masked else None), 0)
 
-        def stat_idx(bi, hi, ki, i):
-            return (bi, hi, 0, i)
+    def stat_idx(bi, hi, ki, i, *lens):
+        return (bi, hi, 0, _q_block(bi, ki, i, lens[0] if masked else None))
 
-    dk, dv = pl.pallas_call(
+    def kv_in_idx(bi, hi, ki, i, *lens):
+        # inputs of a length-dead K block are never read — clamp to the
+        # last live block so the fetch is elided; OUTPUTS still target ki
+        # (their zero-initialized accumulators must be written back)
+        ki_eff = (jnp.minimum(ki, _n_live_blocks(lens[0][bi], blk_k) - 1)
+                  if masked else ki)
+        return (bi, hi, ki_eff, 0)
+
+    kv_out_idx = _pad_idx(lambda bi, hi, ki, i: (bi, hi, ki, 0), masked)
+    dk, dv = _call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, blk_q=blk_q,
-                          blk_k=blk_k, nq=nq, nk=nk),
-        grid=(b, h, nk, nq),
-        in_specs=[
+                          blk_k=blk_k, nq=nq, nk=nk, masked=masked),
+        (b, h, nk, nq),
+        [
             pl.BlockSpec((None, None, blk_q, d), q_idx),
-            pl.BlockSpec((None, None, blk_k, d), lambda bi, hi, ki, i: (bi, hi, ki, 0)),
-            pl.BlockSpec((None, None, blk_k, d), lambda bi, hi, ki, i: (bi, hi, ki, 0)),
+            pl.BlockSpec((None, None, blk_k, d), kv_in_idx),
+            pl.BlockSpec((None, None, blk_k, d), kv_in_idx),
             pl.BlockSpec((None, None, blk_q, d), q_idx),
             pl.BlockSpec((None, None, 1, blk_q), stat_idx),
             pl.BlockSpec((None, None, 1, blk_q), stat_idx),
         ],
-        out_specs=[
-            pl.BlockSpec((None, None, blk_k, d), lambda bi, hi, ki, i: (bi, hi, ki, 0)),
-            pl.BlockSpec((None, None, blk_k, d), lambda bi, hi, ki, i: (bi, hi, ki, 0)),
+        [
+            pl.BlockSpec((None, None, blk_k, d), kv_out_idx),
+            pl.BlockSpec((None, None, blk_k, d), kv_out_idx),
         ],
-        out_shape=[
+        [
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
-        scratch_shapes=[pltpu.VMEM((blk_k, d), jnp.float32),
-                        pltpu.VMEM((blk_k, d), jnp.float32)],
-        interpret=interpret,
-    )(q, k, v, do, lse4, delta4)
-    return dq, dk, dv
+        [pltpu.VMEM((blk_k, d), jnp.float32),
+         pltpu.VMEM((blk_k, d), jnp.float32)],
+        (q, k, v, do, lse4, delta4))
+    return dq, dk, dv, None
 
 
 # ---------------------------------------------------------------------------
 # public op (BHLD), custom VJP
 # ---------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_attention_bhld(q, k, v, scale, causal, blk_q, blk_k, interpret):
-    o, _ = _flash_fwd(q, k, v, scale, causal, blk_q, blk_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_attention_bhld(q, k, v, kv_lengths, scale, causal, blk_q, blk_k, interpret):
+    o, _ = _flash_fwd(q, k, v, scale, causal, blk_q, blk_k, interpret,
+                      kv_lengths=kv_lengths)
     return o
 
 
-def _flash_attention_bhld_fwd(q, k, v, scale, causal, blk_q, blk_k, interpret):
-    o, lse = _flash_fwd(q, k, v, scale, causal, blk_q, blk_k, interpret)
-    return o, (q, k, v, o, lse)
+def _flash_attention_bhld_fwd(q, k, v, kv_lengths, scale, causal, blk_q, blk_k,
+                              interpret):
+    o, lse = _flash_fwd(q, k, v, scale, causal, blk_q, blk_k, interpret,
+                        kv_lengths=kv_lengths)
+    return o, (q, k, v, o, lse, kv_lengths)
 
 
 def _flash_attention_bhld_bwd(scale, causal, blk_q, blk_k, interpret, res, g):
@@ -471,13 +576,23 @@ def flash_attention(q: jax.Array,
                     dropout_rate: float = 0.0,
                     dropout_rng: Optional[jax.Array] = None,
                     decode_lengths: Optional[jax.Array] = None,
+                    kv_lengths: Optional[jax.Array] = None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
                     interpret: Optional[bool] = None) -> jax.Array:
     """Flash attention over BLHD tensors; falls back to the XLA backend for
-    features the kernel doesn't cover (bias/mask/dropout)."""
+    features the kernel doesn't cover (bias/arbitrary mask/dropout).
+
+    ``kv_lengths`` [B]: per-sequence valid K prefix for RIGHT-PADDED
+    batches (the standard HF padding; BERT-style encoders) — handled
+    natively by the kernel in forward AND backward, no XLA fallback. Only
+    pass it for contiguous-prefix masks; arbitrary masks must go through
+    ``mask=`` (which falls back)."""
     b, lq, h, d = q.shape
     lk = k.shape[1]
+    if decode_lengths is not None and kv_lengths is not None:
+        raise ValueError("pass decode_lengths (cache decode) or kv_lengths "
+                         "(padded prefill), not both")
     if decode_lengths is not None:
         # KV-cache decode: per-sequence length masking in the kernel
         if bias is None and mask is None and dropout_rate == 0.0 and lk % (block_k or _pick_block(lk)) == 0:
@@ -493,7 +608,8 @@ def flash_attention(q: jax.Array,
         _warn_fallback("bias/mask/dropout or lq>lk requested")
         from deepspeed_tpu.ops.transformer.attention import xla_attention
         return xla_attention(q, k, v, causal=causal, bias=bias, mask=mask, scale=scale,
-                             dropout_rate=dropout_rate, dropout_rng=dropout_rng)
+                             dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+                             kv_lengths=kv_lengths)
     if scale is None:
         scale = d**-0.5
     if interpret is None:
@@ -503,9 +619,11 @@ def flash_attention(q: jax.Array,
     if lq % blk_q or lk % blk_k:
         _warn_fallback(f"sequence lengths ({lq}, {lk}) not tileable")
         from deepspeed_tpu.ops.transformer.attention import xla_attention
-        return xla_attention(q, k, v, causal=causal, scale=scale)
+        return xla_attention(q, k, v, causal=causal, scale=scale,
+                             kv_lengths=kv_lengths)
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    o = _flash_attention_bhld(qt, kt, vt, float(scale), bool(causal), blk_q, blk_k, interpret)
+    o = _flash_attention_bhld(qt, kt, vt, kv_lengths, float(scale), bool(causal),
+                              blk_q, blk_k, interpret)
     return o.transpose(0, 2, 1, 3)

@@ -47,7 +47,8 @@ def xla_attention(q: jax.Array,
                   scale: Optional[float] = None,
                   dropout_rate: float = 0.0,
                   dropout_rng: Optional[jax.Array] = None,
-                  decode_lengths: Optional[jax.Array] = None) -> jax.Array:
+                  decode_lengths: Optional[jax.Array] = None,
+                  kv_lengths: Optional[jax.Array] = None) -> jax.Array:
     """Plain XLA attention: softmax(q k^T / sqrt(d) + bias) v.
 
     fp32 softmax accumulation regardless of input dtype (matches the
@@ -61,6 +62,10 @@ def xla_attention(q: jax.Array,
     lk = k.shape[1]
     if scale is None:
         scale = d**-0.5
+    if kv_lengths is not None:
+        # [B] valid-prefix lengths (right padding) → boolean K mask
+        pad = (jnp.arange(lk)[None, :] < kv_lengths[:, None])[:, None, None, :]
+        mask = pad if mask is None else jnp.logical_and(mask.astype(bool), pad)
     if decode_lengths is not None:
         q_pos = decode_lengths[:, None].astype(jnp.int32) - lq + jnp.arange(lq)[None, :]
         validity = jnp.arange(lk)[None, None, None, :] <= q_pos[:, None, :, None]
