@@ -46,6 +46,11 @@ class LlamaConfig:
     remat_every: int = 1
     attention_backend: str = "xla"
     attention_bias: bool = False  # Qwen2-style biased q/k/v projections
+    # Mistral-style sliding-window attention: each token attends the last
+    # ``sliding_window`` positions. Training/prefill only — the flash
+    # kernel skips out-of-window blocks (O(L*window)); decode attends the
+    # whole cache (window >= cache length in practice).
+    sliding_window: Optional[int] = None
     # >0: when called with ``labels=``, compute the loss via the chunked
     # fused LM head (models/common.py fused_lm_head_loss) — never
     # materializes [B, L, V] logits (32k-152k vocabs make that the
@@ -75,6 +80,11 @@ LLAMA_CONFIGS = {
                num_attention_heads=16, num_key_value_heads=16),
     "7b": dict(hidden_size=4096, intermediate_size=11008, num_hidden_layers=32,
                num_attention_heads=32, num_key_value_heads=32),
+    # Mistral-7B: llama blocks + GQA(8) + 14336 MLP + 4096 sliding window
+    "mistral-7b": dict(vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+                       num_hidden_layers=32, num_attention_heads=32,
+                       num_key_value_heads=8, max_position_embeddings=32768,
+                       sliding_window=4096),
     "13b": dict(hidden_size=5120, intermediate_size=13824, num_hidden_layers=40,
                 num_attention_heads=40, num_key_value_heads=40),
     # Mixtral-8x7B shape: llama blocks, top-2 of 8 SwiGLU experts per layer
@@ -192,8 +202,13 @@ class LlamaAttention(nn.Module):
             k = jnp.repeat(k, n_rep, axis=2)
             v = jnp.repeat(v, n_rep, axis=2)
 
+        if cfg.sliding_window is not None and cfg.attention_backend not in ("flash", "xla"):
+            # silently ignoring the window would change the model's math
+            raise ValueError(f"sliding_window is supported by the flash/xla attention "
+                             f"backends, not {cfg.attention_backend!r}")
         out = dot_product_attention(q, k, v, backend=cfg.attention_backend, causal=causal,
-                                    mask=mask, decode_lengths=decode_lengths)
+                                    mask=mask, decode_lengths=decode_lengths,
+                                    window=cfg.sliding_window if not decode else None)
         return nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1), use_bias=False,
                                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                                kernel_init=nn.with_logical_partitioning(_init(), ("heads", "kv", "embed")),

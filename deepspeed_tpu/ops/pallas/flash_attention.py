@@ -56,6 +56,26 @@ def _apply_kv_length_mask(s, j, blk_k, kv_len):
     return jnp.where(k_pos < kv_len, s, NEG_INF)
 
 
+def _apply_window_mask(s, qi, j, blk_q, blk_k, off, window):
+    """Sliding-window mask: query attends keys in (q_pos - window, q_pos]
+    (Mistral semantics; combine with the causal mask for the upper edge)."""
+    q_pos = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + off
+    k_pos = j * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(k_pos > q_pos - window, s, NEG_INF)
+
+
+def _first_k_block(qi, blk_q, blk_k, off, window):
+    """First K block intersecting q block ``qi``'s sliding window."""
+    return jnp.maximum((qi * blk_q + off - window + 1) // blk_k, 0)
+
+
+def _last_q_block(ki, blk_q, blk_k, off, window):
+    """Last Q block whose sliding window still reaches K block ``ki``
+    (single source for the dkv kernel's skip AND its fetch clamp — the two
+    must agree or skipped blocks would clamp to unfetched data)."""
+    return (ki * blk_k + blk_k - 1 + window - 1 - off) // blk_q
+
+
 def _n_live_blocks(kv_len, blk_k):
     """K blocks intersecting the valid prefix (>=1 so state initializes)."""
     return jnp.maximum((kv_len + blk_k - 1) // blk_k, 1)
@@ -85,7 +105,7 @@ def _warn_fallback(reason: str):
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
-def _fwd_kernel(*refs, scale, causal, blk_q, blk_k, nq, nk, masked):
+def _fwd_kernel(*refs, scale, causal, blk_q, blk_k, nq, nk, masked, window):
     # grid (b, h, qi, j): one K/V block per step; m/l/acc ride VMEM scratch.
     # With ``masked`` the first ref is the scalar-prefetched [B] kv-lengths.
     if masked:
@@ -106,8 +126,11 @@ def _fwd_kernel(*refs, scale, causal, blk_q, blk_k, nq, nk, masked):
     nk_eff = _last_k_block(qi, blk_q, blk_k, off, nk) if causal else nk
     if masked:
         nk_eff = jnp.minimum(nk_eff, _n_live_blocks(kv_len, blk_k))
+    live = j < nk_eff
+    if window is not None:
+        live = live & (j >= _first_k_block(qi, blk_q, blk_k, off, window))
 
-    @pl.when(j < nk_eff)
+    @pl.when(live)
     def _block():
         q = q_ref[...].astype(jnp.float32) * scale
         k = k_ref[...].astype(jnp.float32)
@@ -118,6 +141,8 @@ def _fwd_kernel(*refs, scale, causal, blk_q, blk_k, nq, nk, masked):
             s = _apply_causal_mask(s, qi, j, blk_q, blk_k, off)
         if masked:
             s = _apply_kv_length_mask(s, j, blk_k, kv_len)
+        if window is not None:
+            s = _apply_window_mask(s, qi, j, blk_q, blk_k, off, window)
         m = m_ref[:, 0]
         l = l_ref[:, 0]
         m_new = jnp.maximum(m, s.max(axis=-1))
@@ -173,13 +198,13 @@ def _length_call(kernel, grid, in_specs, out_specs, out_shape, scratch,
                           scratch_shapes=scratch, interpret=interpret)(*args)
 
 
-def _kv_index_map(causal, blk_q, blk_k, off, nk, masked=False):
-    """K/V block index for grid step (qi, j). Dead steps — causally dead
-    OR beyond the sequence's valid K prefix — CLAMP to the last live
-    block: the index map re-requests the already-resident block, Mosaic
-    elides the DMA, and the dead step moves no HBM bytes (the `pl.when`
-    in the kernel already skips its FLOPs)."""
-    if not causal and not masked:
+def _kv_index_map(causal, blk_q, blk_k, off, nk, masked=False, window=None):
+    """K/V block index for grid step (qi, j). Dead steps — causally dead,
+    beyond the sequence's valid K prefix, or outside the sliding window —
+    CLAMP to a live block: the index map re-requests the already-resident
+    block, Mosaic elides the DMA, and the dead step moves no HBM bytes
+    (the `pl.when` in the kernel already skips its FLOPs)."""
+    if not causal and not masked and window is None:
         return lambda bi, hi, qi, j: (bi, hi, j, 0)
 
     def index(bi, hi, qi, j, *lens):
@@ -188,22 +213,28 @@ def _kv_index_map(causal, blk_q, blk_k, off, nk, masked=False):
             last = jnp.minimum(last, (qi * blk_q + blk_q - 1 + off) // blk_k)
         if masked:
             last = jnp.minimum(last, _n_live_blocks(lens[0][bi], blk_k) - 1)
-        return (bi, hi, jnp.minimum(j, last), 0)
+        j_eff = jnp.minimum(j, last)
+        if window is not None:
+            j_eff = jnp.maximum(j_eff, jnp.minimum(
+                _first_k_block(qi, blk_q, blk_k, off, window), last))
+        return (bi, hi, j_eff, 0)
 
     return index
 
 
-def _flash_fwd(q, k, v, scale, causal, blk_q, blk_k, interpret, kv_lengths=None):
-    # q,k,v: [B,H,L,D]; kv_lengths: optional [B] valid-prefix lengths
+def _flash_fwd(q, k, v, scale, causal, blk_q, blk_k, interpret, kv_lengths=None,
+               window=None):
+    # q,k,v: [B,H,L,D]; kv_lengths: optional [B] valid-prefix lengths;
+    # window: optional sliding-window size (causal only)
     b, h, lq, d = q.shape
     lk = k.shape[2]
     nq, nk = lq // blk_q, lk // blk_k
     off = lk - lq
     masked = kv_lengths is not None
-    kv_idx = _kv_index_map(causal, blk_q, blk_k, off, nk, masked)
+    kv_idx = _kv_index_map(causal, blk_q, blk_k, off, nk, masked, window)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                blk_q=blk_q, blk_k=blk_k, nq=nq, nk=nk,
-                               masked=masked)
+                               masked=masked, window=window)
     qo_idx = _pad_idx(lambda bi, hi, qi, j: (bi, hi, qi, 0), masked)
     in_specs = [
         pl.BlockSpec((None, None, blk_q, d), qo_idx),
@@ -238,7 +269,7 @@ def _flash_fwd(q, k, v, scale, causal, blk_q, blk_k, interpret, kv_lengths=None)
 # ---------------------------------------------------------------------------
 # backward
 # ---------------------------------------------------------------------------
-def _bwd_dq_kernel(*refs, scale, causal, blk_q, blk_k, nq, nk, masked):
+def _bwd_dq_kernel(*refs, scale, causal, blk_q, blk_k, nq, nk, masked, window):
     if masked:
         lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref = refs
         kv_len = lens_ref[pl.program_id(0)]
@@ -255,8 +286,11 @@ def _bwd_dq_kernel(*refs, scale, causal, blk_q, blk_k, nq, nk, masked):
     nk_eff = _last_k_block(qi, blk_q, blk_k, off, nk) if causal else nk
     if masked:
         nk_eff = jnp.minimum(nk_eff, _n_live_blocks(kv_len, blk_k))
+    live = j < nk_eff
+    if window is not None:
+        live = live & (j >= _first_k_block(qi, blk_q, blk_k, off, window))
 
-    @pl.when(j < nk_eff)
+    @pl.when(live)
     def _block():
         q = q_ref[...].astype(jnp.float32) * scale
         do = do_ref[...].astype(jnp.float32)
@@ -269,6 +303,8 @@ def _bwd_dq_kernel(*refs, scale, causal, blk_q, blk_k, nq, nk, masked):
             s = _apply_causal_mask(s, qi, j, blk_q, blk_k, off)
         if masked:
             s = _apply_kv_length_mask(s, j, blk_k, kv_len)
+        if window is not None:
+            s = _apply_window_mask(s, qi, j, blk_q, blk_k, off, window)
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
@@ -280,7 +316,7 @@ def _bwd_dq_kernel(*refs, scale, causal, blk_q, blk_k, nq, nk, masked):
         dq_ref[...] = (acc_ref[...] * scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(*refs, scale, causal, blk_q, blk_k, nq, nk, masked):
+def _bwd_dkv_kernel(*refs, scale, causal, blk_q, blk_k, nq, nk, masked, window):
     if masked:
         (lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          dk_ref, dv_ref, dk_acc, dv_acc) = refs
@@ -308,6 +344,8 @@ def _bwd_dkv_kernel(*refs, scale, causal, blk_q, blk_k, nq, nk, masked):
         # K blocks entirely beyond the valid prefix contribute nothing —
         # skip all their FLOPs (their dk/dv stay at the zero-initialized acc)
         live = live & (ki * blk_k < kv_len)
+    if window is not None:
+        live = live & (i <= _last_q_block(ki, blk_q, blk_k, off, window))
 
     @pl.when(live)
     def _block():
@@ -322,6 +360,8 @@ def _bwd_dkv_kernel(*refs, scale, causal, blk_q, blk_k, nq, nk, masked):
             s = _apply_causal_mask(s, i, ki, blk_q, blk_k, off)
         if masked:
             s = _apply_kv_length_mask(s, ki, blk_k, kv_len)
+        if window is not None:
+            s = _apply_window_mask(s, i, ki, blk_q, blk_k, off, window)
         p = jnp.exp(s - lse[:, None])
         dv_acc[...] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                            preferred_element_type=jnp.float32)
@@ -336,7 +376,7 @@ def _bwd_dkv_kernel(*refs, scale, causal, blk_q, blk_k, nq, nk, masked):
         dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd(res, g, scale, causal, blk_q, blk_k, interpret):
+def _flash_bwd(res, g, scale, causal, blk_q, blk_k, interpret, window=None):
     q, k, v, o, lse, kv_lengths = res
     b, h, lq, d = q.shape
     lk = k.shape[2]
@@ -350,7 +390,7 @@ def _flash_bwd(res, g, scale, causal, blk_q, blk_k, interpret):
     delta4 = delta.reshape(b, h, 1, lq)
 
     off = lk - lq
-    kv_idx = _kv_index_map(causal, blk_q, blk_k, off, nk, masked)
+    kv_idx = _kv_index_map(causal, blk_q, blk_k, off, nk, masked, window)
     qo_idx = _pad_idx(lambda bi, hi, qi, j: (bi, hi, qi, 0), masked)
     stat_q_idx = _pad_idx(lambda bi, hi, qi, j: (bi, hi, 0, qi), masked)
 
@@ -360,7 +400,7 @@ def _flash_bwd(res, g, scale, causal, blk_q, blk_k, interpret):
 
     dq = _call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, blk_q=blk_q,
-                          blk_k=blk_k, nq=nq, nk=nk, masked=masked),
+                          blk_k=blk_k, nq=nq, nk=nk, masked=masked, window=window),
         (b, h, nq, nk),
         [
             pl.BlockSpec((None, None, blk_q, d), qo_idx),
@@ -383,6 +423,9 @@ def _flash_bwd(res, g, scale, causal, blk_q, blk_k, interpret):
         i_eff = i
         if causal:
             i_eff = jnp.maximum(i_eff, jnp.maximum((ki * blk_k - off) // blk_q, 0))
+        if window is not None:
+            i_eff = jnp.minimum(i_eff, jnp.maximum(
+                _last_q_block(ki, blk_q, blk_k, off, window), 0))
         if masked:
             i_eff = jnp.where(ki * blk_k < lens[bi], i_eff, 0)
         return i_eff
@@ -404,7 +447,7 @@ def _flash_bwd(res, g, scale, causal, blk_q, blk_k, interpret):
     kv_out_idx = _pad_idx(lambda bi, hi, ki, i: (bi, hi, ki, 0), masked)
     dk, dv = _call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, blk_q=blk_q,
-                          blk_k=blk_k, nq=nq, nk=nk, masked=masked),
+                          blk_k=blk_k, nq=nq, nk=nk, masked=masked, window=window),
         (b, h, nk, nq),
         [
             pl.BlockSpec((None, None, blk_q, d), q_idx),
@@ -431,22 +474,23 @@ def _flash_bwd(res, g, scale, causal, blk_q, blk_k, interpret):
 # ---------------------------------------------------------------------------
 # public op (BHLD), custom VJP
 # ---------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash_attention_bhld(q, k, v, kv_lengths, scale, causal, blk_q, blk_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_attention_bhld(q, k, v, kv_lengths, scale, causal, blk_q, blk_k, interpret,
+                          window):
     o, _ = _flash_fwd(q, k, v, scale, causal, blk_q, blk_k, interpret,
-                      kv_lengths=kv_lengths)
+                      kv_lengths=kv_lengths, window=window)
     return o
 
 
 def _flash_attention_bhld_fwd(q, k, v, kv_lengths, scale, causal, blk_q, blk_k,
-                              interpret):
+                              interpret, window):
     o, lse = _flash_fwd(q, k, v, scale, causal, blk_q, blk_k, interpret,
-                        kv_lengths=kv_lengths)
+                        kv_lengths=kv_lengths, window=window)
     return o, (q, k, v, o, lse, kv_lengths)
 
 
-def _flash_attention_bhld_bwd(scale, causal, blk_q, blk_k, interpret, res, g):
-    return _flash_bwd(res, g, scale, causal, blk_q, blk_k, interpret)
+def _flash_attention_bhld_bwd(scale, causal, blk_q, blk_k, interpret, window, res, g):
+    return _flash_bwd(res, g, scale, causal, blk_q, blk_k, interpret, window=window)
 
 
 _flash_attention_bhld.defvjp(_flash_attention_bhld_fwd, _flash_attention_bhld_bwd)
@@ -577,6 +621,7 @@ def flash_attention(q: jax.Array,
                     dropout_rng: Optional[jax.Array] = None,
                     decode_lengths: Optional[jax.Array] = None,
                     kv_lengths: Optional[jax.Array] = None,
+                    window: Optional[int] = None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
                     interpret: Optional[bool] = None) -> jax.Array:
@@ -587,12 +632,22 @@ def flash_attention(q: jax.Array,
     batches (the standard HF padding; BERT-style encoders) — handled
     natively by the kernel in forward AND backward, no XLA fallback. Only
     pass it for contiguous-prefix masks; arbitrary masks must go through
-    ``mask=`` (which falls back)."""
+    ``mask=`` (which falls back).
+
+    ``window``: sliding-window size (Mistral semantics, requires
+    ``causal=True``) — each query attends keys in ``(pos-window, pos]``;
+    out-of-window blocks skip their FLOPs and DMA in both passes, so the
+    cost is O(L*window) instead of O(L^2)."""
     b, lq, h, d = q.shape
     lk = k.shape[1]
     if decode_lengths is not None and kv_lengths is not None:
         raise ValueError("pass decode_lengths (cache decode) or kv_lengths "
                          "(padded prefill), not both")
+    if window is not None and not causal:
+        raise ValueError("window (sliding-window attention) requires causal=True")
+    if window is not None and decode_lengths is not None:
+        raise ValueError("window is a prefill/training feature; the decode path "
+                         "attends the whole cache")
     if decode_lengths is not None:
         # KV-cache decode: per-sequence length masking in the kernel
         if bias is None and mask is None and dropout_rate == 0.0 and lk % (block_k or _pick_block(lk)) == 0:
@@ -609,7 +664,7 @@ def flash_attention(q: jax.Array,
         from deepspeed_tpu.ops.transformer.attention import xla_attention
         return xla_attention(q, k, v, causal=causal, bias=bias, mask=mask, scale=scale,
                              dropout_rate=dropout_rate, dropout_rng=dropout_rng,
-                             kv_lengths=kv_lengths)
+                             kv_lengths=kv_lengths, window=window)
     if scale is None:
         scale = d**-0.5
     if interpret is None:
@@ -620,10 +675,11 @@ def flash_attention(q: jax.Array,
         _warn_fallback(f"sequence lengths ({lq}, {lk}) not tileable")
         from deepspeed_tpu.ops.transformer.attention import xla_attention
         return xla_attention(q, k, v, causal=causal, scale=scale,
-                             kv_lengths=kv_lengths)
+                             kv_lengths=kv_lengths, window=window)
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
     o = _flash_attention_bhld(qt, kt, vt, kv_lengths, float(scale), bool(causal),
-                              blk_q, blk_k, interpret)
+                              blk_q, blk_k, interpret,
+                              int(window) if window is not None else None)
     return o.transpose(0, 2, 1, 3)

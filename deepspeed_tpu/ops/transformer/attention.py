@@ -48,7 +48,8 @@ def xla_attention(q: jax.Array,
                   dropout_rate: float = 0.0,
                   dropout_rng: Optional[jax.Array] = None,
                   decode_lengths: Optional[jax.Array] = None,
-                  kv_lengths: Optional[jax.Array] = None) -> jax.Array:
+                  kv_lengths: Optional[jax.Array] = None,
+                  window: Optional[int] = None) -> jax.Array:
     """Plain XLA attention: softmax(q k^T / sqrt(d) + bias) v.
 
     fp32 softmax accumulation regardless of input dtype (matches the
@@ -66,6 +67,11 @@ def xla_attention(q: jax.Array,
         # [B] valid-prefix lengths (right padding) → boolean K mask
         pad = (jnp.arange(lk)[None, :] < kv_lengths[:, None])[:, None, None, :]
         mask = pad if mask is None else jnp.logical_and(mask.astype(bool), pad)
+    if window is not None:
+        # sliding window (Mistral semantics): k in (q_pos - window, q_pos]
+        q_pos = jnp.arange(lq)[:, None] + (lk - lq)
+        band = (jnp.arange(lk)[None, :] > q_pos - window)[None, None]
+        mask = band if mask is None else jnp.logical_and(mask.astype(bool), band)
     if decode_lengths is not None:
         q_pos = decode_lengths[:, None].astype(jnp.int32) - lq + jnp.arange(lq)[None, :]
         validity = jnp.arange(lk)[None, None, None, :] <= q_pos[:, None, :, None]
