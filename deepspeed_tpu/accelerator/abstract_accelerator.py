@@ -10,7 +10,7 @@ semantics.
 """
 
 import abc
-from typing import Any, Optional
+from typing import Optional
 
 
 class DeepSpeedAccelerator(abc.ABC):

@@ -18,7 +18,7 @@ doubles until compilation reports the step no longer fits
 import json
 import os
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np

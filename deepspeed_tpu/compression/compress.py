@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.compression import basic_layer as BL
-from deepspeed_tpu.compression.config import (ACTIVATION_QUANTIZATION, CHANNEL_PRUNING,
+from deepspeed_tpu.compression.config import (CHANNEL_PRUNING,
                                               DIFFERENT_GROUPS, HEAD_PRUNING, ROW_PRUNING,
                                               SHARED_PARAMETERS, SPARSE_PRUNING,
                                               WEIGHT_QUANTIZATION, get_compression_config)

@@ -3,7 +3,7 @@
 layout: per-technique ``shared_parameters`` + ``different_groups``, each
 group carrying method params and module-name patterns."""
 
-from typing import Any, Dict, List
+from typing import Any, Dict
 
 COMPRESSION_TRAINING = "compression_training"
 

@@ -14,7 +14,7 @@ onto a different mesh is how a TPU job resumes at a new world size.
 
 import math
 from functools import reduce
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List
 
 LATEST_ELASTICITY_VERSION = 0.2
 MINIMUM_DEEPSPEED_VERSION = "0.3.8"

@@ -1,7 +1,4 @@
 """``init_inference`` — parity with reference ``deepspeed/__init__.py:269``."""
-
-from typing import Optional
-
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.inference.engine import InferenceEngine
 from deepspeed_tpu.utils.logging import log_dist

@@ -10,7 +10,7 @@ supported architectures, and emits a ``PartitionSpec`` tree — XLA inserts
 the (all-gather / all-reduce) collectives a Megatron layout implies.
 """
 
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import numpy as np
 

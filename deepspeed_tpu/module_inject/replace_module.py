@@ -15,7 +15,7 @@ On TPU both steps are declarative:
 """
 
 import dataclasses
-from typing import Any, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -25,14 +25,14 @@ Key departures from the reference, all forced by XLA's compilation model
 """
 
 import math
-from typing import Any, Optional, Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 import flax.linen as nn
 
-from deepspeed_tpu.parallel.topology import (BATCH_AXES, DATA_AXIS, EXPERT_AXIS, FSDP_AXIS, TENSOR_AXIS,
+from deepspeed_tpu.parallel.topology import (BATCH_AXES, DATA_AXIS, EXPERT_AXIS, FSDP_AXIS,
                                              get_topology)
 
 TOPK_GATE_TIMER = 'topk_gate'

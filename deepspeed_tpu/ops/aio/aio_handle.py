@@ -1,9 +1,6 @@
 """Python wrapper over the C++ aio engine
 (reference ``aio_handle`` class, ``csrc/aio/py_lib/py_ds_aio.cpp:14-20``:
 ``aio_read``/``aio_write``/submit+wait semantics)."""
-
-from typing import Optional
-
 import numpy as np
 
 from deepspeed_tpu.ops.op_builder import AsyncIOBuilder

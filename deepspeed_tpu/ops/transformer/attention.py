@@ -13,8 +13,6 @@ inference ``softmax_context``) and of its block-sparse Triton attention
 
 All take ``[batch, length, heads, head_dim]`` (BLHD) tensors.
 """
-
-import functools
 from typing import Optional
 
 import jax

@@ -17,13 +17,13 @@ gather/scatter/prefetch that the reference drives by hand
 (``partitioned_param_coordinator.py``).
 """
 
-from typing import Any, Optional, Sequence, Tuple, Union
+from typing import Any, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from deepspeed_tpu.parallel.topology import (BATCH_AXES, DATA_AXIS, EXPERT_AXIS, FSDP_AXIS, PIPE_AXIS,
+from deepspeed_tpu.parallel.topology import (BATCH_AXES, EXPERT_AXIS, FSDP_AXIS, PIPE_AXIS,
                                              SEQUENCE_AXIS, TENSOR_AXIS, MeshTopology)
 
 # Default logical → mesh rules (first match wins). Models annotate their

@@ -33,8 +33,7 @@ sub-group sharding (``groups.py:428``, ``runtime/zero/mics.py``).
 import collections
 import dataclasses
 import itertools
-import os
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import numpy as np
 

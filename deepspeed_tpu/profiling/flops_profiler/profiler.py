@@ -13,10 +13,9 @@ profiled step.
 """
 
 import sys
-from typing import Any, Optional
+from typing import Optional
 
 import jax
-import jax.numpy as jnp
 
 
 def _num(x) -> str:

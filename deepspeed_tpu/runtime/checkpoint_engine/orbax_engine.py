@@ -11,7 +11,7 @@ sharding.
 
 import json
 import os
-from typing import Any, Optional
+from typing import Optional
 
 import jax
 import orbax.checkpoint as ocp

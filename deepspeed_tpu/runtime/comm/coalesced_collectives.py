@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from deepspeed_tpu.ops.quantizer.core import (dequantize, divisor_groups, pack_int4, quantize,
+from deepspeed_tpu.ops.quantizer.core import (divisor_groups, pack_int4, quantize,
                                               unpack_int4)
 from deepspeed_tpu.parallel.topology import DATA_AXIS, FSDP_AXIS
 

@@ -5,8 +5,6 @@ Parity with reference ``deepspeed/runtime/config_utils.py:16``
 deprecated fields that forward to their replacement, plus the scalar/dict
 param helpers used by the legacy-style readers.
 """
-
-from functools import partial
 from typing import Dict
 
 from pydantic import BaseModel, ConfigDict, model_validator

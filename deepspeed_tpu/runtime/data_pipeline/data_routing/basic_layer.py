@@ -8,9 +8,6 @@ scatter is a functional ``.at[].set`` — the analogs of the reference's
 ``GatherTokens``/``ScatterTokens`` custom autograd ops, with the VJP coming
 for free from JAX.
 """
-
-from typing import Any, Callable
-
 import jax
 import jax.numpy as jnp
 import flax.linen as nn

@@ -6,7 +6,7 @@ dicts of arrays / iterables; batches are dicts of numpy arrays with a
 *global* leading batch dim (the engine shards them over the DP mesh axes).
 """
 
-from typing import Any, Callable, Optional
+from typing import Callable, Optional
 
 import numpy as np
 

@@ -41,8 +41,7 @@ from deepspeed_tpu.runtime.fp16.loss_scaler import LossScaleState, create_loss_s
 from deepspeed_tpu.runtime.lr_schedules import get_lr_schedule
 from deepspeed_tpu.runtime.zero.planner import ZeroPlan, build_plan, resolve_topology_axes
 from deepspeed_tpu.utils.logging import log_dist, logger
-from deepspeed_tpu.utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER,
-                                       TRAIN_BATCH_TIMER, NoopTimer, SynchronizedWallClockTimer, ThroughputTimer)
+from deepspeed_tpu.utils.timer import (TRAIN_BATCH_TIMER, NoopTimer, SynchronizedWallClockTimer, ThroughputTimer)
 
 MEMORY_OPT_ALLREDUCE_SIZE = 500000000
 

@@ -19,13 +19,9 @@ adapters separate.
 """
 
 import time
-from typing import Any, Dict, Optional
-
-import numpy as np
+from typing import Dict
 
 import jax
-import jax.numpy as jnp
-
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine, _cast_floating
 from deepspeed_tpu.utils.logging import log_dist
 

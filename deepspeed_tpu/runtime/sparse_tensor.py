@@ -11,7 +11,7 @@ use (np-based, data-dependent size). ``to_dense`` is a segment-sum, which
 XLA lowers efficiently; duplicated indices accumulate, matching the
 reference's ``scatter_add_``. ``all_gather_rows`` is the comm pattern the
 reference's ``sparse_allreduce_bucket`` implements with NCCL gathers."""
-from typing import List, Optional, Sequence
+from typing import Sequence
 
 import numpy as np
 

@@ -11,14 +11,12 @@ states are being written back — the reference's pipelined
 swap-in/compute/swap-out overlap (``pipelined_optimizer_swapper.py``).
 """
 
-import os
 from pathlib import Path
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from deepspeed_tpu.ops.aio import AsyncIOHandle
-from deepspeed_tpu.utils.logging import logger
 
 
 class PipelinedOptimizerSwapper:

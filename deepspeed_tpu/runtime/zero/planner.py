@@ -21,7 +21,7 @@ implements via the ``PartitionedParameterCoordinator`` trace machinery.
 """
 
 import dataclasses
-from typing import Any, Callable, Optional
+from typing import Any
 
 import jax
 import numpy as np
@@ -31,7 +31,7 @@ import flax.linen as nn
 
 from deepspeed_tpu.parallel import topology as topo_mod
 from deepspeed_tpu.parallel.sharding import (DEFAULT_LOGICAL_RULES, add_fsdp_sharding, logical_to_mesh_spec)
-from deepspeed_tpu.parallel.topology import FSDP_AXIS, MeshTopology
+from deepspeed_tpu.parallel.topology import MeshTopology
 from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
 from deepspeed_tpu.utils.logging import logger
 

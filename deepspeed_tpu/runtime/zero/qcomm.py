@@ -25,7 +25,7 @@ megatron-style MP (``coalesced_collectives.py`` reduces over DP groups
 only). Pipe/expert meshes still fall back to the numerics-only QDQ path.
 """
 
-from typing import Any, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -5,8 +5,6 @@ TPU-native analog of the reference's ``deepspeed/utils/logging.py``
 taken from ``jax.process_index()`` (one process per host on TPU) instead of
 ``torch.distributed`` ranks.
 """
-
-import functools
 import logging
 import os
 import sys

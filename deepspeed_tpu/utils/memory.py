@@ -1,12 +1,9 @@
 """Memory observability + meta-device init (reference
 ``runtime/utils.py:see_memory_usage`` and ``utils/init_on_device.py``
 ``OnDevice``)."""
+from typing import Optional
 
-import contextlib
-import gc
-from typing import Any, Callable, Optional
-
-from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.logging import log_dist
 
 
 def see_memory_usage(message: str, force: bool = False) -> Optional[dict]:
