@@ -137,3 +137,30 @@ def test_lora_fuse_changes_served_weights():
     np.testing.assert_allclose(base, fused)
     engine.unfuse_lora_weight()
     assert not engine.is_lora_fused
+
+
+def test_hybrid_with_fused_head_model():
+    """A fused-head model (training computes loss in-model, serving needs
+    logits) must work in BOTH hybrid modes: train_batch uses the labels
+    path, generate() the logits path, and generation still leaves the
+    training trajectory untouched."""
+    cfg = get_gpt2_config("test", n_layer=2, fused_head_loss_chunk=64)
+    rng = np.random.default_rng(5)
+
+    def run(with_generate):
+        set_topology(None)
+        engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2LMHeadModel(cfg), config=_config(),
+                                                   topology=MeshTopology(data=2, fsdp=4))
+        b = _batch(cfg, np.random.default_rng(6))
+        losses = []
+        for step in range(3):
+            losses.append(float(engine.train_batch(b)))
+            if with_generate and step == 0:
+                prompts = np.asarray(rng.integers(0, cfg.vocab_size, (2, 8)), np.int32)
+                out = engine.generate(prompts, max_new_tokens=4)
+                assert out.shape == (2, 12)
+        return losses
+
+    control = run(with_generate=False)
+    mixed = run(with_generate=True)
+    assert control == mixed, f"generation perturbed fused-head training: {control} vs {mixed}"
