@@ -12,3 +12,5 @@ from deepspeed_tpu.models.t5 import (T5Config, T5ForConditionalGeneration, T5_CO
                                      get_t5_config)
 from deepspeed_tpu.models.falcon import (FalconConfig, FalconForCausalLM, FALCON_CONFIGS,
                                           get_falcon_config)
+from deepspeed_tpu.models.gptj import (GPTJConfig, GPTJForCausalLM, GPTJ_CONFIGS,
+                                       get_gptj_config)

@@ -158,7 +158,8 @@ def normalize_padding_mask(attention_mask, ndim_target: int = 4):
 
 @functools.lru_cache(maxsize=None)
 def _fused_lm_head_loss_fn(vocab: int, x_dtype_name: str, w_dtype_name: str,
-                           chunk: int, ignore_index: int, vocab_major: bool):
+                           chunk: int, ignore_index: int, vocab_major: bool,
+                           has_bias: bool = False):
     """Chunked LM-head + cross-entropy with a custom VJP.
 
     Computes mean next-token NLL from HIDDEN STATES without ever
@@ -194,16 +195,17 @@ def _fused_lm_head_loss_fn(vocab: int, x_dtype_name: str, w_dtype_name: str,
     # Dense head, LLaMA) — contraction dims differ, no transpose copies
     w_contract = (1,) if vocab_major else (0,)
 
-    def _chunk_logits(x_c, w):
-        return jax.lax.dot_general(x_c, w, (((1,), w_contract), ((), ())),
-                                   preferred_element_type=x_dtype)  # [C, V]
+    def _chunk_logits(x_c, w, bias):
+        out = jax.lax.dot_general(x_c, w, (((1,), w_contract), ((), ())),
+                                  preferred_element_type=x_dtype)  # [C, V]
+        return out + bias if has_bias else out
 
     @jax.custom_vjp
-    def f(x, w, labels):
-        out, _ = fwd(x, w, labels)
+    def f(x, w, bias, labels):
+        out, _ = fwd(x, w, bias, labels)
         return out
 
-    def fwd(x, w, labels):
+    def fwd(x, w, bias, labels):
         b, t, e = x.shape
         x_f, lab_f = _pad_tokens(x.reshape(-1, e), labels.reshape(-1))
         valid_all = lab_f != ignore_index
@@ -211,7 +213,7 @@ def _fused_lm_head_loss_fn(vocab: int, x_dtype_name: str, w_dtype_name: str,
 
         def body(acc, xs):
             x_c, lab_c = xs
-            logits = _chunk_logits(x_c, w)
+            logits = _chunk_logits(x_c, w, bias)
             valid = lab_c != ignore_index
             safe = jnp.where(valid, lab_c, 0)
             logz = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
@@ -221,22 +223,24 @@ def _fused_lm_head_loss_fn(vocab: int, x_dtype_name: str, w_dtype_name: str,
 
         total, _ = jax.lax.scan(body, jnp.zeros([], jnp.float32),
                                 (_chunks(x_f, chunk), _chunks(lab_f, chunk)))
-        return total / denom, (x, w, labels, denom)
+        return total / denom, (x, w, bias, labels, denom)
 
     def bwd(res, g):
-        x, w, labels, denom = res
+        x, w, bias, labels, denom = res
         b, t, e = x.shape
         x_f, lab_f = _pad_tokens(x.reshape(-1, e), labels.reshape(-1))
         scale = g / denom
 
-        def body(dw_acc, xs):
+        def body(carry, xs):
+            dw_acc, db_acc = carry
             x_c, lab_c = xs
-            logits = _chunk_logits(x_c, w)
+            logits = _chunk_logits(x_c, w, bias)
             valid = lab_c != ignore_index
             safe = jnp.where(valid, lab_c, 0)
             p = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-            coeff = p - jax.nn.one_hot(safe, vocab, dtype=jnp.float32)
-            coeff = (coeff * (valid * scale)[:, None]).astype(x_dtype)  # [C, V]
+            coeff32 = p - jax.nn.one_hot(safe, vocab, dtype=jnp.float32)
+            coeff32 = coeff32 * (valid * scale)[:, None]  # [C, V]
+            coeff = coeff32.astype(x_dtype)
             dx_c = jax.lax.dot_general(
                 coeff, w, (((1,), (0,) if vocab_major else (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
@@ -246,47 +250,53 @@ def _fused_lm_head_loss_fn(vocab: int, x_dtype_name: str, w_dtype_name: str,
             else:
                 dw_c = jax.lax.dot_general(x_c, coeff, (((0,), (0,)), ((), ())),
                                            preferred_element_type=jnp.float32)
-            return dw_acc + dw_c, dx_c.astype(x.dtype)
+            db_acc = db_acc + coeff32.sum(0) if has_bias else db_acc
+            return (dw_acc + dw_c, db_acc), dx_c.astype(x.dtype)
 
         dw_shape = (vocab, e) if vocab_major else (e, vocab)
-        dw, dx_chunks = jax.lax.scan(
-            body, jnp.zeros(dw_shape, jnp.float32),
+        db0 = jnp.zeros((vocab,), jnp.float32) if has_bias else jnp.zeros([], jnp.float32)
+        (dw, db), dx_chunks = jax.lax.scan(
+            body, (jnp.zeros(dw_shape, jnp.float32), db0),
             (_chunks(x_f, chunk), _chunks(lab_f, chunk)))
         dx = dx_chunks.reshape(-1, e)[:b * t].reshape(b, t, e)
-        return dx, dw.astype(jnp.dtype(w_dtype_name)), None
+        db_out = db.astype(jnp.dtype(w_dtype_name)) if has_bias else None
+        return dx, dw.astype(jnp.dtype(w_dtype_name)), db_out, None
 
     f.defvjp(fwd, bwd)
     return f
 
 
-def fused_lm_head_loss(x, embedding, labels, *, chunk: int = 1024,
+def fused_lm_head_loss(x, embedding, labels, *, bias=None, chunk: int = 1024,
                        ignore_index: int = -100, vocab_major: bool = True):
     """Mean next-token cross-entropy straight from hidden states.
 
     ``x``: [B, T, E] hidden states (already shifted — token t predicts
     ``labels[:, t]``); ``embedding``: the LM head at the compute dtype —
     [V, E] tied embedding (``vocab_major=True``, GPT-2) or [E, V] untied
-    Dense kernel (``vocab_major=False``, LLaMA); ``labels``: [B, T] int
-    with ``ignore_index`` masking. See ``_fused_lm_head_loss_fn`` for the
+    Dense kernel (``vocab_major=False``, LLaMA); ``bias``: optional [V]
+    head bias at the compute dtype (GPT-J), added per chunk with its grad
+    accumulated in the backward scan; ``labels``: [B, T] int with
+    ``ignore_index`` masking. See ``_fused_lm_head_loss_fn`` for the
     memory story.
     """
     vocab = int(embedding.shape[0] if vocab_major else embedding.shape[1])
     fn = _fused_lm_head_loss_fn(vocab,
                                 jnp.dtype(x.dtype).name,
                                 jnp.dtype(embedding.dtype).name,
-                                int(chunk), int(ignore_index), bool(vocab_major))
-    return fn(x, embedding, labels)
+                                int(chunk), int(ignore_index), bool(vocab_major),
+                                bias is not None)
+    return fn(x, embedding, bias, labels)
 
 
 def fused_head_loss_output(x, weight, labels, aux_total, deterministic, cfg, *,
-                           vocab_major: bool):
+                           vocab_major: bool, bias=None):
     """Shared fused-head dispatch for causal-LM model families: applies the
     next-token shift, runs :func:`fused_lm_head_loss`, and adds the MoE aux
     loss in training only (eval reports pure CE, matching the engine's
     unfused eval branch). Keeping the shift convention and aux policy here
     means every family adopting ``fused_head_loss_chunk`` stays in
     lockstep."""
-    loss = fused_lm_head_loss(x[:, :-1], weight, labels[:, 1:],
+    loss = fused_lm_head_loss(x[:, :-1], weight, labels[:, 1:], bias=bias,
                               chunk=cfg.fused_head_loss_chunk,
                               vocab_major=vocab_major)
     if getattr(cfg, "moe_num_experts", 0) > 0 and not deterministic:
@@ -298,15 +308,24 @@ class UntiedHeadKernel(nn.Module):
     """Declares an untied LM-head kernel at the same param path as
     ``nn.Dense(name=<name>)`` ([E, V], same init/partitioning) so a fused-
     loss branch shares weights with the logits branch (used by LLaMA's
-    ``lm_head`` and GPT-NeoX's ``embed_out``)."""
+    ``lm_head`` and GPT-NeoX's ``embed_out``). With ``use_bias`` it also
+    declares the Dense-compatible bias and returns ``(kernel, bias)``
+    (GPT-J's biased head)."""
 
     in_features: int
     out_features: int
     param_dtype: Any = jnp.float32
+    use_bias: bool = False
 
     @nn.compact
     def __call__(self):
-        kernel = self.param("kernel",
-                            nn.with_logical_partitioning(dense_init(), ("embed", "vocab")),
-                            (self.in_features, self.out_features), self.param_dtype)
-        return kernel.value if isinstance(kernel, nn.meta.AxisMetadata) else kernel
+        unbox = lambda p: p.value if isinstance(p, nn.meta.AxisMetadata) else p
+        kernel = unbox(self.param(
+            "kernel", nn.with_logical_partitioning(dense_init(), ("embed", "vocab")),
+            (self.in_features, self.out_features), self.param_dtype))
+        if not self.use_bias:
+            return kernel
+        bias = unbox(self.param(
+            "bias", nn.with_logical_partitioning(nn.initializers.zeros, ("vocab",)),
+            (self.out_features,), self.param_dtype))
+        return kernel, bias

@@ -1,0 +1,189 @@
+"""GPT-J family (6B) — interleaved partial rotary, single-LayerNorm parallel
+residual (the reference serves GPT-J through kernel injection,
+``module_inject/containers/gptj.py``; its rotary kernel is
+``csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu``).
+
+Same TPU conventions as the rest of the zoo (logical axis names → ZeRO
+planner, pluggable attention backend, flax ``cache`` collection). GPT-J
+quirks kept for checkpoint parity: rotary on only the first ``rotary_dim``
+of each head dim in the INTERLEAVED (rotate-every-two) convention — not the
+half-split convention NeoX/LLaMA use — one shared ``ln_1`` feeding both
+attention and MLP (``x + attn(ln(x)) + mlp(ln(x))``), bias-free q/k/v/out
+projections, and an untied ``lm_head`` WITH bias.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.common import config_from, dense_init as _init, maybe_remat
+from deepspeed_tpu.ops.transformer.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTJConfig:
+    vocab_size: int = 50400
+    hidden_size: int = 4096
+    intermediate_size: int = 16384
+    num_hidden_layers: int = 28
+    num_attention_heads: int = 16
+    max_position_embeddings: int = 2048
+    rotary_dim: int = 64
+    rotary_emb_base: float = 10000.0
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    remat_every: int = 1
+    remat_policy: Optional[str] = None
+    # >0: loss via the chunked fused LM head when called with labels=
+    # (models/common.py fused_lm_head_loss, bias= path) — no [B, L, V]
+    # logits buffer
+    fused_head_loss_chunk: int = 0
+    attention_backend: str = "xla"
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+GPTJ_CONFIGS = {
+    "test": dict(vocab_size=256, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                 num_attention_heads=4, max_position_embeddings=128, rotary_dim=8),
+    "6b": dict(vocab_size=50400, hidden_size=4096, intermediate_size=16384,
+               num_hidden_layers=28, num_attention_heads=16, rotary_dim=64),
+}
+
+
+def get_gptj_config(name: str, **overrides) -> GPTJConfig:
+    return config_from(GPTJ_CONFIGS, GPTJConfig, name, **overrides)
+
+
+def rotary_embedding_interleaved(x, positions, theta: float = 10000.0):
+    """RoPE in GPT-J's interleaved (rotate-every-two) convention: pairs are
+    adjacent lanes ``(2i, 2i+1)``, not split halves. ``x`` [B, L, H, D] at
+    ``positions`` [B, L]."""
+    d = x.shape[-1]
+    inv_freq = 1.0 / (theta**(jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, L, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]  # [B, L, 1, D/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., 0::2], xf[..., 1::2]
+    out = jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+def _partial_rotary(x, positions, rotary_dim: int, base: float):
+    """Interleaved RoPE on the first ``rotary_dim`` of the head dim, rest
+    passes through (GPT-J convention)."""
+    rot, rest = x[..., :rotary_dim], x[..., rotary_dim:]
+    rot = rotary_embedding_interleaved(rot, positions, base)
+    return jnp.concatenate([rot, rest], axis=-1)
+
+
+class GPTJAttention(nn.Module):
+    config: GPTJConfig
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        b, l, _ = x.shape
+
+        def proj(name):
+            return nn.DenseGeneral(features=(cfg.num_attention_heads, cfg.head_dim), axis=-1,
+                                   use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                                   kernel_init=nn.with_logical_partitioning(
+                                       _init(), ("embed", "heads", "kv")),
+                                   name=name)(x)
+
+        q, k, v = proj("q_proj"), proj("k_proj"), proj("v_proj")  # [B, L, H, D]
+        causal, decode_lengths = True, None
+        if self.decode:
+            cache_index = self.variable("cache", "cache_index", lambda: jnp.zeros([], jnp.int32))
+            idx = cache_index.value
+            positions = idx + jnp.broadcast_to(jnp.arange(l)[None, :], (b, l))
+            q = _partial_rotary(q, positions, cfg.rotary_dim, cfg.rotary_emb_base)
+            k = _partial_rotary(k, positions, cfg.rotary_dim, cfg.rotary_emb_base)
+            shape = (b, cfg.max_position_embeddings, cfg.num_attention_heads, cfg.head_dim)
+            cached_k = self.variable("cache", "cached_key", jnp.zeros, shape, k.dtype)
+            cached_v = self.variable("cache", "cached_value", jnp.zeros, shape, v.dtype)
+            cached_k.value = jax.lax.dynamic_update_slice(cached_k.value, k, (0, idx, 0, 0))
+            cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, v, (0, idx, 0, 0))
+            cache_index.value = idx + l
+            k, v = cached_k.value, cached_v.value
+            decode_lengths = jnp.broadcast_to(idx + l, (b,))
+            causal = False
+        else:
+            positions = jnp.broadcast_to(jnp.arange(l)[None, :], (b, l))
+            q = _partial_rotary(q, positions, cfg.rotary_dim, cfg.rotary_emb_base)
+            k = _partial_rotary(k, positions, cfg.rotary_dim, cfg.rotary_emb_base)
+        out = dot_product_attention(q, k, v, backend=cfg.attention_backend,
+                                    causal=causal, decode_lengths=decode_lengths)
+        return nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1), use_bias=False,
+                               dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                               kernel_init=nn.with_logical_partitioning(_init(), ("heads", "kv", "embed")),
+                               name="out_proj")(out)
+
+
+class GPTJBlock(nn.Module):
+    config: GPTJConfig
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        # ONE LayerNorm feeds both branches (vs NeoX's two):
+        # x + attn(ln_1(x)) + mlp(ln_1(x))
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="ln_1")(x)
+        attn_out = GPTJAttention(cfg, self.decode, name="attn")(h)
+        m = nn.Dense(features=cfg.intermediate_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     kernel_init=nn.with_logical_partitioning(_init(), ("embed", "mlp")),
+                     bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("mlp",)),
+                     name="fc_in")(h)
+        m = jax.nn.gelu(m, approximate=True)  # HF GPT-J uses gelu_new (tanh)
+        m = nn.Dense(features=cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     kernel_init=nn.with_logical_partitioning(_init(), ("mlp", "embed")),
+                     bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
+                     name="fc_out")(m)
+        return x + attn_out + m
+
+
+class GPTJForCausalLM(nn.Module):
+    """GPT-J with UNTIED, BIASED ``lm_head``. Returns logits [B, L, V] (or
+    the scalar loss when ``labels`` ride the fused head)."""
+
+    config: GPTJConfig
+
+    @nn.compact
+    def __call__(self, input_ids, *, deterministic: bool = True, decode: bool = False,
+                 labels=None):
+        cfg = self.config
+        wte = self.param("wte", nn.with_logical_partitioning(_init(), ("vocab", "embed")),
+                         (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+        wte = wte.value if isinstance(wte, nn.meta.AxisMetadata) else wte
+        x = jnp.take(wte, input_ids, axis=0).astype(cfg.dtype)
+        for i in range(cfg.num_hidden_layers):
+            block_cls = maybe_remat(GPTJBlock, cfg, i, enabled=cfg.remat and not decode)
+            x = block_cls(cfg, decode, name=f"h_{i}")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="ln_f")(x)
+        if labels is not None and cfg.fused_head_loss_chunk > 0:
+            from deepspeed_tpu.models.common import (UntiedHeadKernel,
+                                                     fused_head_loss_output)
+            kernel, bias = UntiedHeadKernel(cfg.hidden_size, cfg.vocab_size,
+                                            cfg.param_dtype, use_bias=True,
+                                            name="lm_head")()
+            return fused_head_loss_output(x, kernel.astype(cfg.dtype), labels, 0.0,
+                                          deterministic, cfg, vocab_major=False,
+                                          bias=bias.astype(cfg.dtype))
+        return nn.Dense(features=cfg.vocab_size, use_bias=True, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype,
+                        kernel_init=nn.with_logical_partitioning(_init(), ("embed", "vocab")),
+                        bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("vocab",)),
+                        name="lm_head")(x)

@@ -246,6 +246,43 @@ def load_hf_gpt_neox(model_or_sd, cfg) -> dict:
     return params
 
 
+def load_hf_gptj(model_or_sd, cfg) -> dict:
+    """HF ``GPTJForCausalLM`` → ``models.gptj.GPTJForCausalLM`` params
+    (reference ``module_inject/containers/gptj.py``).
+
+    q/k/v/out are separate bias-free Linears: torch [E, E] transposes to
+    [E, E] and reshapes to [E, H, D] (out: [E(H·D), E] → [H, D, E]); HF
+    GPT-J rotary is the interleaved (rotate-every-two) convention our
+    ``rotary_embedding_interleaved`` implements; ``lm_head`` keeps its bias.
+    """
+    sd = _sd(model_or_sd)
+    pre = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    E, H, D = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
+
+    lin = lambda name: _lin(sd, name)
+    ln = lambda name: _ln(sd, name)
+
+    params = {
+        "wte": jnp.asarray(sd[f"{pre}wte.weight"]),
+        "ln_f": ln(f"{pre}ln_f"),
+        "lm_head": lin("lm_head"),
+    }
+    for i in range(cfg.num_hidden_layers):
+        p = f"{pre}h.{i}."
+        params[f"h_{i}"] = {
+            "ln_1": ln(p + "ln_1"),
+            "attn": {
+                "q_proj": {"kernel": jnp.asarray(sd[p + "attn.q_proj.weight"].T.reshape(E, H, D))},
+                "k_proj": {"kernel": jnp.asarray(sd[p + "attn.k_proj.weight"].T.reshape(E, H, D))},
+                "v_proj": {"kernel": jnp.asarray(sd[p + "attn.v_proj.weight"].T.reshape(E, H, D))},
+                "out_proj": {"kernel": jnp.asarray(sd[p + "attn.out_proj.weight"].T.reshape(H, D, E))},
+            },
+            "fc_in": lin(p + "mlp.fc_in"),
+            "fc_out": lin(p + "mlp.fc_out"),
+        }
+    return params
+
+
 def load_hf_bloom(model_or_sd, cfg) -> dict:
     """HF ``BloomForCausalLM`` → ``models.bloom.BloomForCausalLM`` params
     (reference ``module_inject/containers/bloom.py``). The fused qkv is
@@ -400,7 +437,8 @@ def load_hf_checkpoint(hf_model, arch: str, cfg) -> dict:
     """Dispatch by architecture (reference per-arch policy containers)."""
     loaders = {"gpt2": load_hf_gpt2, "llama": load_hf_llama, "opt": load_hf_opt,
                "gpt_neox": load_hf_gpt_neox, "gptneox": load_hf_gpt_neox,
-               "bloom": load_hf_bloom, "t5": load_hf_t5, "falcon": load_hf_falcon}
+               "bloom": load_hf_bloom, "t5": load_hf_t5, "falcon": load_hf_falcon,
+               "gptj": load_hf_gptj, "gpt-j": load_hf_gptj}
     if arch not in loaders:
         raise ValueError(f"no HF converter for architecture {arch!r}; available: {sorted(loaders)}")
     return loaders[arch](hf_model, cfg)

@@ -64,6 +64,35 @@ def test_fused_head_loss_vocab_minor_layout():
                                np.asarray(gw_minor.T, np.float32), atol=5e-4)
 
 
+def test_fused_head_loss_bias_parity():
+    """bias= path (GPT-J) matches the materialized logits+bias reference,
+    values and (dx, dw, db) grads."""
+    rng = np.random.default_rng(5)
+    b, t, e, v, chunk = 2, 64, 64, 512, 32
+    x = jnp.asarray(rng.normal(size=(b, t, e)), jnp.bfloat16)
+    w = jnp.asarray(rng.normal(size=(e, v)) * 0.05, jnp.bfloat16)
+    bias = jnp.asarray(rng.normal(size=(v,)) * 0.5, jnp.bfloat16)
+    labels = jnp.asarray(rng.integers(0, v, (b, t)), jnp.int32)
+    labels = labels.at[0, :5].set(-100)
+
+    def ref(x, w, bias):
+        logits = jnp.einsum("bte,ev->btv", x, w, preferred_element_type=x.dtype) + bias
+        return cross_entropy_loss(logits, labels)
+
+    fused = lambda x, w, bias: fused_lm_head_loss(x, w, labels, bias=bias,
+                                                  chunk=chunk, vocab_major=False)
+    np.testing.assert_allclose(np.asarray(fused(x, w, bias)),
+                               np.asarray(ref(x, w, bias)), rtol=2e-5)
+    g_f = jax.grad(fused, argnums=(0, 1, 2))(x, w, bias)
+    g_r = jax.grad(ref, argnums=(0, 1, 2))(x, w, bias)
+    assert float(jnp.abs(g_f[2]).max()) > 0
+    # db tol: the reference sums bf16-rounded cotangents where the fused
+    # path accumulates unrounded fp32 — pure rounding-point difference
+    for a, b_, tol in zip(g_f, g_r, (2e-4, 5e-4, 2e-3)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b_, np.float32), atol=tol)
+
+
 def test_llama_fused_head_matches_logits_path():
     """LlamaForCausalLM(labels=...) with the fused head reproduces the
     logits+cross_entropy loss, sharing the same lm_head/kernel param."""
@@ -109,17 +138,20 @@ def test_engine_trains_with_fused_head(tmp_path):
     np.testing.assert_allclose(losses["fused"], losses["plain"], rtol=2e-2)
 
 
-@pytest.mark.parametrize("family", ["opt", "gpt_neox", "bloom", "falcon"])
+@pytest.mark.parametrize("family", ["opt", "gpt_neox", "bloom", "falcon", "gptj"])
 def test_zoo_fused_head_matches_logits_path(family):
     """Every causal-LM family's fused-head branch reproduces its
     logits+cross_entropy loss on shared params (tied [V,E] heads for
-    OPT/BLOOM/Falcon, untied [E,V] embed_out for GPT-NeoX)."""
+    OPT/BLOOM/Falcon, untied [E,V] embed_out for GPT-NeoX, untied biased
+    lm_head for GPT-J)."""
     if family == "opt":
         from deepspeed_tpu.models.opt import OPTForCausalLM as M, get_opt_config as C
     elif family == "gpt_neox":
         from deepspeed_tpu.models.gpt_neox import GPTNeoXForCausalLM as M, get_gpt_neox_config as C
     elif family == "bloom":
         from deepspeed_tpu.models.bloom import BloomForCausalLM as M, get_bloom_config as C
+    elif family == "gptj":
+        from deepspeed_tpu.models.gptj import GPTJForCausalLM as M, get_gptj_config as C
     else:
         from deepspeed_tpu.models.falcon import FalconForCausalLM as M, get_falcon_config as C
 
@@ -128,6 +160,13 @@ def test_zoo_fused_head_matches_logits_path(family):
     cfg_fused = C("test", dtype=jnp.bfloat16, fused_head_loss_chunk=32)
     ids = jnp.asarray(rng.integers(0, cfg_plain.vocab_size, (2, 64)), jnp.int32)
     params = M(cfg_plain).init(jax.random.PRNGKey(0), ids)["params"]
+    if family == "gptj":
+        # init zeroes the head bias; randomize it so the fused bias path
+        # is actually exercised
+        params["lm_head"]["bias"] = jnp.asarray(
+            rng.normal(size=(cfg_plain.vocab_size,)) * 0.1, jnp.float32)
+        grads = jax.grad(lambda p: M(cfg_fused).apply({"params": p}, ids, labels=ids))(params)
+        assert float(jnp.abs(grads["lm_head"]["bias"]).max()) > 0
     loss_f = M(cfg_fused).apply({"params": params}, ids, labels=ids)
     logits = M(cfg_plain).apply({"params": params}, ids)
     loss_p = cross_entropy_loss(logits[:, :-1], ids[:, 1:])
