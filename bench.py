@@ -46,7 +46,8 @@ def run_child():
     # whose time budget is mostly compilation) skip straight to execution
     try:
         jax.config.update("jax_compilation_cache_dir",
-                          os.environ.get("JAX_CACHE_DIR", "/tmp/jax_comp_cache"))
+                          os.environ.get("JAX_CACHE_DIR", os.path.join(
+                              os.path.dirname(os.path.abspath(__file__)), ".jax_cache")))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass  # older jax without the knobs — compile cold
@@ -198,9 +199,14 @@ def _last_json_line(text):
 
 
 def main():
+    # run budget sized for a COLD compile cache: the fused-10-step 350M
+    # program can take >8 min to compile on the tunnel, and killing the
+    # claim-holding child mid-compile wedges the tunnel for hours (wedge #4,
+    # PERF.md). The repo-local .jax_cache (survives reboots, unlike /tmp)
+    # makes warm runs finish in ~2-3 min.
     probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
-    run_timeout = int(os.environ.get("BENCH_RUN_TIMEOUT", "480"))
-    cpu_timeout = int(os.environ.get("BENCH_CPU_TIMEOUT", "240"))
+    run_timeout = int(os.environ.get("BENCH_RUN_TIMEOUT", "2400"))
+    cpu_timeout = int(os.environ.get("BENCH_CPU_TIMEOUT", "600"))
     errors = []
 
     # 1) accelerator probe, two attempts

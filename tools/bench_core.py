@@ -19,7 +19,9 @@ BASELINE_TFLOPS = 64.0  # reference headline, BASELINE.md
 
 def enable_compile_cache():
     try:
-        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_comp_cache")
+        jax.config.update("jax_compilation_cache_dir", os.environ.get(
+            "JAX_CACHE_DIR", os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), ".jax_cache")))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass

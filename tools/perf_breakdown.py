@@ -4,7 +4,8 @@ dependencies (output feeds next input) so the axon tunnel's identical-
 dispatch dedupe can't fake the numbers. Attribution without
 jax.profiler.trace (a killed trace session wedges the tunnel).
 
-Run: timeout 2000 python tools/perf_breakdown.py
+Run: python tools/perf_breakdown.py   (background it; poll stdout —
+NEVER wrap in `timeout`: a killed TPU process wedges the tunnel claim)
 """
 import json
 import os
@@ -12,16 +13,15 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
-try:
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_comp_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-except Exception:
-    pass
+from bench_core import enable_compile_cache
+
+enable_compile_cache()
 
 import deepspeed_tpu
 from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
