@@ -28,6 +28,11 @@ class BertConfig:
     max_position_embeddings: int = 512
     type_vocab_size: int = 2
     layer_norm_eps: float = 1e-12
+    # "gelu_tanh"/"gelu_new" (default: the reference training kernel's
+    # approximation, ``csrc/transformer/gelu_kernels.cu``), "gelu" (exact
+    # erf — what HF BERT/DistilBERT checkpoints use; the converters raise
+    # on a mismatch), or "relu"
+    hidden_act: str = "gelu_tanh"
     hidden_dropout_prob: float = 0.0
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
@@ -61,11 +66,29 @@ BERT_CONFIGS = {
                  intermediate_size=3072),
     "large": dict(hidden_size=1024, num_hidden_layers=24, num_attention_heads=16,
                   intermediate_size=4096),
+    # DistilBERT serves through the BERT family (see load_hf_distilbert):
+    # 6 layers, no token types, exact gelu
+    "distilbert": dict(vocab_size=30522, hidden_size=768, num_hidden_layers=6,
+                       num_attention_heads=12, intermediate_size=3072,
+                       type_vocab_size=1, hidden_act="gelu"),
 }
 
 
 def get_bert_config(name: str, **overrides) -> BertConfig:
     return config_from(BERT_CONFIGS, BertConfig, name, **overrides)
+
+
+def _activation(cfg: BertConfig, h):
+    """Dispatch ``cfg.hidden_act`` — unknown names raise instead of
+    silently falling back to an approximation."""
+    if cfg.hidden_act == "gelu":
+        return jax.nn.gelu(h, approximate=False)
+    if cfg.hidden_act in ("gelu_tanh", "gelu_new"):
+        return jax.nn.gelu(h, approximate=True)
+    if cfg.hidden_act == "relu":
+        return jax.nn.relu(h)
+    raise ValueError(f"unknown hidden_act {cfg.hidden_act!r}; "
+                     f"choose from ['gelu', 'gelu_tanh', 'gelu_new', 'relu']")
 
 
 class BertLayerNorm(nn.Module):
@@ -142,7 +165,7 @@ class BertLayer(nn.Module):
                      kernel_init=nn.with_logical_partitioning(_init(), ("embed", "mlp")),
                      bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("mlp",)),
                      name="intermediate")(x)
-        h = jax.nn.gelu(h, approximate=True)
+        h = _activation(cfg, h)
         h = nn.Dense(features=cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                      kernel_init=nn.with_logical_partitioning(_init(), ("mlp", "embed")),
                      bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
@@ -212,7 +235,7 @@ class BertForMaskedLM(nn.Module):
                      kernel_init=nn.with_logical_partitioning(_init(), ("embed", "embed2")),
                      bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
                      name="transform")(x)
-        x = jax.nn.gelu(x, approximate=True)
+        x = _activation(cfg, x)
         x = BertLayerNorm(cfg, name="transform_ln")(x)
         bias = self.param("decoder_bias", nn.with_logical_partitioning(nn.initializers.zeros, ("vocab",)),
                           (cfg.vocab_size,), cfg.param_dtype)

@@ -37,6 +37,26 @@ def _ln(sd, name):
             "bias": jnp.asarray(sd[name + ".bias"])}
 
 
+def _check_activation(model_or_sd, cfg, hf_field: str):
+    """Raise if the HF model's activation disagrees with the target config
+    (weights trained with erf-gelu silently drift under tanh-gelu). Only
+    checkable when a model (not a bare state dict) is passed."""
+    hf_cfg = getattr(model_or_sd, "config", None)
+    if hf_cfg is None:
+        return
+    hf_act = getattr(hf_cfg, hf_field, None)
+    if hf_act is None:
+        return
+    ours = {"gelu": "gelu", "gelu_new": "gelu_new", "gelu_tanh": "gelu_tanh",
+            "relu": "relu"}.get(hf_act)
+    normalize = lambda a: "gelu_tanh" if a == "gelu_new" else a
+    if ours is None or normalize(ours) != normalize(cfg.hidden_act):
+        raise ValueError(
+            f"HF checkpoint activation {hf_act!r} != target config hidden_act "
+            f"{cfg.hidden_act!r}; build the config with the matching hidden_act "
+            f"(HF BERT/DistilBERT default is exact 'gelu')")
+
+
 def load_hf_gpt2(model_or_sd, cfg) -> dict:
     """HF ``GPT2LMHeadModel`` → ``models.gpt2.GPT2LMHeadModel`` params.
 
@@ -246,6 +266,118 @@ def load_hf_gpt_neox(model_or_sd, cfg) -> dict:
     return params
 
 
+def load_hf_bert(model_or_sd, cfg) -> dict:
+    """HF ``BertForMaskedLM`` → ``models.bert.BertForMaskedLM`` params
+    (reference ``module_inject/containers/bert.py``).
+
+    HF checkpoints use exact (erf) gelu — build the target config with
+    ``hidden_act="gelu"``. ``BertForMaskedLM`` checkpoints carry no pooler
+    (``add_pooling_layer=False``); ours always declares one, so a zero
+    pooler is synthesized (unused by the MLM head).
+    """
+    _check_activation(model_or_sd, cfg, "hidden_act")
+    sd = _sd(model_or_sd)
+    pre = "bert." if any(k.startswith("bert.") for k in sd) else ""
+    E, H, D = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
+
+    lin = lambda name: _lin(sd, name)
+    ln = lambda name: {"LayerNorm_0": _ln(sd, name)}
+
+    bert = {
+        "word_embeddings": jnp.asarray(sd[f"{pre}embeddings.word_embeddings.weight"]),
+        "position_embeddings": jnp.asarray(sd[f"{pre}embeddings.position_embeddings.weight"]),
+        "token_type_embeddings": jnp.asarray(sd[f"{pre}embeddings.token_type_embeddings.weight"]),
+        "embeddings_ln": ln(f"{pre}embeddings.LayerNorm"),
+    }
+    if f"{pre}pooler.dense.weight" in sd:
+        bert["pooler"] = lin(f"{pre}pooler.dense")
+    else:
+        bert["pooler"] = {"kernel": jnp.zeros((E, E), jnp.float32),
+                          "bias": jnp.zeros((E,), jnp.float32)}
+    for i in range(cfg.num_hidden_layers):
+        p = f"{pre}encoder.layer.{i}."
+
+        def heads_in(name):
+            return {"kernel": jnp.asarray(sd[name + ".weight"].T.reshape(E, H, D)),
+                    "bias": jnp.asarray(sd[name + ".bias"].reshape(H, D))}
+
+        bert[f"layer_{i}"] = {
+            "attention": {
+                "query": heads_in(p + "attention.self.query"),
+                "key": heads_in(p + "attention.self.key"),
+                "value": heads_in(p + "attention.self.value"),
+                "output": {"kernel": jnp.asarray(sd[p + "attention.output.dense.weight"].T
+                                                 .reshape(H, D, E)),
+                           "bias": jnp.asarray(sd[p + "attention.output.dense.bias"])},
+            },
+            "attention_ln": ln(p + "attention.output.LayerNorm"),
+            "intermediate": lin(p + "intermediate.dense"),
+            "output": lin(p + "output.dense"),
+            "output_ln": ln(p + "output.LayerNorm"),
+        }
+    return {
+        "bert": bert,
+        "transform": lin("cls.predictions.transform.dense"),
+        "transform_ln": ln("cls.predictions.transform.LayerNorm"),
+        "decoder_bias": jnp.asarray(sd["cls.predictions.bias"]),
+    }
+
+
+def load_hf_distilbert(model_or_sd, cfg) -> dict:
+    """HF ``DistilBertForMaskedLM`` → ``models.bert.BertForMaskedLM`` params
+    (reference ``module_inject/containers/distil_bert.py``).
+
+    DistilBERT is served through the BERT family: no token-type embeddings
+    (build the config with ``type_vocab_size=1`` — a zero row is
+    synthesized so the default ``token_type_ids=0`` contributes nothing),
+    no pooler (zero-synthesized), ``vocab_projector`` tied to the word
+    embeddings with its bias → ``decoder_bias``. Use ``hidden_act="gelu"``.
+    """
+    _check_activation(model_or_sd, cfg, "activation")
+    sd = _sd(model_or_sd)
+    pre = "distilbert." if any(k.startswith("distilbert.") for k in sd) else ""
+    E, H, D = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
+
+    lin = lambda name: _lin(sd, name)
+    ln = lambda name: {"LayerNorm_0": _ln(sd, name)}
+
+    bert = {
+        "word_embeddings": jnp.asarray(sd[f"{pre}embeddings.word_embeddings.weight"]),
+        "position_embeddings": jnp.asarray(sd[f"{pre}embeddings.position_embeddings.weight"]),
+        "token_type_embeddings": jnp.zeros((cfg.type_vocab_size, E), jnp.float32),
+        "embeddings_ln": ln(f"{pre}embeddings.LayerNorm"),
+        "pooler": {"kernel": jnp.zeros((E, E), jnp.float32),
+                   "bias": jnp.zeros((E,), jnp.float32)},
+    }
+    for i in range(cfg.num_hidden_layers):
+        p = f"{pre}transformer.layer.{i}."
+
+        def heads_in(name):
+            return {"kernel": jnp.asarray(sd[name + ".weight"].T.reshape(E, H, D)),
+                    "bias": jnp.asarray(sd[name + ".bias"].reshape(H, D))}
+
+        bert[f"layer_{i}"] = {
+            "attention": {
+                "query": heads_in(p + "attention.q_lin"),
+                "key": heads_in(p + "attention.k_lin"),
+                "value": heads_in(p + "attention.v_lin"),
+                "output": {"kernel": jnp.asarray(sd[p + "attention.out_lin.weight"].T
+                                                 .reshape(H, D, E)),
+                           "bias": jnp.asarray(sd[p + "attention.out_lin.bias"])},
+            },
+            "attention_ln": ln(p + "sa_layer_norm"),
+            "intermediate": lin(p + "ffn.lin1"),
+            "output": lin(p + "ffn.lin2"),
+            "output_ln": ln(p + "output_layer_norm"),
+        }
+    return {
+        "bert": bert,
+        "transform": lin("vocab_transform"),
+        "transform_ln": ln("vocab_layer_norm"),
+        "decoder_bias": jnp.asarray(sd["vocab_projector.bias"]),
+    }
+
+
 def load_hf_gptj(model_or_sd, cfg) -> dict:
     """HF ``GPTJForCausalLM`` → ``models.gptj.GPTJForCausalLM`` params
     (reference ``module_inject/containers/gptj.py``).
@@ -438,7 +570,8 @@ def load_hf_checkpoint(hf_model, arch: str, cfg) -> dict:
     loaders = {"gpt2": load_hf_gpt2, "llama": load_hf_llama, "opt": load_hf_opt,
                "gpt_neox": load_hf_gpt_neox, "gptneox": load_hf_gpt_neox,
                "bloom": load_hf_bloom, "t5": load_hf_t5, "falcon": load_hf_falcon,
-               "gptj": load_hf_gptj, "gpt-j": load_hf_gptj}
+               "gptj": load_hf_gptj, "gpt-j": load_hf_gptj,
+               "bert": load_hf_bert, "distilbert": load_hf_distilbert}
     if arch not in loaders:
         raise ValueError(f"no HF converter for architecture {arch!r}; available: {sorted(loaders)}")
     return loaders[arch](hf_model, cfg)

@@ -1,0 +1,84 @@
+"""HF BERT / DistilBERT checkpoint parity through the BERT family
+(reference ``module_inject/containers/{bert,distil_bert}.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models import BertForMaskedLM, get_bert_config
+
+
+def test_hf_bert_mlm_parity():
+    """HF torch BertForMaskedLM logits == converted deepspeed_tpu logits
+    (incl. padding mask)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from deepspeed_tpu.module_inject import load_hf_bert
+
+    hf_cfg = transformers.BertConfig(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                                     num_attention_heads=4, intermediate_size=64,
+                                     max_position_embeddings=64, type_vocab_size=2,
+                                     hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    hf_model = transformers.BertForMaskedLM(hf_cfg).eval()
+    cfg = get_bert_config("test", vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                          num_attention_heads=4, intermediate_size=64,
+                          max_position_embeddings=64, hidden_act="gelu")
+    params = load_hf_bert(hf_model, cfg)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 128, (2, 12))
+    mask = np.ones((2, 12), np.int32)
+    mask[1, 8:] = 0
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids), attention_mask=torch.tensor(mask)).logits.numpy()
+    got = BertForMaskedLM(cfg).apply({"params": params}, jnp.asarray(ids, jnp.int32),
+                                     attention_mask=jnp.asarray(mask))
+    # compare only valid positions (HF still computes padded columns, but
+    # their logits are influenced by masked attention identically)
+    np.testing.assert_allclose(np.asarray(got)[mask == 1], want[mask == 1],
+                               atol=5e-4, rtol=3e-3)
+
+
+def test_hf_distilbert_mlm_parity():
+    """HF torch DistilBertForMaskedLM logits == converted logits served
+    through the BERT family (no token types, tied projector)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from deepspeed_tpu.module_inject import load_hf_distilbert
+
+    hf_cfg = transformers.DistilBertConfig(vocab_size=128, dim=32, n_layers=2, n_heads=4,
+                                           hidden_dim=64, max_position_embeddings=64,
+                                           dropout=0.0, attention_dropout=0.0)
+    hf_model = transformers.DistilBertForMaskedLM(hf_cfg).eval()
+    cfg = get_bert_config("distilbert", vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                          num_attention_heads=4, intermediate_size=64,
+                          max_position_embeddings=64)
+    assert cfg.hidden_act == "gelu" and cfg.type_vocab_size == 1
+    params = load_hf_distilbert(hf_model, cfg)
+    ids = np.random.default_rng(1).integers(0, 128, (2, 10))
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids)).logits.numpy()
+    got = BertForMaskedLM(cfg).apply({"params": params}, jnp.asarray(ids, jnp.int32))
+    np.testing.assert_allclose(np.asarray(got), want, atol=5e-4, rtol=3e-3)
+
+
+def test_distilbert_preset_trains_under_engine():
+    import deepspeed_tpu
+    from deepspeed_tpu.models import bert_mlm_loss
+
+    cfg = get_bert_config("distilbert", vocab_size=256, hidden_size=64,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          intermediate_size=128, max_position_embeddings=64)
+    rng = np.random.default_rng(2)
+    ids = rng.integers(0, 256, (8, 32)).astype(np.int32)
+    labels = np.where(rng.random((8, 32)) < 0.15, ids, -100).astype(np.int32)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=BertForMaskedLM(cfg),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 0}},
+        loss_fn=bert_mlm_loss)
+    batch = {"input_ids": ids, "labels": labels}
+    engine.initialize_state(batch)
+    losses = [float(engine.train_batch(batch)) for _ in range(5)]
+    assert losses[-1] < losses[0], losses
