@@ -14,3 +14,7 @@ from deepspeed_tpu.models.falcon import (FalconConfig, FalconForCausalLM, FALCON
                                           get_falcon_config)
 from deepspeed_tpu.models.gptj import (GPTJConfig, GPTJForCausalLM, GPTJ_CONFIGS,
                                        get_gptj_config)
+from deepspeed_tpu.models.gpt_neo import (GPTNeoConfig, GPTNeoForCausalLM, GPT_NEO_CONFIGS,
+                                          get_gpt_neo_config)
+from deepspeed_tpu.models.clip import (CLIPTextConfig, CLIPTextModel, CLIP_TEXT_CONFIGS,
+                                       get_clip_text_config)

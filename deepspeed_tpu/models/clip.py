@@ -1,0 +1,153 @@
+"""CLIP text encoder — the conditioning tower of the reference's diffusers
+serving path (``module_inject/containers/clip.py``,
+``model_implementations/transformers/clip_encoder.py``).
+
+CLIP quirks kept for checkpoint parity: CAUSAL attention in the text
+encoder (despite being an "encoder"), quick-gelu (``x * sigmoid(1.702x)``),
+pre-LN blocks with biased q/k/v/out projections, learned positions, final
+LayerNorm, and an EOS-token pooled output (first ``eos_token_id``
+occurrence when configured, else HF's legacy raw-argmax-of-ids pooling).
+"""
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.common import config_from, dense_init as _init
+from deepspeed_tpu.ops.transformer.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPTextConfig:
+    vocab_size: int = 49408
+    hidden_size: int = 512
+    intermediate_size: int = 2048
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 8
+    max_position_embeddings: int = 77
+    # pooled-output position: None → argmax of input_ids (HF's legacy
+    # eos_token_id==2 path); an int → FIRST occurrence of that token id
+    # (HF's current path — CLIP checkpoints ship eos_token_id=49407)
+    eos_token_id: Any = None
+    layer_norm_eps: float = 1e-5
+    # "quick_gelu" (original OpenAI CLIP) or "gelu" (exact erf —
+    # OpenCLIP-lineage towers, e.g. SD-2.x / ViT-H); converters validate
+    hidden_act: str = "quick_gelu"
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    remat_every: int = 1
+    remat_policy: Any = None
+    attention_backend: str = "xla"
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+CLIP_TEXT_CONFIGS = {
+    "test": dict(vocab_size=256, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                 num_attention_heads=4, max_position_embeddings=32),
+    # openai/clip-vit-base-patch32 text tower
+    "base": dict(hidden_size=512, intermediate_size=2048, num_hidden_layers=12,
+                 num_attention_heads=8, eos_token_id=49407),
+    # openai/clip-vit-large-patch14 text tower (stable-diffusion v1 conditioning)
+    "large": dict(hidden_size=768, intermediate_size=3072, num_hidden_layers=12,
+                  num_attention_heads=12, eos_token_id=49407),
+}
+
+
+def get_clip_text_config(name: str, **overrides) -> CLIPTextConfig:
+    return config_from(CLIP_TEXT_CONFIGS, CLIPTextConfig, name, **overrides)
+
+
+def quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+def _activation(cfg: CLIPTextConfig, h):
+    if cfg.hidden_act == "quick_gelu":
+        return quick_gelu(h)
+    if cfg.hidden_act == "gelu":
+        return jax.nn.gelu(h, approximate=False)
+    raise ValueError(f"unknown hidden_act {cfg.hidden_act!r}; "
+                     f"choose from ['quick_gelu', 'gelu']")
+
+
+class CLIPEncoderLayer(nn.Module):
+    config: CLIPTextConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+
+        def proj(name):
+            return nn.DenseGeneral(features=(cfg.num_attention_heads, cfg.head_dim), axis=-1,
+                                   dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                                   kernel_init=nn.with_logical_partitioning(
+                                       _init(), ("embed", "heads", "kv")),
+                                   bias_init=nn.with_logical_partitioning(
+                                       nn.initializers.zeros, ("heads", "kv")),
+                                   name=name)
+
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                                       param_dtype=cfg.param_dtype, name=name)
+        h = ln("layer_norm1")(x)
+        q, k, v = proj("q_proj")(h), proj("k_proj")(h), proj("v_proj")(h)
+        # text tower attends causally (HF CLIPTextTransformer builds a
+        # causal mask even though the module is named an encoder)
+        attn = dot_product_attention(q, k, v, backend=cfg.attention_backend, causal=True)
+        attn = nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype,
+                               param_dtype=cfg.param_dtype,
+                               kernel_init=nn.with_logical_partitioning(_init(), ("heads", "kv", "embed")),
+                               bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
+                               name="out_proj")(attn)
+        x = x + attn
+        h = ln("layer_norm2")(x)
+        h = nn.Dense(features=cfg.intermediate_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     kernel_init=nn.with_logical_partitioning(_init(), ("embed", "mlp")),
+                     bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("mlp",)),
+                     name="fc1")(h)
+        h = _activation(cfg, h)
+        h = nn.Dense(features=cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     kernel_init=nn.with_logical_partitioning(_init(), ("mlp", "embed")),
+                     bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
+                     name="fc2")(h)
+        return x + h
+
+
+class CLIPTextModel(nn.Module):
+    """Text tower: returns (last_hidden_state [B, L, E], pooled [B, E])."""
+
+    config: CLIPTextConfig
+
+    @nn.compact
+    def __call__(self, input_ids, *, deterministic: bool = True):
+        cfg = self.config
+        tok = self.param("token_embedding", nn.with_logical_partitioning(_init(), ("vocab", "embed")),
+                         (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+        pos = self.param("position_embedding", nn.with_logical_partitioning(_init(0.01), (None, "embed")),
+                         (cfg.max_position_embeddings, cfg.hidden_size), cfg.param_dtype)
+        tok = tok.value if isinstance(tok, nn.meta.AxisMetadata) else tok
+        pos = pos.value if isinstance(pos, nn.meta.AxisMetadata) else pos
+        b, l = input_ids.shape
+        x = (jnp.take(tok, input_ids, axis=0) + pos[None, :l]).astype(cfg.dtype)
+        from deepspeed_tpu.models.common import maybe_remat
+        for i in range(cfg.num_hidden_layers):
+            layer_cls = maybe_remat(CLIPEncoderLayer, cfg, i)
+            x = layer_cls(cfg, name=f"layers_{i}")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="final_layer_norm")(x)
+        # pooled = hidden state at the EOS token: first occurrence of
+        # eos_token_id when configured (HF current semantics), else argmax
+        # of ids (HF legacy eos_token_id==2 semantics — EOS is the highest
+        # id in the original CLIP vocabulary)
+        if cfg.eos_token_id is not None:
+            eos_idx = jnp.argmax((input_ids == cfg.eos_token_id).astype(jnp.int32), axis=-1)
+        else:
+            eos_idx = jnp.argmax(input_ids, axis=-1)
+        pooled = jnp.take_along_axis(x, eos_idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+        return x, pooled

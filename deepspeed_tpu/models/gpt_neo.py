@@ -1,0 +1,175 @@
+"""GPT-Neo family (125M/1.3B/2.7B) — learned positions with alternating
+global/local (sliding-window) attention layers (the reference serves
+GPT-Neo through kernel injection, ``module_inject/containers/gptneo.py``).
+
+Same TPU conventions as the rest of the zoo. GPT-Neo quirks kept for
+checkpoint parity: UNSCALED attention logits (no 1/sqrt(d) — the original
+mesh-tensorflow training choice HF preserves), bias-free q/k/v with biased
+out_proj, odd layers attending only the last ``window_size`` positions
+(the flash kernel skips out-of-window blocks; the xla backend masks),
+tanh-gelu MLP, tied LM head. Window masking applies to training/prefill —
+decode attends the whole cache (same convention as the Mistral preset,
+``models/llama.py``).
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.common import config_from, dense_init as _init, maybe_remat
+from deepspeed_tpu.ops.transformer.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTNeoConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 2048
+    # every odd layer is "local": attends (pos - window_size, pos]
+    window_size: int = 256
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    remat_every: int = 1
+    remat_policy: Optional[str] = None
+    # >0: loss via the chunked fused LM head when called with labels=
+    fused_head_loss_chunk: int = 0
+    attention_backend: str = "xla"
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    def attention_type(self, layer_idx: int) -> str:
+        # HF attention_types [[["global", "local"], n/2]] — even global,
+        # odd local
+        return "local" if layer_idx % 2 else "global"
+
+
+GPT_NEO_CONFIGS = {
+    "test": dict(vocab_size=256, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                 num_attention_heads=4, max_position_embeddings=128, window_size=8),
+    "125m": dict(hidden_size=768, intermediate_size=3072, num_hidden_layers=12,
+                 num_attention_heads=12),
+    "1.3b": dict(hidden_size=2048, intermediate_size=8192, num_hidden_layers=24,
+                 num_attention_heads=16),
+    "2.7b": dict(hidden_size=2560, intermediate_size=10240, num_hidden_layers=32,
+                 num_attention_heads=20),
+}
+
+
+def get_gpt_neo_config(name: str, **overrides) -> GPTNeoConfig:
+    return config_from(GPT_NEO_CONFIGS, GPTNeoConfig, name, **overrides)
+
+
+class GPTNeoAttention(nn.Module):
+    config: GPTNeoConfig
+    layer_idx: int = 0
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        b, l, _ = x.shape
+        local = cfg.attention_type(self.layer_idx) == "local"
+
+        def proj(name):
+            return nn.DenseGeneral(features=(cfg.num_attention_heads, cfg.head_dim), axis=-1,
+                                   use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                                   kernel_init=nn.with_logical_partitioning(
+                                       _init(), ("embed", "heads", "kv")),
+                                   name=name)(x)
+
+        q, k, v = proj("q_proj"), proj("k_proj"), proj("v_proj")  # [B, L, H, D]
+        causal, decode_lengths, window = True, None, cfg.window_size if local else None
+        if self.decode:
+            cache_index = self.variable("cache", "cache_index", lambda: jnp.zeros([], jnp.int32))
+            idx = cache_index.value
+            shape = (b, cfg.max_position_embeddings, cfg.num_attention_heads, cfg.head_dim)
+            cached_k = self.variable("cache", "cached_key", jnp.zeros, shape, k.dtype)
+            cached_v = self.variable("cache", "cached_value", jnp.zeros, shape, v.dtype)
+            cached_k.value = jax.lax.dynamic_update_slice(cached_k.value, k, (0, idx, 0, 0))
+            cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, v, (0, idx, 0, 0))
+            cache_index.value = idx + l
+            k, v = cached_k.value, cached_v.value
+            decode_lengths = jnp.broadcast_to(idx + l, (b,))
+            causal, window = False, None  # decode attends the whole cache
+        # GPT-Neo computes UNSCALED attention logits (scale=1.0)
+        out = dot_product_attention(q, k, v, backend=cfg.attention_backend,
+                                    causal=causal, scale=1.0,
+                                    decode_lengths=decode_lengths, window=window)
+        return nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1), use_bias=True,
+                               dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                               kernel_init=nn.with_logical_partitioning(_init(), ("heads", "kv", "embed")),
+                               bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
+                               name="out_proj")(out)
+
+
+class GPTNeoBlock(nn.Module):
+    config: GPTNeoConfig
+    layer_idx: int = 0
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                                       param_dtype=cfg.param_dtype, name=name)
+        x = x + GPTNeoAttention(cfg, self.layer_idx, self.decode,
+                                name="attn")(ln("ln_1")(x))
+        h = nn.Dense(features=cfg.intermediate_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     kernel_init=nn.with_logical_partitioning(_init(), ("embed", "mlp")),
+                     bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("mlp",)),
+                     name="c_fc")(ln("ln_2")(x))
+        h = jax.nn.gelu(h, approximate=True)  # HF GPT-Neo uses gelu_new
+        h = nn.Dense(features=cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     kernel_init=nn.with_logical_partitioning(_init(), ("mlp", "embed")),
+                     bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
+                     name="c_proj")(h)
+        return x + h
+
+
+class GPTNeoForCausalLM(nn.Module):
+    """GPT-Neo with TIED LM head. Returns logits [B, L, V] (or the scalar
+    loss when ``labels`` ride the fused head)."""
+
+    config: GPTNeoConfig
+
+    @nn.compact
+    def __call__(self, input_ids, *, deterministic: bool = True, decode: bool = False,
+                 labels=None):
+        cfg = self.config
+        wte = self.param("wte", nn.with_logical_partitioning(_init(), ("vocab", "embed")),
+                         (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+        wpe = self.param("wpe", nn.with_logical_partitioning(_init(0.01), (None, "embed")),
+                         (cfg.max_position_embeddings, cfg.hidden_size), cfg.param_dtype)
+        wte = wte.value if isinstance(wte, nn.meta.AxisMetadata) else wte
+        wpe = wpe.value if isinstance(wpe, nn.meta.AxisMetadata) else wpe
+
+        b, l = input_ids.shape
+        x = jnp.take(wte, input_ids, axis=0).astype(cfg.dtype)
+        if decode:
+            pos_idx = self.variable("cache", "position_index", lambda: jnp.zeros([], jnp.int32))
+            positions = pos_idx.value + jnp.arange(l)
+            pos_idx.value = pos_idx.value + l
+            x = x + jnp.take(wpe, positions, axis=0).astype(cfg.dtype)[None]
+        else:
+            x = x + wpe[:l].astype(cfg.dtype)
+        for i in range(cfg.num_hidden_layers):
+            block_cls = maybe_remat(GPTNeoBlock, cfg, i, enabled=cfg.remat and not decode)
+            x = block_cls(cfg, i, decode, name=f"h_{i}")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="ln_f")(x)
+        if labels is not None and cfg.fused_head_loss_chunk > 0:
+            from deepspeed_tpu.models.common import fused_head_loss_output
+            return fused_head_loss_output(x, wte.astype(cfg.dtype), labels,
+                                          0.0, deterministic, cfg, vocab_major=True)
+        return jnp.einsum("ble,ve->blv", x, wte.astype(cfg.dtype),
+                          preferred_element_type=cfg.dtype)

@@ -37,24 +37,27 @@ def _ln(sd, name):
             "bias": jnp.asarray(sd[name + ".bias"])}
 
 
-def _check_activation(model_or_sd, cfg, hf_field: str):
-    """Raise if the HF model's activation disagrees with the target config
+# HF activation-name aliases → one canonical name per numeric function
+_ACT_CANON = {"gelu": "gelu", "gelu_new": "gelu_tanh", "gelu_tanh": "gelu_tanh",
+              "gelu_pytorch_tanh": "gelu_tanh", "relu": "relu",
+              "quick_gelu": "quick_gelu"}
+
+
+def _check_activation(hf_cfg, cfg, hf_field: str):
+    """Raise if the HF config's activation disagrees with the target config
     (weights trained with erf-gelu silently drift under tanh-gelu). Only
     checkable when a model (not a bare state dict) is passed."""
-    hf_cfg = getattr(model_or_sd, "config", None)
     if hf_cfg is None:
         return
     hf_act = getattr(hf_cfg, hf_field, None)
     if hf_act is None:
         return
-    ours = {"gelu": "gelu", "gelu_new": "gelu_new", "gelu_tanh": "gelu_tanh",
-            "relu": "relu"}.get(hf_act)
-    normalize = lambda a: "gelu_tanh" if a == "gelu_new" else a
-    if ours is None or normalize(ours) != normalize(cfg.hidden_act):
+    if _ACT_CANON.get(hf_act) != _ACT_CANON.get(cfg.hidden_act):
         raise ValueError(
             f"HF checkpoint activation {hf_act!r} != target config hidden_act "
             f"{cfg.hidden_act!r}; build the config with the matching hidden_act "
-            f"(HF BERT/DistilBERT default is exact 'gelu')")
+            f"(HF BERT/DistilBERT default is exact 'gelu'; original CLIP is "
+            f"'quick_gelu')")
 
 
 def load_hf_gpt2(model_or_sd, cfg) -> dict:
@@ -275,7 +278,7 @@ def load_hf_bert(model_or_sd, cfg) -> dict:
     (``add_pooling_layer=False``); ours always declares one, so a zero
     pooler is synthesized (unused by the MLM head).
     """
-    _check_activation(model_or_sd, cfg, "hidden_act")
+    _check_activation(getattr(model_or_sd, "config", None), cfg, "hidden_act")
     sd = _sd(model_or_sd)
     pre = "bert." if any(k.startswith("bert.") for k in sd) else ""
     E, H, D = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
@@ -333,7 +336,7 @@ def load_hf_distilbert(model_or_sd, cfg) -> dict:
     no pooler (zero-synthesized), ``vocab_projector`` tied to the word
     embeddings with its bias → ``decoder_bias``. Use ``hidden_act="gelu"``.
     """
-    _check_activation(model_or_sd, cfg, "activation")
+    _check_activation(getattr(model_or_sd, "config", None), cfg, "activation")
     sd = _sd(model_or_sd)
     pre = "distilbert." if any(k.startswith("distilbert.") for k in sd) else ""
     E, H, D = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
@@ -411,6 +414,88 @@ def load_hf_gptj(model_or_sd, cfg) -> dict:
             },
             "fc_in": lin(p + "mlp.fc_in"),
             "fc_out": lin(p + "mlp.fc_out"),
+        }
+    return params
+
+
+def load_hf_gpt_neo(model_or_sd, cfg) -> dict:
+    """HF ``GPTNeoForCausalLM`` → ``models.gpt_neo.GPTNeoForCausalLM``
+    params (reference ``module_inject/containers/gptneo.py``).
+
+    GPT-Neo uses plain ``nn.Linear`` ([out, in] — transposed here), not
+    GPT-2's Conv1D; q/k/v carry no biases; the LM head is tied (any
+    ``lm_head.weight`` in the state dict is the embedding and is ignored).
+    """
+    sd = _sd(model_or_sd)
+    pre = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    E, H, D = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
+
+    lin = lambda name: _lin(sd, name)
+    ln = lambda name: _ln(sd, name)
+
+    params = {
+        "wte": jnp.asarray(sd[f"{pre}wte.weight"]),
+        "wpe": jnp.asarray(sd[f"{pre}wpe.weight"]),
+        "ln_f": ln(f"{pre}ln_f"),
+    }
+    for i in range(cfg.num_hidden_layers):
+        p = f"{pre}h.{i}."
+        a = p + "attn.attention."
+        params[f"h_{i}"] = {
+            "ln_1": ln(p + "ln_1"),
+            "ln_2": ln(p + "ln_2"),
+            "attn": {
+                "q_proj": {"kernel": jnp.asarray(sd[a + "q_proj.weight"].T.reshape(E, H, D))},
+                "k_proj": {"kernel": jnp.asarray(sd[a + "k_proj.weight"].T.reshape(E, H, D))},
+                "v_proj": {"kernel": jnp.asarray(sd[a + "v_proj.weight"].T.reshape(E, H, D))},
+                "out_proj": {"kernel": jnp.asarray(sd[a + "out_proj.weight"].T.reshape(H, D, E)),
+                             "bias": jnp.asarray(sd[a + "out_proj.bias"])},
+            },
+            "c_fc": lin(p + "mlp.c_fc"),
+            "c_proj": lin(p + "mlp.c_proj"),
+        }
+    return params
+
+
+def load_hf_clip_text(model_or_sd, cfg) -> dict:
+    """HF ``CLIPTextModel`` (or full ``CLIPModel``) →
+    ``models.clip.CLIPTextModel`` params (reference
+    ``module_inject/containers/clip.py``)."""
+    hf_cfg = getattr(model_or_sd, "config", None)
+    _check_activation(getattr(hf_cfg, "text_config", hf_cfg), cfg, "hidden_act")
+    sd = _sd(model_or_sd)
+    pre = ""
+    for cand in ("text_model.", "clip.text_model."):
+        if any(k.startswith(cand) for k in sd):
+            pre = cand
+            break
+    E, H, D = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
+
+    lin = lambda name: _lin(sd, name)
+    ln = lambda name: _ln(sd, name)
+
+    def heads_in(name):
+        return {"kernel": jnp.asarray(sd[name + ".weight"].T.reshape(E, H, D)),
+                "bias": jnp.asarray(sd[name + ".bias"].reshape(H, D))}
+
+    params = {
+        "token_embedding": jnp.asarray(sd[f"{pre}embeddings.token_embedding.weight"]),
+        "position_embedding": jnp.asarray(sd[f"{pre}embeddings.position_embedding.weight"]),
+        "final_layer_norm": ln(f"{pre}final_layer_norm"),
+    }
+    for i in range(cfg.num_hidden_layers):
+        p = f"{pre}encoder.layers.{i}."
+        params[f"layers_{i}"] = {
+            "layer_norm1": ln(p + "layer_norm1"),
+            "layer_norm2": ln(p + "layer_norm2"),
+            "q_proj": heads_in(p + "self_attn.q_proj"),
+            "k_proj": heads_in(p + "self_attn.k_proj"),
+            "v_proj": heads_in(p + "self_attn.v_proj"),
+            "out_proj": {"kernel": jnp.asarray(sd[p + "self_attn.out_proj.weight"].T
+                                               .reshape(H, D, E)),
+                         "bias": jnp.asarray(sd[p + "self_attn.out_proj.bias"])},
+            "fc1": lin(p + "mlp.fc1"),
+            "fc2": lin(p + "mlp.fc2"),
         }
     return params
 
@@ -571,7 +656,9 @@ def load_hf_checkpoint(hf_model, arch: str, cfg) -> dict:
                "gpt_neox": load_hf_gpt_neox, "gptneox": load_hf_gpt_neox,
                "bloom": load_hf_bloom, "t5": load_hf_t5, "falcon": load_hf_falcon,
                "gptj": load_hf_gptj, "gpt-j": load_hf_gptj,
-               "bert": load_hf_bert, "distilbert": load_hf_distilbert}
+               "bert": load_hf_bert, "distilbert": load_hf_distilbert,
+               "gpt_neo": load_hf_gpt_neo, "gptneo": load_hf_gpt_neo,
+               "clip": load_hf_clip_text, "clip_text": load_hf_clip_text}
     if arch not in loaders:
         raise ValueError(f"no HF converter for architecture {arch!r}; available: {sorted(loaders)}")
     return loaders[arch](hf_model, cfg)

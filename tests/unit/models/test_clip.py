@@ -1,0 +1,66 @@
+"""CLIP text encoder: HF parity (causal text attention, quick-gelu, EOS
+pooling). Reference: module_inject/containers/clip.py."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models import CLIPTextModel, get_clip_text_config
+
+
+def test_clip_text_is_causal():
+    """Perturbing a FUTURE token must not change earlier hidden states."""
+    cfg = get_clip_text_config("test")
+    model = CLIPTextModel(cfg)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 12)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    h0, _ = model.apply({"params": params}, ids)
+    bumped = ids.at[0, 9].set((int(ids[0, 9]) + 1) % cfg.vocab_size)
+    h1, _ = model.apply({"params": params}, bumped)
+    np.testing.assert_allclose(np.asarray(h0[0, :9]), np.asarray(h1[0, :9]), atol=1e-6)
+    assert not np.allclose(np.asarray(h0[0, 9:]), np.asarray(h1[0, 9:]), atol=1e-6)
+
+
+def test_hf_clip_text_parity():
+    """HF torch CLIPTextModel hidden states + pooled == converted ours."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from deepspeed_tpu.module_inject import load_hf_clip_text
+
+    hf_cfg = transformers.CLIPTextConfig(vocab_size=99, hidden_size=32, intermediate_size=64,
+                                         num_hidden_layers=2, num_attention_heads=4,
+                                         max_position_embeddings=16, hidden_act="quick_gelu",
+                                         eos_token_id=98)
+    hf_model = transformers.CLIPTextModel(hf_cfg).eval()
+    cfg = get_clip_text_config("test", vocab_size=99, hidden_size=32, intermediate_size=64,
+                               num_hidden_layers=2, num_attention_heads=4,
+                               max_position_embeddings=16, eos_token_id=98)
+    params = load_hf_clip_text(hf_model, cfg)
+    rng = np.random.default_rng(1)
+    # standard CLIP shape: tokens then EOS (the max id) then padding-ish ids
+    ids_np = rng.integers(0, 90, (2, 10))
+    ids_np[:, 7] = 98  # EOS = highest id → argmax pooling position
+    with torch.no_grad():
+        hf_out = hf_model(torch.tensor(ids_np))
+        want_h = hf_out.last_hidden_state.numpy()
+        want_p = hf_out.pooler_output.numpy()
+    got_h, got_p = CLIPTextModel(cfg).apply({"params": params}, jnp.asarray(ids_np, jnp.int32))
+    np.testing.assert_allclose(np.asarray(got_h), want_h, atol=3e-4, rtol=3e-3)
+    np.testing.assert_allclose(np.asarray(got_p), want_p, atol=3e-4, rtol=3e-3)
+
+
+def test_clip_pooling_modes():
+    """eos_token_id pooling picks the FIRST EOS occurrence; the legacy
+    (None) mode picks the argmax-id position — they disagree when a larger
+    id follows the EOS."""
+    cfg_eos = get_clip_text_config("test", eos_token_id=7)
+    cfg_argmax = get_clip_text_config("test")
+    model = CLIPTextModel(cfg_eos)
+    ids = jnp.asarray([[3, 7, 200, 4, 7, 1]], jnp.int32)  # EOS at 1; max id at 2
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    h, pooled_eos = model.apply({"params": params}, ids)
+    _, pooled_argmax = CLIPTextModel(cfg_argmax).apply({"params": params}, ids)
+    np.testing.assert_allclose(np.asarray(pooled_eos), np.asarray(h[:, 1]), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(pooled_argmax), np.asarray(h[:, 2]), atol=1e-6)
