@@ -425,7 +425,25 @@ def load_hf_gpt_neo(model_or_sd, cfg) -> dict:
     GPT-Neo uses plain ``nn.Linear`` ([out, in] — transposed here), not
     GPT-2's Conv1D; q/k/v carry no biases; the LM head is tied (any
     ``lm_head.weight`` in the state dict is the embedding and is ignored).
+    The target model hardcodes the standard even-global/odd-local layer
+    pattern, so checkpoints with a different ``attention_types`` schedule
+    or ``window_size`` are rejected rather than silently mis-masked.
     """
+    hf_cfg = getattr(model_or_sd, "config", None)
+    if hf_cfg is not None:
+        hf_layers = list(getattr(hf_cfg, "attention_layers", []) or [])
+        if hf_layers:
+            ours = [cfg.attention_type(i) for i in range(cfg.num_hidden_layers)]
+            if hf_layers != ours:
+                raise ValueError(
+                    f"HF attention_types expand to {hf_layers} but the target "
+                    f"model masks layers as {ours} (even-global/odd-local); "
+                    f"this checkpoint's schedule is unsupported")
+        hf_window = getattr(hf_cfg, "window_size", None)
+        if hf_window is not None and hf_window != cfg.window_size:
+            raise ValueError(
+                f"HF window_size={hf_window} != target config window_size="
+                f"{cfg.window_size}; build the config with the matching window")
     sd = _sd(model_or_sd)
     pre = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
     E, H, D = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim

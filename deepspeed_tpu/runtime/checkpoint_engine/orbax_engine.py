@@ -34,14 +34,25 @@ class OrbaxCheckpointEngine(CheckpointEngine):
     def save(self, state, tag, metadata: Optional[dict] = None):
         path = self._path(tag)
         self._ckptr.save(os.path.join(path, "state"), state, force=True)
-        # StandardCheckpointer finalizes asynchronously; without this a
-        # process exit right after save_checkpoint() leaves a torn
-        # *.orbax-checkpoint-tmp that restore reports as "not found"
-        self._ckptr.wait_until_finished()
+        if not self.use_async:
+            # StandardCheckpointer finalizes asynchronously; without this a
+            # process exit right after save_checkpoint() leaves a torn
+            # *.orbax-checkpoint-tmp that restore reports as "not found".
+            # Async mode (the Nebula role) skips the wait — the caller must
+            # commit(tag) before treating the checkpoint as durable.
+            self._ckptr.wait_until_finished()
         if metadata is not None and jax.process_index() == 0:
             with open(os.path.join(path, "metadata.json"), "w") as f:
                 json.dump(metadata, f)
-        log_dist(f"saved checkpoint {tag} -> {path}")
+        log_dist(f"saved checkpoint {tag} -> {path}"
+                 + (" (async, pending commit)" if self.use_async else ""))
+
+    def commit(self, tag):
+        """Block until every staged write for ``tag`` is durable (async
+        mode's second half; a no-op after synchronous saves)."""
+        self._ckptr.wait_until_finished()
+        log_dist(f"committed checkpoint {tag}")
+        return True
 
     def load(self, state, shardings, tag, load_optimizer_states=True, load_module_only=False):
         path = self._path(tag)

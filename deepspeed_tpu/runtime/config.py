@@ -89,6 +89,22 @@ class CheckpointConfig(DeepSpeedConfigModel):
     parallel_write: dict = {}
 
 
+class NebulaConfig(DeepSpeedConfigModel):
+    """Reference ``nebula/config.py`` keys. Nebula is MSFT's async
+    checkpoint service; here ``enabled`` routes ``save_checkpoint`` through
+    the async Orbax path — the write finalizes in the background while
+    training continues, and the ``latest`` durability marker lands at the
+    next save / explicit ``engine.flush_checkpoints()``. The storage/
+    retention knobs are accepted for config-surface parity (orbax
+    tensorstore already writes shard-parallel to the checkpoint dir)."""
+    enabled: bool = False
+    persistent_storage_path: Optional[str] = None
+    persistent_time_interval: Optional[int] = None
+    num_of_version_in_retention: int = 2
+    enable_nebula_load: bool = True
+    load_path: Optional[str] = None
+
+
 class DeepSpeedConfig:
     """Parses and validates the full config (reference ``DeepSpeedConfig``,
     ``runtime/config.py``)."""
@@ -187,6 +203,7 @@ class DeepSpeedConfig:
         self.trace_profiler_config = get_trace_profiler_config(param_dict)
         self.comms_config = DeepSpeedCommsConfig(param_dict)
         self.checkpoint_config = CheckpointConfig(**param_dict.get(C.CHECKPOINT, {}))
+        self.nebula_config = NebulaConfig(**param_dict.get(C.NEBULA, {}))
         self.hybrid_engine_config = HybridEngineConfig(**param_dict.get("hybrid_engine", {}))
         self.autotuning_config = param_dict.get(C.AUTOTUNING, {})
         self.elasticity_config = param_dict.get(C.ELASTICITY, {})

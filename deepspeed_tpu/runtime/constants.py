@@ -129,4 +129,5 @@ CURRICULUM_LEARNING_LEGACY = "curriculum_learning"
 PROGRESSIVE_LAYER_DROP = "progressive_layer_drop"
 QUANTIZE_TRAINING = "quantize_training"
 CHECKPOINT = "checkpoint"
+NEBULA = "nebula"
 DATA_TYPES = "data_types"

@@ -1605,7 +1605,11 @@ class DeepSpeedEngine:
         from deepspeed_tpu.runtime.checkpoint_engine.orbax_engine import OrbaxCheckpointEngine
         assert self.state is not None, "nothing to checkpoint: state not initialized"
         tag = tag or f"global_step{self.global_steps}"
-        engine = OrbaxCheckpointEngine(save_dir)
+        use_async = bool(self.config.nebula_config.enabled)
+        # one pending async save at a time: entering a new save commits the
+        # previous one (its 'latest' marker lands then)
+        self.flush_checkpoints()
+        engine = OrbaxCheckpointEngine(save_dir, use_async=use_async)
         meta = {
             "global_steps": self.global_steps,
             "global_samples": self.global_samples,
@@ -1629,11 +1633,56 @@ class DeepSpeedEngine:
             np.save(os.path.join(save_dir, tag, "host_optimizer.npy"),
                     {"opt": self._host_opt.state_dict(),
                      "masters": self._host_masters}, allow_pickle=True)
+        if use_async:
+            # Nebula-style deferral: training continues while orbax
+            # finalizes in the background; 'latest' (the durability marker)
+            # is written by flush_checkpoints() / the next save. A process
+            # exit with a pending save would leave a torn
+            # *.orbax-checkpoint-tmp — commit it from atexit.
+            self._pending_ckpt = (engine, save_dir, tag, save_latest)
+            if not getattr(self, "_flush_atexit", False):
+                import threading
+                import weakref
+                ref = weakref.ref(self)
+
+                def _flush_on_exit():
+                    eng = ref()
+                    if eng is not None:
+                        try:
+                            eng.flush_checkpoints()
+                        except Exception as e:  # noqa: BLE001 — exit path
+                            logger.warning(f"atexit checkpoint flush failed: {e}")
+
+                # plain atexit runs AFTER concurrent.futures' executor
+                # shutdown, which orbax's background commit still needs —
+                # threading's exit hooks run before that teardown
+                register = getattr(threading, "_register_atexit", None)
+                if register is None:  # very old Python: best-effort
+                    import atexit
+                    register = atexit.register
+                register(_flush_on_exit)
+                self._flush_atexit = True
+            return True
         if save_latest and dist.get_rank() == 0:
             with open(os.path.join(save_dir, "latest"), "w") as f:
                 f.write(tag)
         dist.barrier()
         return True
+
+    def flush_checkpoints(self):
+        """Commit any pending async checkpoint (reference Nebula's persist
+        boundary): blocks until the write is durable, then publishes its
+        ``latest`` marker."""
+        pending = getattr(self, "_pending_ckpt", None)
+        if pending is None:
+            return
+        engine, save_dir, tag, save_latest = pending
+        engine.commit(tag)
+        if save_latest and dist.get_rank() == 0:
+            with open(os.path.join(save_dir, "latest"), "w") as f:
+                f.write(tag)
+        dist.barrier()
+        self._pending_ckpt = None
 
     def save_16bit_model(self, save_dir, output_file=None):
         """Consolidated bf16 deployment weights from the LIVE params
@@ -1676,6 +1725,7 @@ class DeepSpeedEngine:
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False):
         from deepspeed_tpu.runtime.checkpoint_engine.orbax_engine import OrbaxCheckpointEngine
+        self.flush_checkpoints()  # an async save must be durable before any load
         if tag is None:
             latest = os.path.join(load_dir, "latest")
             if not os.path.exists(latest):

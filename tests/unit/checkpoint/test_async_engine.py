@@ -1,0 +1,74 @@
+"""Async (Nebula-role) checkpointing: deferred durability marker, commit on
+flush / next save, round-trip fidelity. Reference: ``nebula/config.py`` +
+``runtime/checkpoint_engine/nebula_checkpoint_engine.py``."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+
+
+def _engine(nebula: bool):
+    cfg = get_gpt2_config("test")
+    ds = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+    }
+    if nebula:
+        ds["nebula"] = {"enabled": True, "persistent_time_interval": 100}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2LMHeadModel(cfg), config=ds)
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (8, 32)).astype(np.int32)}
+    engine.initialize_state(batch)
+    return engine, batch
+
+
+def test_async_save_defers_latest_until_flush(tmp_path):
+    engine, batch = _engine(nebula=True)
+    engine.train_batch(batch)
+    snap = jax.device_get(engine.state.params)
+    engine.save_checkpoint(str(tmp_path), tag="tagA")
+    # durability marker is deferred — training continues meanwhile
+    assert not os.path.exists(tmp_path / "latest")
+    engine.train_batch(batch)
+    engine.flush_checkpoints()
+    assert (tmp_path / "latest").read_text() == "tagA"
+    # restored state is the SAVE-TIME state, not the post-save one
+    engine.load_checkpoint(str(tmp_path))
+    restored = jax.device_get(engine.state.params)
+    jax.tree.map(np.testing.assert_array_equal, snap, restored)
+
+
+def test_next_save_commits_previous(tmp_path):
+    engine, batch = _engine(nebula=True)
+    engine.train_batch(batch)
+    engine.save_checkpoint(str(tmp_path), tag="tagA")
+    assert not os.path.exists(tmp_path / "latest")
+    engine.train_batch(batch)
+    engine.save_checkpoint(str(tmp_path), tag="tagB")
+    # entering save B committed A and published its marker
+    assert (tmp_path / "latest").read_text() == "tagA"
+    engine.flush_checkpoints()
+    assert (tmp_path / "latest").read_text() == "tagB"
+
+
+def test_load_flushes_pending_async_save(tmp_path):
+    engine, batch = _engine(nebula=True)
+    engine.train_batch(batch)
+    engine.save_checkpoint(str(tmp_path), tag="only")
+    # no flush, straight to load: must auto-commit first
+    engine.load_checkpoint(str(tmp_path))
+    assert (tmp_path / "latest").read_text() == "only"
+
+
+def test_sync_mode_unchanged(tmp_path):
+    engine, batch = _engine(nebula=False)
+    engine.train_batch(batch)
+    engine.save_checkpoint(str(tmp_path), tag="s")
+    assert (tmp_path / "latest").read_text() == "s"
+    engine.flush_checkpoints()  # no-op

@@ -100,3 +100,28 @@ def test_hf_gpt_neo_checkpoint_parity():
         hf_logits = hf_model(torch.tensor(ids_np)).logits.numpy()
     ours = GPTNeoForCausalLM(cfg).apply({"params": params}, jnp.asarray(ids_np, jnp.int32))
     np.testing.assert_allclose(np.asarray(ours), hf_logits, atol=3e-4, rtol=3e-3)
+
+
+def test_converter_rejects_mismatched_schedule():
+    """All-global or different-window HF checkpoints must be rejected, not
+    silently mis-masked."""
+    transformers = pytest.importorskip("transformers")
+    from deepspeed_tpu.module_inject import load_hf_gpt_neo
+
+    hf_cfg = transformers.GPTNeoConfig(
+        vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+        intermediate_size=64, max_position_embeddings=64, window_size=4,
+        attention_types=[[["global"], 2]])
+    hf_model = transformers.GPTNeoForCausalLM(hf_cfg).eval()
+    cfg = get_gpt_neo_config("test", vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                             num_attention_heads=4, intermediate_size=64,
+                             max_position_embeddings=64, window_size=4)
+    with pytest.raises(ValueError, match="attention_types"):
+        load_hf_gpt_neo(hf_model, cfg)
+
+    hf_cfg2 = transformers.GPTNeoConfig(
+        vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+        intermediate_size=64, max_position_embeddings=64, window_size=8,
+        attention_types=[[["global", "local"], 1]])
+    with pytest.raises(ValueError, match="window_size"):
+        load_hf_gpt_neo(transformers.GPTNeoForCausalLM(hf_cfg2).eval(), cfg)
