@@ -33,6 +33,43 @@ from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
 from deepspeed_tpu.utils.logging import log_dist
 
 
+def _load_checkpoint_params(spec, base_dir: str = ""):
+    """Load serving weights named by ``config.checkpoint`` (reference
+    ``InferenceEngine`` checkpoint loading, ``inference/engine.py:336``).
+
+    Accepts a consolidated ``.npz`` (``save_16bit_model`` /
+    ``zero_to_fp32`` output), an engine ``save_checkpoint`` directory
+    (``latest``/tag orbax checkpoint — consolidated on the fly), or a dict
+    ``{"checkpoint_dir"|"path": ..., "tag": ...}``.
+    """
+    import os
+
+    from deepspeed_tpu.checkpoint.zero_to_fp32 import (
+        WEIGHTS_NAME, get_fp32_state_dict_from_zero_checkpoint, load_state_dict_from_npz)
+
+    tag, original = None, spec
+    if isinstance(spec, dict):
+        tag = spec.get("tag")
+        spec = spec.get("checkpoint_dir") or spec.get("path")
+    if not isinstance(spec, str) or not spec:
+        raise ValueError(f"unsupported checkpoint spec {original!r}: pass a .npz path, an "
+                         f"engine checkpoint dir, or {{'checkpoint_dir': ..., 'tag': ...}}")
+    path = os.path.join(base_dir, spec) if base_dir else spec
+    if path.endswith(".npz"):
+        if not os.path.isfile(path):
+            raise ValueError(f"checkpoint npz {path!r} does not exist")
+        params = load_state_dict_from_npz(path)
+    elif os.path.isdir(path) and (tag is not None or os.path.exists(os.path.join(path, "latest"))):
+        params = get_fp32_state_dict_from_zero_checkpoint(path, tag=tag)
+    elif os.path.isdir(path) and os.path.isfile(os.path.join(path, WEIGHTS_NAME)):
+        params = load_state_dict_from_npz(path)
+    else:
+        raise ValueError(f"checkpoint path {path!r} is neither a .npz file nor a "
+                         f"checkpoint directory (no 'latest', no {WEIGHTS_NAME})")
+    log_dist(f"inference weights loaded from {path}")
+    return params
+
+
 def _unwrap_logits(out):
     """MoE models return (logits, aux_loss); serving wants the logits."""
     if isinstance(out, (tuple, list)):
@@ -95,6 +132,8 @@ class InferenceEngine:
         self._is_seq2seq = is_seq2seq_module(self.module)
         example_extra = {"decoder_input_ids": example} if self._is_seq2seq else {}
 
+        if params is None and config.checkpoint is not None:
+            params = _load_checkpoint_params(config.checkpoint, config.base_dir)
         if params is None:
             params = nn.meta.unbox(
                 self.module.init(self._rng, example, **example_extra)["params"])

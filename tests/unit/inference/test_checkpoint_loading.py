@@ -1,0 +1,64 @@
+"""init_inference(checkpoint=...) weight loading (reference
+``InferenceEngine`` sharded/meta checkpoint loading, engine.py:336):
+engine save dirs and consolidated npz both serve."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ckpt")
+    cfg = get_gpt2_config("test")
+    engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2LMHeadModel(cfg), config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+    })
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (8, 32)).astype(np.int32)}
+    engine.initialize_state(batch)
+    for _ in range(2):
+        engine.train_batch(batch)
+    engine.save_checkpoint(str(d / "save"))
+    engine.save_16bit_model(str(d / "deploy"))
+    live = jax.device_get(engine.state.params)
+    return d, cfg, live
+
+
+def _logits(cfg, params_source_kwargs, ids):
+    serve = deepspeed_tpu.init_inference(GPT2LMHeadModel(cfg), dtype=jnp.float32,
+                                         replace_with_kernel_inject=False,
+                                         **params_source_kwargs)
+    return np.asarray(serve(ids))
+
+
+def test_serve_from_engine_checkpoint_dir(trained):
+    d, cfg, live = trained
+    ids = np.arange(16, dtype=np.int32).reshape(1, 16) % cfg.vocab_size
+    want = _logits(cfg, {"params": live}, ids)
+    got = _logits(cfg, {"checkpoint": str(d / "save")}, ids)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_serve_from_consolidated_npz(trained):
+    d, cfg, live = trained
+    ids = np.arange(16, dtype=np.int32).reshape(1, 16) % cfg.vocab_size
+    want = _logits(cfg, {"params": live}, ids)
+    # bf16 deployment weights: parity within bf16 rounding of the weights
+    got = _logits(cfg, {"checkpoint": str(d / "deploy")}, ids)
+    np.testing.assert_allclose(got, want, atol=0.05, rtol=0.05)
+
+
+def test_bad_checkpoint_spec_raises(trained):
+    d, cfg, _ = trained
+    with pytest.raises(ValueError, match="neither"):
+        deepspeed_tpu.init_inference(GPT2LMHeadModel(cfg),
+                                     replace_with_kernel_inject=False,
+                                     checkpoint=str(d / "nope"))
