@@ -83,9 +83,9 @@ def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir: str,
     public entry)."""
     state = _restore_numpy(checkpoint_dir, tag, params_only=True)
     params = state["params"]
-    return {k: v for k, v in _unflatten({
+    return _unflatten({
         p: a.astype(np.float32) if np.issubdtype(a.dtype, np.floating) else a
-        for p, a in _flatten(params).items()}).items()}
+        for p, a in _flatten(params).items()})
 
 
 def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir: str,

@@ -28,4 +28,19 @@ def init_inference(model, config=None, params=None, topology=None, **kwargs):
                 cfg_dict["tensor_parallel"].setdefault("tp_size", kwargs.pop("mp_size"))
         cfg_dict.update(kwargs)
         ds_config = DeepSpeedInferenceConfig(**cfg_dict)
+    if hasattr(model, "state_dict") and hasattr(model, "config") and params is None:
+        # HF torch module handed in directly (the reference's calling
+        # convention): convert arch + config + weights in one step. int8
+        # means QUANTIZED WEIGHTS, never int8 compute — match the engine's
+        # cast_dtype mapping (inference/engine.py)
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.module_inject.from_hf import from_hf
+        compute_dtype = jnp.bfloat16 if ds_config.dtype == jnp.int8 else ds_config.dtype
+        model, params = from_hf(model, dtype=compute_dtype)
+        if ds_config.checkpoint is not None:
+            # explicit checkpoint wins over the module's own weights (the
+            # reference's meta-tensor convention: arch from the module,
+            # weights from the checkpoint)
+            params = None
     return InferenceEngine(model, ds_config, params=params, topology=topology)

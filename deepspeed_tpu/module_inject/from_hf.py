@@ -1,0 +1,171 @@
+"""One-call HF import: torch model → (flax module, converted params).
+
+The reference's ``init_inference`` accepts the HF torch module directly and
+injects kernels into it (``module_inject/replace_module.py:283``); the TPU
+analog is a conversion: detect the architecture from ``config.model_type``,
+derive the matching model-zoo config from the HF config, and remap the
+weights with the per-arch converter. ``init_inference`` calls this
+automatically when handed a torch module.
+"""
+
+from typing import Any, Optional
+
+from deepspeed_tpu.module_inject.load_checkpoint import load_hf_checkpoint
+
+_CONFIG_CLASS = {"gpt2": "GPT2Config", "llama": "LlamaConfig", "opt": "OPTConfig",
+                 "gpt_neox": "GPTNeoXConfig", "gptj": "GPTJConfig",
+                 "gpt_neo": "GPTNeoConfig", "bloom": "BloomConfig",
+                 "falcon": "FalconConfig", "t5": "T5Config", "bert": "BertConfig",
+                 "clip": "CLIPTextConfig"}
+
+
+def _gptj_inner(hf):
+    return hf.n_inner if getattr(hf, "n_inner", None) else 4 * hf.n_embd
+
+
+def _llama_like(hf, **extra):
+    out = dict(vocab_size=hf.vocab_size, hidden_size=hf.hidden_size,
+               intermediate_size=hf.intermediate_size,
+               num_hidden_layers=hf.num_hidden_layers,
+               num_attention_heads=hf.num_attention_heads,
+               num_key_value_heads=getattr(hf, "num_key_value_heads", None)
+               or hf.num_attention_heads,
+               max_position_embeddings=hf.max_position_embeddings,
+               rms_norm_eps=hf.rms_norm_eps,
+               rope_theta=getattr(hf, "rope_theta", 10000.0),
+               attention_bias=bool(getattr(hf, "attention_bias", False)))
+    out.update(extra)
+    return out
+
+
+def _spec(model_type: str, hf):
+    """(family module name, model class name, config class kwargs, converter arch)."""
+    if model_type == "gpt2":
+        return ("gpt2", "GPT2LMHeadModel", dict(
+            vocab_size=hf.vocab_size, n_positions=hf.n_positions, n_embd=hf.n_embd,
+            n_layer=hf.n_layer, n_head=hf.n_head,
+            layer_norm_epsilon=hf.layer_norm_epsilon), "gpt2")
+    if model_type == "llama":
+        return ("llama", "LlamaForCausalLM", _llama_like(hf), "llama")
+    if model_type == "mistral":
+        return ("llama", "LlamaForCausalLM",
+                _llama_like(hf, sliding_window=getattr(hf, "sliding_window", None)), "llama")
+    if model_type == "qwen2":
+        sw = getattr(hf, "sliding_window", None) if getattr(hf, "use_sliding_window", False) else None
+        return ("llama", "LlamaForCausalLM",
+                _llama_like(hf, attention_bias=True, sliding_window=sw), "llama")
+    if model_type == "mixtral":
+        return ("llama", "LlamaForCausalLM",
+                _llama_like(hf, moe_num_experts=hf.num_local_experts,
+                            moe_k=hf.num_experts_per_tok), "llama")
+    if model_type == "opt":
+        return ("opt", "OPTForCausalLM", dict(
+            vocab_size=hf.vocab_size, hidden_size=hf.hidden_size, ffn_dim=hf.ffn_dim,
+            num_hidden_layers=hf.num_hidden_layers, num_attention_heads=hf.num_attention_heads,
+            max_position_embeddings=hf.max_position_embeddings,
+            word_embed_proj_dim=hf.word_embed_proj_dim,
+            do_layer_norm_before=hf.do_layer_norm_before), "opt")
+    if model_type == "gpt_neox":
+        return ("gpt_neox", "GPTNeoXForCausalLM", dict(
+            vocab_size=hf.vocab_size, hidden_size=hf.hidden_size,
+            intermediate_size=hf.intermediate_size, num_hidden_layers=hf.num_hidden_layers,
+            num_attention_heads=hf.num_attention_heads,
+            max_position_embeddings=hf.max_position_embeddings,
+            rotary_pct=hf.rotary_pct,
+            rotary_emb_base=getattr(hf, "rotary_emb_base", None) or getattr(hf, "rope_theta", 10000.0),
+            use_parallel_residual=hf.use_parallel_residual,
+            layer_norm_eps=hf.layer_norm_eps), "gpt_neox")
+    if model_type == "gptj":
+        return ("gptj", "GPTJForCausalLM", dict(
+            vocab_size=hf.vocab_size, hidden_size=hf.n_embd, intermediate_size=_gptj_inner(hf),
+            num_hidden_layers=hf.n_layer, num_attention_heads=hf.n_head,
+            max_position_embeddings=hf.n_positions, rotary_dim=hf.rotary_dim or hf.n_embd
+            // hf.n_head, layer_norm_eps=hf.layer_norm_epsilon), "gptj")
+    if model_type == "gpt_neo":
+        inner = getattr(hf, "intermediate_size", None) or 4 * hf.hidden_size
+        return ("gpt_neo", "GPTNeoForCausalLM", dict(
+            vocab_size=hf.vocab_size, hidden_size=hf.hidden_size, intermediate_size=inner,
+            num_hidden_layers=hf.num_layers, num_attention_heads=hf.num_heads,
+            max_position_embeddings=hf.max_position_embeddings,
+            window_size=hf.window_size, layer_norm_eps=hf.layer_norm_epsilon), "gpt_neo")
+    if model_type == "bloom":
+        return ("bloom", "BloomForCausalLM", dict(
+            vocab_size=hf.vocab_size, hidden_size=hf.hidden_size, n_head=hf.n_head,
+            n_layer=hf.n_layer, layer_norm_epsilon=hf.layer_norm_epsilon), "bloom")
+    if model_type == "falcon":
+        if getattr(hf, "new_decoder_architecture", False):
+            kv = hf.num_kv_heads
+        else:
+            kv = 1 if getattr(hf, "multi_query", True) else hf.num_attention_heads
+        return ("falcon", "FalconForCausalLM", dict(
+            vocab_size=hf.vocab_size, hidden_size=hf.hidden_size,
+            num_attention_heads=hf.num_attention_heads, num_kv_heads=kv,
+            num_hidden_layers=hf.num_hidden_layers,
+            layer_norm_epsilon=hf.layer_norm_epsilon,
+            rope_theta=getattr(hf, "rope_theta", 10000.0),
+            new_decoder_architecture=getattr(hf, "new_decoder_architecture", False)), "falcon")
+    if model_type == "t5":
+        return ("t5", "T5ForConditionalGeneration", dict(
+            vocab_size=hf.vocab_size, d_model=hf.d_model, d_kv=hf.d_kv, d_ff=hf.d_ff,
+            num_layers=hf.num_layers, num_decoder_layers=hf.num_decoder_layers,
+            num_heads=hf.num_heads,
+            relative_attention_num_buckets=hf.relative_attention_num_buckets,
+            relative_attention_max_distance=hf.relative_attention_max_distance,
+            layer_norm_epsilon=hf.layer_norm_epsilon,
+            feed_forward_proj=hf.feed_forward_proj,
+            tie_word_embeddings=hf.tie_word_embeddings,
+            decoder_start_token_id=hf.decoder_start_token_id), "t5")
+    if model_type == "bert":
+        return ("bert", "BertForMaskedLM", dict(
+            vocab_size=hf.vocab_size, hidden_size=hf.hidden_size,
+            num_hidden_layers=hf.num_hidden_layers, num_attention_heads=hf.num_attention_heads,
+            intermediate_size=hf.intermediate_size,
+            max_position_embeddings=hf.max_position_embeddings,
+            type_vocab_size=hf.type_vocab_size, layer_norm_eps=hf.layer_norm_eps,
+            hidden_act=hf.hidden_act), "bert")
+    if model_type == "distilbert":
+        return ("bert", "BertForMaskedLM", dict(
+            vocab_size=hf.vocab_size, hidden_size=hf.dim, num_hidden_layers=hf.n_layers,
+            num_attention_heads=hf.n_heads, intermediate_size=hf.hidden_dim,
+            max_position_embeddings=hf.max_position_embeddings,
+            type_vocab_size=1, hidden_act=hf.activation), "distilbert")
+    if model_type in ("clip", "clip_text_model"):
+        text = getattr(hf, "text_config", hf)
+        return ("clip", "CLIPTextModel", dict(
+            vocab_size=text.vocab_size, hidden_size=text.hidden_size,
+            intermediate_size=text.intermediate_size,
+            num_hidden_layers=text.num_hidden_layers,
+            num_attention_heads=text.num_attention_heads,
+            max_position_embeddings=text.max_position_embeddings,
+            # HF special-cases eos_token_id==2 to legacy argmax pooling;
+            # the zoo encodes that mode as None
+            eos_token_id=(lambda e: None if e == 2 else e)(getattr(text, "eos_token_id", None)),
+            hidden_act=text.hidden_act, layer_norm_eps=text.layer_norm_eps), "clip")
+    raise ValueError(f"no deepspeed_tpu mapping for HF model_type {model_type!r}; "
+                     f"convert manually via module_inject.load_hf_checkpoint")
+
+
+def from_hf(hf_model, dtype: Optional[Any] = None, **config_overrides):
+    """HF torch model → ``(flax module, converted params)``.
+
+    ``dtype`` sets the compute dtype of the returned module (params stay at
+    the checkpoint precision); extra kwargs override derived config fields
+    (e.g. ``attention_backend="flash"``, ``fused_head_loss_chunk=1024``).
+    """
+    import importlib
+
+    hf_cfg = getattr(hf_model, "config", None)
+    model_type = getattr(hf_cfg, "model_type", None)
+    if model_type is None:
+        raise ValueError("from_hf needs a HF model with config.model_type; got "
+                         f"{type(hf_model).__name__}")
+    family, cls_name, kwargs, arch = _spec(model_type, hf_cfg)
+    if dtype is not None:
+        kwargs["dtype"] = dtype
+    kwargs.update(config_overrides)
+    mod = importlib.import_module(f"deepspeed_tpu.models.{family}")
+    cfg_cls = getattr(mod, _CONFIG_CLASS[family])
+    cfg = cfg_cls(**kwargs)
+    model = getattr(mod, cls_name)(cfg)
+    params = load_hf_checkpoint(hf_model, arch, cfg)
+    return model, params

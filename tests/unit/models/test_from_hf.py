@@ -1,0 +1,138 @@
+"""from_hf: one-call HF import (auto arch detection, config derivation,
+weight conversion) and init_inference(torch model) ergonomics
+(reference ``init_inference`` consuming HF modules directly)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from deepspeed_tpu.module_inject import from_hf
+
+
+def _hf(model_type):
+    if model_type == "gpt2":
+        return transformers.GPT2LMHeadModel(transformers.GPT2Config(
+            vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+            resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0))
+    if model_type == "gptj":
+        return transformers.GPTJForCausalLM(transformers.GPTJConfig(
+            vocab_size=128, n_embd=32, n_layer=2, n_head=4, n_inner=64,
+            n_positions=64, rotary_dim=4, resid_pdrop=0.0, embd_pdrop=0.0,
+            attn_pdrop=0.0))
+    if model_type == "qwen2":
+        return transformers.Qwen2ForCausalLM(transformers.Qwen2Config(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, attention_dropout=0.0))
+    if model_type == "gpt_neo":
+        return transformers.GPTNeoForCausalLM(transformers.GPTNeoConfig(
+            vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+            intermediate_size=64, max_position_embeddings=64, window_size=8,
+            attention_types=[[["global", "local"], 1]],
+            resid_dropout=0.0, embed_dropout=0.0, attention_dropout=0.0))
+    raise KeyError(model_type)
+
+
+@pytest.mark.parametrize("model_type", ["gpt2", "gptj", "qwen2", "gpt_neo"])
+def test_from_hf_logits_parity(model_type):
+    hf_model = _hf(model_type).eval()
+    model, params = from_hf(hf_model)
+    ids = np.random.default_rng(0).integers(0, 128, (2, 12))
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids)).logits.numpy()
+    got = model.apply({"params": params}, jnp.asarray(ids, jnp.int32))
+    np.testing.assert_allclose(np.asarray(got), want, atol=5e-4, rtol=3e-3)
+
+
+def test_from_hf_overrides_and_dtype():
+    model, params = from_hf(_hf("gpt2"), dtype=jnp.bfloat16,
+                            attention_backend="xla", fused_head_loss_chunk=32)
+    assert model.config.dtype == jnp.bfloat16
+    assert model.config.fused_head_loss_chunk == 32
+    # params keep checkpoint precision
+    assert jax.tree.leaves(params)[0].dtype == jnp.float32
+
+
+def test_from_hf_unknown_arch_raises():
+    class FakeCfg:
+        model_type = "some-rnn"
+
+    class Fake:
+        config = FakeCfg()
+
+        def state_dict(self):
+            return {}
+
+    with pytest.raises(ValueError, match="model_type"):
+        from_hf(Fake())
+
+
+def test_init_inference_accepts_torch_module():
+    import deepspeed_tpu
+
+    hf_model = _hf("gpt2").eval()
+    serve = deepspeed_tpu.init_inference(hf_model, dtype=jnp.float32,
+                                         replace_with_kernel_inject=False)
+    ids = np.random.default_rng(1).integers(0, 128, (2, 8))
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(serve(ids.astype(np.int32)))
+    np.testing.assert_allclose(got, want, atol=5e-4, rtol=3e-3)
+    out = serve.generate(ids.astype(np.int32), max_new_tokens=4)
+    assert np.asarray(out).shape == (2, 12)
+
+
+def test_init_inference_hf_module_with_checkpoint_override(tmp_path):
+    """checkpoint= wins over the torch module's own weights (the reference
+    meta-tensor convention: arch from the module, weights from disk)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.checkpoint.zero_to_fp32 import save_npz, _flatten
+
+    hf_model = _hf("gpt2").eval()
+    model, params = from_hf(hf_model)
+    # perturb and save as the "fine-tuned" deployment npz
+    bumped = jax.tree.map(lambda p: p + 0.01, params)
+    npz = tmp_path / "model_weights.npz"
+    save_npz(str(npz), _flatten(jax.tree.map(np.asarray, bumped)))
+    serve = deepspeed_tpu.init_inference(hf_model, dtype=jnp.float32,
+                                         replace_with_kernel_inject=False,
+                                         checkpoint=str(npz))
+    ids = np.zeros((1, 8), np.int32)
+    got = np.asarray(serve(ids))
+    want_bumped = np.asarray(model.apply({"params": bumped}, jnp.asarray(ids)))
+    want_orig = np.asarray(model.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, want_bumped, atol=1e-5)
+    assert not np.allclose(got, want_orig, atol=1e-5)
+
+
+def test_init_inference_hf_module_int8_serves_float():
+    """dtype='int8' means quantized WEIGHTS; the converted module must
+    compute in bf16, not int8."""
+    import deepspeed_tpu
+
+    serve = deepspeed_tpu.init_inference(_hf("gpt2").eval(), dtype=jnp.int8,
+                                         replace_with_kernel_inject=False)
+    assert serve.module.config.dtype == jnp.bfloat16
+    out = np.asarray(serve(np.zeros((1, 8), np.int32)))
+    assert np.isfinite(out).all()
+
+
+def test_from_hf_biased_llama():
+    """attention_bias flows through for plain-llama checkpoints that carry
+    q/k/v biases."""
+    hf_model = transformers.LlamaForCausalLM(transformers.LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        attention_bias=True, attention_dropout=0.0)).eval()
+    model, params = from_hf(hf_model)
+    assert model.config.attention_bias is True
+    ids = np.random.default_rng(3).integers(0, 128, (1, 10))
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids)).logits.numpy()
+    got = model.apply({"params": params}, jnp.asarray(ids, jnp.int32))
+    np.testing.assert_allclose(np.asarray(got), want, atol=5e-4, rtol=3e-3)
