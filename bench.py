@@ -82,8 +82,10 @@ def run_child():
         overrides["vocab_size"] = vocab_override
     if os.environ.get("BENCH_EMBED_ONEHOT", "1") == "1":
         overrides["embed_onehot_grad"] = True
-    # chunked fused LM-head loss (no [B,L,V] logits buffer) — opt-in knob
-    if os.environ.get("BENCH_FUSED_XENT", "0") == "1":
+    # chunked fused LM-head loss (no [B,L,V] logits buffer) — measured
+    # faster than the plain head at mb=8 on v5e (70.1 vs 69.0 TFLOPS,
+    # tools/perf_sweep2.py r3 session 5) — on by default, opt out with "0"
+    if os.environ.get("BENCH_FUSED_XENT", "1") == "1":
         overrides["fused_head_loss_chunk"] = int(os.environ.get("BENCH_XENT_CHUNK", "1024"))
     cfg_model = get_gpt2_config(model_name, n_positions=seq, remat=remat,
                                 attention_backend=attn, dtype=jnp.bfloat16,

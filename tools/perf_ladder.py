@@ -51,6 +51,10 @@ def run_rung(tag, model_name, mb, offload=False, steps=None, seq=None,
 RUNGS = {
     "760m_mb4": dict(model_name="760m", mb=4),
     "760m_mb8": dict(model_name="760m", mb=8),
+    # plain 760m_mb8 OOMs by 2.6G; the chunked fused head removes the
+    # [B,L,V] logits + cotangent buffers (~2x0.77G bf16 + f32 temps)
+    "760m_mb8_fx": dict(model_name="760m", mb=8, fused_xent=True),
+    "760m_mb4_fx": dict(model_name="760m", mb=4, fused_xent=True),
     "xl_offload_mb1": dict(model_name="xl", mb=1, offload=True, steps=2),
     "xl_offload_mb4": dict(model_name="xl", mb=4, offload=True, steps=2),
     # long-context rungs: the gridded flash kernel streams K/V blocks, so
