@@ -37,10 +37,9 @@ def init_inference(model, config=None, params=None, topology=None, **kwargs):
 
         from deepspeed_tpu.module_inject.from_hf import from_hf
         compute_dtype = jnp.bfloat16 if ds_config.dtype == jnp.int8 else ds_config.dtype
-        model, params = from_hf(model, dtype=compute_dtype)
-        if ds_config.checkpoint is not None:
-            # explicit checkpoint wins over the module's own weights (the
-            # reference's meta-tensor convention: arch from the module,
-            # weights from the checkpoint)
-            params = None
+        # explicit checkpoint wins over the module's own weights (the
+        # reference's meta-tensor convention: arch from the module, weights
+        # from the checkpoint) — skip the state_dict conversion entirely
+        model, params = from_hf(model, dtype=compute_dtype,
+                                weights=ds_config.checkpoint is None)
     return InferenceEngine(model, ds_config, params=params, topology=topology)

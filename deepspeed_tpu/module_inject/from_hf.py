@@ -101,6 +101,7 @@ def _spec(model_type: str, hf):
             vocab_size=hf.vocab_size, hidden_size=hf.hidden_size,
             num_attention_heads=hf.num_attention_heads, num_kv_heads=kv,
             num_hidden_layers=hf.num_hidden_layers,
+            max_position_embeddings=getattr(hf, "max_position_embeddings", 2048),
             layer_norm_epsilon=hf.layer_norm_epsilon,
             rope_theta=getattr(hf, "rope_theta", 10000.0),
             new_decoder_architecture=getattr(hf, "new_decoder_architecture", False)), "falcon")
@@ -145,12 +146,19 @@ def _spec(model_type: str, hf):
                      f"convert manually via module_inject.load_hf_checkpoint")
 
 
-def from_hf(hf_model, dtype: Optional[Any] = None, **config_overrides):
+def from_hf(hf_model, dtype: Optional[Any] = None, weights: bool = True,
+            **config_overrides):
     """HF torch model → ``(flax module, converted params)``.
 
     ``dtype`` sets the compute dtype of the returned module (params stay at
     the checkpoint precision); extra kwargs override derived config fields
     (e.g. ``attention_backend="flash"``, ``fused_head_loss_chunk=1024``).
+
+    ``weights=False`` skips the state_dict conversion and returns
+    ``(module, None)`` — the reference's meta-tensor convention
+    (``inference/engine.py:336``): arch/config from the module, weights
+    loaded later from an explicit checkpoint. Avoids a full-model host
+    copy when the converted weights would be thrown away.
     """
     import importlib
 
@@ -167,5 +175,5 @@ def from_hf(hf_model, dtype: Optional[Any] = None, **config_overrides):
     cfg_cls = getattr(mod, _CONFIG_CLASS[family])
     cfg = cfg_cls(**kwargs)
     model = getattr(mod, cls_name)(cfg)
-    params = load_hf_checkpoint(hf_model, arch, cfg)
+    params = load_hf_checkpoint(hf_model, arch, cfg) if weights else None
     return model, params

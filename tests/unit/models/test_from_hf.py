@@ -136,3 +136,54 @@ def test_from_hf_biased_llama():
         want = hf_model(torch.tensor(ids)).logits.numpy()
     got = model.apply({"params": params}, jnp.asarray(ids, jnp.int32))
     np.testing.assert_allclose(np.asarray(got), want, atol=5e-4, rtol=3e-3)
+
+
+def test_from_hf_falcon_forwards_context_length():
+    """The Falcon spec forwards max_position_embeddings — the decode KV
+    cache is sized from it, so dropping it silently truncates long-context
+    Falcon checkpoints to the 2048 default."""
+    hf_model = transformers.FalconForCausalLM(transformers.FalconConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=4096, bias=False,
+        multi_query=True, attention_dropout=0.0, hidden_dropout=0.0)).eval()
+    model, _ = from_hf(hf_model)
+    assert model.config.max_position_embeddings == 4096
+
+
+def test_from_hf_weights_false_skips_state_dict():
+    """weights=False (the init_inference checkpoint= path) must not touch
+    the torch module's state_dict — that conversion is a full host copy of
+    the model, thrown away when explicit checkpoint weights win."""
+    hf_model = _hf("gpt2").eval()
+
+    def boom(*a, **k):
+        raise AssertionError("state_dict must not be read when weights=False")
+
+    hf_model.state_dict = boom
+    model, params = from_hf(hf_model, weights=False)
+    assert params is None
+    assert model.config.vocab_size == 128
+
+
+def test_init_inference_checkpoint_skips_conversion(tmp_path):
+    """init_inference(hf_module, checkpoint=...) loads weights from disk
+    without converting the module's own state_dict first."""
+    import deepspeed_tpu
+    from deepspeed_tpu.checkpoint.zero_to_fp32 import save_npz, _flatten
+
+    hf_model = _hf("gpt2").eval()
+    model, params = from_hf(hf_model)  # converted once, for the npz
+    npz = tmp_path / "model_weights.npz"
+    save_npz(str(npz), _flatten(jax.tree.map(np.asarray, params)))
+
+    def boom(*a, **k):
+        raise AssertionError("state_dict must not be read when checkpoint= is set")
+
+    hf_model.state_dict = boom
+    serve = deepspeed_tpu.init_inference(hf_model, dtype=jnp.float32,
+                                         replace_with_kernel_inject=False,
+                                         checkpoint=str(npz))
+    ids = np.zeros((1, 8), np.int32)
+    got = np.asarray(serve(ids))
+    want = np.asarray(model.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, want, atol=1e-5)
