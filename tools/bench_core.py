@@ -28,14 +28,13 @@ def enable_compile_cache():
 
 
 def build_engine(model_name, mb, seq, ds_overrides=None, **cfg_overrides):
-    """GPT-2 engine + batch at the bench methodology's defaults
-    (bf16, flash attention, remat). Returns (engine, batch, n_params)."""
+    """Engine + batch at the bench methodology's defaults (bf16, flash
+    attention, remat). ``model_name`` picks the family: ``bert_<preset>``
+    builds a BERT MLM engine (the reference's 64-TFLOPS headline workload,
+    BERT-large pretrain); anything else is a GPT-2 causal-LM preset.
+    Returns (engine, batch, n_params)."""
     import deepspeed_tpu
-    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
 
-    cfg = get_gpt2_config(model_name, n_positions=seq, remat=True,
-                          attention_backend="flash", dtype=jnp.bfloat16,
-                          **cfg_overrides)
     ds = {
         "train_batch_size": mb,
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.01}},
@@ -45,9 +44,27 @@ def build_engine(model_name, mb, seq, ds_overrides=None, **cfg_overrides):
         "steps_per_print": 10**9,
     }
     ds.update(ds_overrides or {})
-    engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2LMHeadModel(cfg), config=ds)
     rng = np.random.default_rng(0)
-    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (mb, seq)).astype(np.int32)}
+    if model_name.startswith("bert_"):
+        from deepspeed_tpu.models import BertForMaskedLM, bert_mlm_loss, get_bert_config
+
+        cfg_overrides.setdefault("max_position_embeddings", max(seq, 512))
+        cfg = get_bert_config(model_name.split("_", 1)[1], remat=True,
+                              attention_backend="flash", dtype=jnp.bfloat16,
+                              **cfg_overrides)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=BertForMaskedLM(cfg), config=ds, loss_fn=bert_mlm_loss)
+        ids = rng.integers(0, cfg.vocab_size, (mb, seq)).astype(np.int32)
+        labels = np.where(rng.random((mb, seq)) < 0.15, ids, -100).astype(np.int32)
+        batch = {"input_ids": ids, "labels": labels}
+    else:
+        from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+
+        cfg = get_gpt2_config(model_name, n_positions=seq, remat=True,
+                              attention_backend="flash", dtype=jnp.bfloat16,
+                              **cfg_overrides)
+        engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2LMHeadModel(cfg), config=ds)
+        batch = {"input_ids": rng.integers(0, cfg.vocab_size, (mb, seq)).astype(np.int32)}
     engine.initialize_state(batch)
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(engine.state.params))
     return engine, batch, n_params

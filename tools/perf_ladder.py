@@ -27,16 +27,21 @@ SEQ = 1024
 
 
 def run_rung(tag, model_name, mb, offload=False, steps=None, seq=None,
-             fused_xent=False):
-    ds_overrides = {}
+             fused_xent=False, ds=None):
+    ds_overrides = dict(ds or {})
     if offload:
         ds_overrides["zero_optimization"] = {
             "stage": 2,
             "offload_optimizer": {"device": "cpu", "pin_memory": True},
         }
-    overrides = {"vocab_size": 50304, "embed_onehot_grad": True}
-    if fused_xent:
-        overrides["fused_head_loss_chunk"] = 1024
+    if model_name.startswith("bert_"):
+        # lane-aligned vocab (30522 → 30592, x128); BERT has no causal LM
+        # head so the GPT-2 fused-xent/onehot knobs don't apply
+        overrides = {"vocab_size": 30592}
+    else:
+        overrides = {"vocab_size": 50304, "embed_onehot_grad": True}
+        if fused_xent:
+            overrides["fused_head_loss_chunk"] = 1024
     engine, batch, n_params = build_engine(
         model_name, mb, seq or SEQ, ds_overrides=ds_overrides, **overrides)
     if offload:
@@ -62,6 +67,19 @@ RUNGS = {
     # buffers off the OOM line at long L
     "350m_seq4k": dict(model_name="350m", mb=2, seq=4096, fused_xent=True),
     "350m_seq8k": dict(model_name="350m", mb=1, seq=8192, fused_xent=True),
+    # the reference's 64-TFLOPS headline workload: BERT-large pretrain at
+    # seq 128 (BASELINE.md row 1) — direct apples-to-apples rung
+    "bert_large_mb64": dict(model_name="bert_large", mb=64, seq=128),
+    "bert_large_mb128": dict(model_name="bert_large", mb=128, seq=128),
+    "bert_large_mb256": dict(model_name="bert_large", mb=256, seq=128),
+    # BERT-large ZeRO-1 + FusedAdam is the ladder's second judged config
+    # ("Adam" = the optax XLA-fused Adam, this repo's FusedAdam role; on
+    # one chip ZeRO-1's shards are trivially whole but the config path is
+    # the judged one)
+    "bert_large_seq512_mb32": dict(model_name="bert_large", mb=32, seq=512,
+                                   ds={"zero_optimization": {"stage": 1},
+                                       "optimizer": {"type": "Adam",
+                                                     "params": {"lr": 1e-4}}}),
 }
 
 
