@@ -146,7 +146,8 @@ class InferenceEngine:
             params = jax.tree.map(
                 lambda p: p.astype(cast_dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
         # -- TP weight placement (ReplaceWithTensorSlicing / AutoTP)
-        self.params, self.param_specs = tp_shard_params(params, self.module, topology, example)
+        self.params, self.param_specs = tp_shard_params(params, self.module, topology, example,
+                                                        policy=config.injection_policy)
 
         # -- int8 weight quantization (reference WeightQuantization applied
         # at checkpoint load; here on the already-sharded tree, engine.py:299)

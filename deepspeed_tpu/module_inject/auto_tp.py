@@ -65,12 +65,70 @@ class AutoTP:
         return None
 
     @staticmethod
-    def spec_for(path_parts: Sequence[str], shape: Sequence[int], tp_size: int) -> P:
+    def normalize_policy(policy) -> list:
+        """User ``injection_policy`` → [(path_substring, role), ...].
+
+        Accepts both forms: the reference's
+        ``{ModuleClass_or_name: ("attn.out_proj", ...)}`` where the tuple
+        lists the projections whose output needs an all-reduce (row
+        parallel — reference ``LinearAllreduce``, ``auto_tp.py:13``), and
+        the explicit ``{"path.substring": "row"|"column"|"vocab"|
+        "replicate"}`` mapping."""
+        rules = []
+        for key, val in (policy or {}).items():
+            if isinstance(val, str):
+                if val not in ("row", "column", "vocab", "replicate"):
+                    raise ValueError(f"injection_policy role {val!r} for {key!r}: expected "
+                                     "'row', 'column', 'vocab' or 'replicate'")
+                rules.append((str(key), val))
+            else:
+                for name in (val if isinstance(val, (tuple, list)) else (val,)):
+                    rules.append((str(name), "row"))
+        # most-specific (longest) substring wins: {"attn": "row",
+        # "attn.c_attn": "column"} must let the second rule reach c_attn
+        rules.sort(key=lambda r: len(r[0]), reverse=True)
+        return rules
+
+    @staticmethod
+    def warn_unmatched_policy(params, rules: list) -> None:
+        """Warn for policy rules that matched NO param path — the escape
+        hatch must not fail open silently (typos, torch-style paths)."""
+        if not rules:
+            return
+        paths = []
+        jax.tree_util.tree_map_with_path(
+            lambda path, leaf: paths.append("/".join(p.lower() for p in _path_parts(path))),
+            params)
+        from deepspeed_tpu.utils.logging import logger
+        for substr, role in rules:
+            s = substr.lower()
+            if not any(s in p or s in p.replace("/", ".") for p in paths):
+                logger.warning(f"injection_policy rule {substr!r} -> {role} matched no "
+                               f"param path; the override did NOT apply (param paths "
+                               f"look like {paths[0] if paths else '<empty>'!r})")
+
+    @staticmethod
+    def policy_role(path_parts: Sequence[str], rules: list) -> Optional[str]:
+        path = "/".join(p.lower() for p in path_parts)
+        dotted = path.replace("/", ".")
+        for substr, role in rules:
+            s = substr.lower()
+            if s in path or s in dotted:
+                return role
+        return None
+
+    @staticmethod
+    def spec_for(path_parts: Sequence[str], shape: Sequence[int], tp_size: int,
+                 policy_rules: Optional[list] = None) -> P:
         """PartitionSpec for one param. Kernels are [in, ..., out] (flax
         convention); biases follow the output dim of their layer."""
         if tp_size <= 1:
             return P()
-        role = AutoTP.classify(path_parts)
+        role = AutoTP.policy_role(path_parts, policy_rules) if policy_rules else None
+        if role == "replicate":
+            return P()
+        if role is None:
+            role = AutoTP.classify(path_parts)
         is_bias = path_parts and path_parts[-1] in ("bias",)
         if role is None and not is_bias and len(shape) == 2:
             # shape heuristic for unknown naming conventions (the reference
@@ -110,9 +168,15 @@ class AutoTP:
         return P()
 
     @staticmethod
-    def tp_parser(params, tp_size: int):
+    def tp_parser(params, tp_size: int, policy=None):
         """Emit a PartitionSpec pytree for a raw param tree
-        (reference ``AutoTP.tp_parser`` + ``ReplaceWithTensorSlicing``)."""
+        (reference ``AutoTP.tp_parser`` + ``ReplaceWithTensorSlicing``).
+        ``policy`` (user ``injection_policy``) overrides name classification
+        for matched paths."""
+        rules = AutoTP.normalize_policy(policy)
+        if rules:
+            AutoTP.warn_unmatched_policy(params, rules)
         return jax.tree_util.tree_map_with_path(
-            lambda path, leaf: AutoTP.spec_for(_path_parts(path), getattr(leaf, "shape", ()), tp_size),
+            lambda path, leaf: AutoTP.spec_for(_path_parts(path), getattr(leaf, "shape", ()),
+                                               tp_size, policy_rules=rules or None),
             params)

@@ -24,6 +24,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 import flax.linen as nn
 
 from deepspeed_tpu.module_inject.auto_tp import AutoTP
+from deepspeed_tpu.utils.tree import keypath_parts
 from deepspeed_tpu.parallel.sharding import DEFAULT_LOGICAL_RULES, logical_to_mesh_spec
 from deepspeed_tpu.parallel.topology import MeshTopology
 from deepspeed_tpu.utils.logging import log_dist
@@ -61,12 +62,15 @@ def replace_transformer_layer(model: nn.Module, config) -> nn.Module:
 
 
 def tp_shard_params(params, model: Optional[nn.Module], topology: MeshTopology,
-                    example_ids=None, rules=DEFAULT_LOGICAL_RULES):
+                    example_ids=None, rules=DEFAULT_LOGICAL_RULES, policy=None):
     """Shard a param tree over the ``tensor`` mesh axis.
 
     Annotated models (logical axis names) get exact Megatron layouts via the
     sharding rules; raw trees fall back to AutoTP name classification
-    (reference ``ReplaceWithTensorSlicing`` / ``AutoTP``).
+    (reference ``ReplaceWithTensorSlicing`` / ``AutoTP``). A user
+    ``injection_policy`` (reference ``init_inference(injection_policy=...)``,
+    ``replace_module.py:283``) overrides BOTH sources for the paths it
+    matches — it is the escape hatch for unrecognized naming conventions.
     """
     mesh = topology.mesh
 
@@ -97,7 +101,21 @@ def tp_shard_params(params, model: Optional[nn.Module], topology: MeshTopology,
         except Exception:
             specs = None
     if specs is None:
-        specs = AutoTP.tp_parser(params, topology.tensor_parallel_size)
+        specs = AutoTP.tp_parser(params, topology.tensor_parallel_size, policy=policy)
+    elif policy:
+        # policy-matched paths override the model's own logical annotations
+        prules = AutoTP.normalize_policy(policy)
+        AutoTP.warn_unmatched_policy(params, prules)
+        tp = topology.tensor_parallel_size
+
+        def override(path, spec, p):
+            parts = keypath_parts(path)
+            if AutoTP.policy_role(parts, prules) is None:
+                return spec
+            return AutoTP.spec_for(parts, getattr(p, "shape", ()), tp, policy_rules=prules)
+
+        specs = jax.tree_util.tree_map_with_path(override, specs, params,
+                                                 is_leaf=lambda x: isinstance(x, P))
     specs = jax.tree.map(lambda s, p: drop_indivisible(s, getattr(p, "shape", ())), specs, params,
                          is_leaf=lambda x: isinstance(x, P))
     shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P))

@@ -259,3 +259,69 @@ class TestBeamSearch:
         if eos in gen:
             first = int(np.argmax(gen == eos))
             assert (gen[first:] == eos).all(), gen
+
+
+def test_autotp_injection_policy_overrides():
+    """injection_policy (reference init_inference(injection_policy=...))
+    overrides name classification: reference-form tuples mark row-parallel
+    projections; explicit role strings force any layout."""
+    params = {
+        "blk": {"mixer": {"kernel": np.zeros((64, 64))},       # ambiguous square
+                "q_proj": {"kernel": np.zeros((64, 64))}},     # name says column
+    }
+    from jax.sharding import PartitionSpec as P
+    # reference form: tuple of names that need the output all-reduce (row)
+    specs = AutoTP.tp_parser(params, tp_size=4, policy={"SomeLayer": ("mixer",)})
+    assert specs["blk"]["mixer"]["kernel"] == P("tensor", None)
+    assert specs["blk"]["q_proj"]["kernel"] == P(None, "tensor")  # untouched
+    # explicit role form, overriding the built-in name vocabulary
+    specs = AutoTP.tp_parser(params, tp_size=4,
+                             policy={"q_proj": "replicate", "mixer": "column"})
+    assert specs["blk"]["q_proj"]["kernel"] == P()
+    assert specs["blk"]["mixer"]["kernel"] == P(None, "tensor")
+    with pytest.raises(ValueError):
+        AutoTP.normalize_policy({"x": "diagonal"})
+
+
+def test_injection_policy_reaches_serving_engine():
+    """init_inference(..., injection_policy=...) must change the served
+    weight layout (the config field used to be accepted and ignored)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+
+    cfg = get_gpt2_config("test")
+    model = GPT2LMHeadModel(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    import flax.linen as fnn
+    params = fnn.meta.unbox(params)
+    engine = deepspeed_tpu.init_inference(
+        model, params=params, mp_size=4, replace_with_kernel_inject=False,
+        injection_policy={"h_0/attn/c_attn": "replicate"})
+    from jax.sharding import PartitionSpec as P
+    spec = engine.param_specs["h_0"]["attn"]["c_attn"]["kernel"]
+    assert all(p is None for p in spec), spec  # replicated
+    # sibling layers keep their annotated/classified TP layout
+    flat = jax.tree.leaves(engine.param_specs, is_leaf=lambda x: isinstance(x, P))
+    assert any(s != P() for s in flat)
+
+
+def test_injection_policy_specificity_and_unmatched_warning(caplog):
+    """Longest substring wins (specific overrides general); rules matching
+    no path warn instead of failing open silently."""
+    import logging
+    params = {"blk": {"attn": {"c_attn": {"kernel": np.zeros((64, 192))},
+                               "c_proj": {"kernel": np.zeros((64, 64))}}}}
+    from jax.sharding import PartitionSpec as P
+    specs = AutoTP.tp_parser(params, tp_size=4,
+                             policy={"attn": "row", "attn/c_attn": "column"})
+    assert specs["blk"]["attn"]["c_attn"]["kernel"] == P(None, "tensor")  # specific
+    assert specs["blk"]["attn"]["c_proj"]["kernel"] == P("tensor", None)  # general
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+    ds_logger.addHandler(caplog.handler)  # ds logger has propagate=False
+    try:
+        with caplog.at_level(logging.WARNING):
+            AutoTP.tp_parser(params, tp_size=4,
+                             policy={"transformer.h.0.attn.c_proj": "replicate"})
+    finally:
+        ds_logger.removeHandler(caplog.handler)
+    assert any("matched no" in r.getMessage() for r in caplog.records)
