@@ -203,12 +203,17 @@ class BertModel(nn.Module):
         layer_cls = BertLayer
         if cfg.remat:
             layer_cls = nn.remat(BertLayer, static_argnums=(3,), prevent_cse=False)
+        from deepspeed_tpu.models.common import constrain_activation
+        # batch-parallel residual stream over fsdp-sharded weights — see
+        # constrain_activation (the ZeRO-3 weak-scaling invariant)
+        x = constrain_activation(x, "batch", "length", "embed")
         use_pld = cfg.progressive_layer_drop and pld_theta is not None and not deterministic
         for i in range(cfg.num_hidden_layers):
             # PLD depth scaling (paper eq. 6): deeper blocks drop more often
             keep_i = (1.0 - (i + 1) / cfg.num_hidden_layers * (1.0 - pld_theta)
                       if use_pld else None)
             x = layer_cls(cfg, name=f"layer_{i}")(x, attention_mask, deterministic, keep_i)
+            x = constrain_activation(x, "batch", "length", "embed")
 
         pooled = nn.Dense(features=cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                           kernel_init=nn.with_logical_partitioning(_init(), ("embed", "embed2")),

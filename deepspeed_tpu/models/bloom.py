@@ -166,8 +166,13 @@ class BloomForCausalLM(nn.Module):
         block_cls = BloomBlock
         if cfg.remat:
             block_cls = nn.remat(BloomBlock, prevent_cse=False)
+        from deepspeed_tpu.models.common import constrain_activation
+        # batch-parallel residual stream over fsdp-sharded weights — see
+        # constrain_activation (the ZeRO-3 weak-scaling invariant)
+        x = constrain_activation(x, "batch", "length", "embed")
         for i in range(cfg.n_layer):
             x = block_cls(cfg, decode, name=f"h_{i}")(x)
+            x = constrain_activation(x, "batch", "length", "embed")
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="ln_f")(x)
         if labels is not None and cfg.fused_head_loss_chunk > 0:

@@ -269,7 +269,12 @@ class GPT2LMHeadModel(nn.Module):
         if not deterministic and cfg.dropout > 0.0:
             x = nn.Dropout(rate=cfg.dropout)(x, deterministic=False)
 
-        from deepspeed_tpu.models.common import maybe_remat
+        from deepspeed_tpu.models.common import constrain_activation, maybe_remat
+        # pin the residual stream to batch-parallel sharding: without this
+        # GSPMD may replicate the batch over fsdp-sharded (ZeRO-3) weights
+        # and all-reduce per-layer contractions — per-chip bytes that grow
+        # with the mesh (see constrain_activation)
+        x = constrain_activation(x, "batch", "length", "embed")
         aux_total = jnp.zeros([], jnp.float32)
         use_pld = cfg.progressive_layer_drop and pld_theta is not None and not deterministic
         for i in range(cfg.n_layer):
@@ -278,6 +283,7 @@ class GPT2LMHeadModel(nn.Module):
             # PLD depth scaling (paper eq. 6): deeper blocks drop more often
             keep_i = 1.0 - (i + 1) / cfg.n_layer * (1.0 - pld_theta) if use_pld else None
             x, l_aux = block_cls(cfg, use_moe, decode, name=f"h_{i}")(x, deterministic, keep_i)
+            x = constrain_activation(x, "batch", "length", "embed")
             aux_total = aux_total + l_aux
         x = LayerNorm(cfg, name="ln_f")(x)
         if labels is not None and cfg.fused_head_loss_chunk > 0:

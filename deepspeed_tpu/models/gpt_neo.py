@@ -162,9 +162,14 @@ class GPTNeoForCausalLM(nn.Module):
             x = x + jnp.take(wpe, positions, axis=0).astype(cfg.dtype)[None]
         else:
             x = x + wpe[:l].astype(cfg.dtype)
+        from deepspeed_tpu.models.common import constrain_activation
+        # batch-parallel residual stream over fsdp-sharded weights — see
+        # constrain_activation (the ZeRO-3 weak-scaling invariant)
+        x = constrain_activation(x, "batch", "length", "embed")
         for i in range(cfg.num_hidden_layers):
             block_cls = maybe_remat(GPTNeoBlock, cfg, i, enabled=cfg.remat and not decode)
             x = block_cls(cfg, i, decode, name=f"h_{i}")(x)
+            x = constrain_activation(x, "batch", "length", "embed")
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="ln_f")(x)
         if labels is not None and cfg.fused_head_loss_chunk > 0:

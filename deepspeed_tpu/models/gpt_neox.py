@@ -170,8 +170,13 @@ class GPTNeoXForCausalLM(nn.Module):
         block_cls = GPTNeoXBlock
         if cfg.remat:
             block_cls = nn.remat(GPTNeoXBlock, prevent_cse=False)
+        from deepspeed_tpu.models.common import constrain_activation
+        # batch-parallel residual stream over fsdp-sharded weights — see
+        # constrain_activation (the ZeRO-3 weak-scaling invariant)
+        x = constrain_activation(x, "batch", "length", "embed")
         for i in range(cfg.num_hidden_layers):
             x = block_cls(cfg, decode, name=f"layers_{i}")(x)
+            x = constrain_activation(x, "batch", "length", "embed")
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="final_layer_norm")(x)
         if labels is not None and cfg.fused_head_loss_chunk > 0:

@@ -281,7 +281,10 @@ class LlamaForCausalLM(nn.Module):
         wte_value = wte.value if isinstance(wte, nn.meta.AxisMetadata) else wte
         x = jnp.take(wte_value, input_ids, axis=0).astype(cfg.dtype)
 
-        from deepspeed_tpu.models.common import maybe_remat
+        from deepspeed_tpu.models.common import constrain_activation, maybe_remat
+        # residual stream stays batch-parallel over fsdp-sharded weights —
+        # see constrain_activation (the ZeRO-3 weak-scaling invariant)
+        x = constrain_activation(x, "batch", "length", "embed")
         aux_total = jnp.zeros([], jnp.float32)
         for i in range(cfg.num_hidden_layers):
             use_moe = (cfg.moe_num_experts > 0
@@ -290,6 +293,7 @@ class LlamaForCausalLM(nn.Module):
                                     enabled=cfg.remat and not decode)
             x, l_aux = block_cls(cfg, use_moe, name=f"layers_{i}")(
                 x, positions, decode, attention_mask, deterministic)
+            x = constrain_activation(x, "batch", "length", "embed")
             aux_total = aux_total + l_aux
         x = RMSNorm(cfg, name="norm")(x)
         if labels is not None and cfg.fused_head_loss_chunk > 0:

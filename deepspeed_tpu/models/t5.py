@@ -237,10 +237,15 @@ class T5Stack(nn.Module):
         if cfg.remat:
             # decode is arg index 5 of T5Block.__call__ (static python bool)
             block_cls = nn.remat(T5Block, static_argnums=(5,), prevent_cse=False)
+        from deepspeed_tpu.models.common import constrain_activation
+        # batch-parallel residual stream over fsdp-sharded weights — see
+        # constrain_activation (the ZeRO-3 weak-scaling invariant)
+        x = constrain_activation(x, "batch", "length", "embed")
         for i in range(n):
             x, bias = block_cls(cfg, self.is_decoder, has_relative_bias=(i == 0),
                                 name=f"block_{i}")(
                 x, enc, bias, enc_mask, decode)
+            x = constrain_activation(x, "batch", "length", "embed")
         return T5LayerNorm(cfg, name="final_layer_norm")(x)
 
 

@@ -572,7 +572,11 @@ class DeepSpeedEngine:
             def micro(acc, xs):
                 mb, key = xs
                 key = jax.random.fold_in(key, dp_idx)
-                (_, loss), grads = jax.value_and_grad(self._loss_for, has_aux=True)(params, mb, key, scale)
+                # manual shard_map body: activation sharding constraints off
+                from deepspeed_tpu.models.common import activation_constraints_disabled
+                with activation_constraints_disabled():
+                    (_, loss), grads = jax.value_and_grad(self._loss_for, has_aux=True)(
+                        params, mb, key, scale)
                 grads = _cast_floating(grads, jnp.float32)
                 return jax.tree.map(jnp.add, acc, grads), loss
 

@@ -234,7 +234,13 @@ def qcomm_accumulate(loss_for, mesh, param_specs, grad_specs, batch, batch_spec,
         def micro(acc, xs):
             mb, key = xs
             key = jax.random.fold_in(key, dp_idx)  # decorrelate dropout across DP shards
-            (_, loss), grads = jax.value_and_grad(loss_for, has_aux=True)(full_params, mb, key, scale)
+            # activation sharding constraints must not fire inside this
+            # manual shard_map body (remat hides the mesh context from
+            # constrain_activation's own detection)
+            from deepspeed_tpu.models.common import activation_constraints_disabled
+            with activation_constraints_disabled():
+                (_, loss), grads = jax.value_and_grad(loss_for, has_aux=True)(
+                    full_params, mb, key, scale)
             grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
             return jax.tree.map(jnp.add, acc, grads), loss
 

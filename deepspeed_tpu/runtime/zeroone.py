@@ -162,7 +162,11 @@ class ZeroOneRunner:
         def micro(acc, xs):
             mb, key = xs
             key = jax.random.fold_in(key, dp_idx)
-            (_, loss), grads = jax.value_and_grad(eng._loss_for, has_aux=True)(params, mb, key, scale)
+            # manual shard_map body: activation sharding constraints off
+            from deepspeed_tpu.models.common import activation_constraints_disabled
+            with activation_constraints_disabled():
+                (_, loss), grads = jax.value_and_grad(eng._loss_for, has_aux=True)(
+                    params, mb, key, scale)
             return jax.tree.map(jnp.add, acc, jax.tree.map(lambda g: g.astype(jnp.float32), grads)), loss
 
         gas = eng.config.gradient_accumulation_steps

@@ -50,3 +50,29 @@ def test_zero3_per_chip_wire_bytes_flat_in_world_size():
     # doubling the mesh does not grow what each chip moves by more than the
     # (N-1)/N ring factor — allow 35% headroom for compiler variation
     assert b8 <= 1.35 * b4 <= 1.35 * 1.35 * b2, (b2, b4, b8)
+
+
+def test_zero3_no_batch_replication_at_scale():
+    """Regression: at realistic model scale GSPMD used to drop the batch
+    sharding after the fsdp-sharded embedding gather and replicate the
+    whole forward — per-layer activation all-reduces whose per-chip bytes
+    GREW with the mesh (22x from 8 to 256 chips). The activation
+    constraints (models/common.constrain_activation) pin the batch-parallel
+    strategy; per-chip payload must stay flat between 16 and 64 virtual
+    chips. Runs tools/scaling_report.py meshes in subprocesses (device
+    count is fixed at jax import, so the 8-device conftest can't host
+    this)."""
+    import importlib.util
+    import os
+    tools = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))), "tools")
+    spec = importlib.util.spec_from_file_location(
+        "scaling_report", os.path.join(tools, "scaling_report.py"))
+    scaling_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(scaling_report)
+
+    p16, _ = scaling_report.run_mesh(16)
+    p64, _ = scaling_report.run_mesh(64)
+    assert p16 > 0 and p64 > 0
+    # flat within ring-factor + compiler headroom; the broken plan gave 4x
+    assert p64 <= 1.35 * p16, (p16, p64)
