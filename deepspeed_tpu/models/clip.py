@@ -135,10 +135,14 @@ class CLIPTextModel(nn.Module):
         pos = pos.value if isinstance(pos, nn.meta.AxisMetadata) else pos
         b, l = input_ids.shape
         x = (jnp.take(tok, input_ids, axis=0) + pos[None, :l]).astype(cfg.dtype)
-        from deepspeed_tpu.models.common import maybe_remat
+        from deepspeed_tpu.models.common import constrain_activation, maybe_remat
+        # batch-parallel residual stream over fsdp-sharded weights — see
+        # constrain_activation (the ZeRO-3 weak-scaling invariant)
+        x = constrain_activation(x, "batch", "length", "embed")
         for i in range(cfg.num_hidden_layers):
             layer_cls = maybe_remat(CLIPEncoderLayer, cfg, i)
             x = layer_cls(cfg, name=f"layers_{i}")(x)
+            x = constrain_activation(x, "batch", "length", "embed")
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="final_layer_norm")(x)
         # pooled = hidden state at the EOS token: first occurrence of

@@ -70,6 +70,11 @@ def test_zero3_no_batch_replication_at_scale():
         "scaling_report", os.path.join(tools, "scaling_report.py"))
     scaling_report = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(scaling_report)
+    # pin the regression config regardless of ambient env (the tool reads
+    # MODEL/SEQ/TP/... from os.environ at import)
+    scaling_report.MODEL, scaling_report.SEQ = "125m", 128
+    scaling_report.VOCAB, scaling_report.TP = 50432, 1
+    scaling_report.MB_PER_CHIP = 1
 
     p16, _ = scaling_report.run_mesh(16)
     p64, _ = scaling_report.run_mesh(64)
