@@ -135,8 +135,11 @@ class InferenceEngine:
         if params is None and config.checkpoint is not None:
             params = _load_checkpoint_params(config.checkpoint, config.base_dir)
         if params is None:
-            params = nn.meta.unbox(
-                self.module.init(self._rng, example, **example_extra)["params"])
+            params = self.module.init(self._rng, example, **example_extra)["params"]
+        # callers may hand in boxed trees straight from model.init(); the
+        # TP spec derivation below needs raw arrays (boxed leaves have no
+        # .shape, so every spec would silently fall back to replicated)
+        params = nn.meta.unbox(params)
         # int8 dtype means QUANTIZED weights (reference dtype=torch.int8):
         # floats are cast to the serve dtype here and quantized after TP
         # sharding below — a raw astype(int8) would destroy the weights

@@ -74,3 +74,55 @@ def test_llama_7b_lowers_full_stack():
     engine, text = _lower(LlamaForCausalLM(cfg), ds,
                           MeshTopology(fsdp=2, tensor=2, sequence=2))
     assert _param_count(engine) > 6e9  # the real 7B count, planned and sharded
+
+
+def test_gpt_moe_350m_64e_lowers_under_ep():
+    """The ladder's MoE rung: GPT-MoE 350M-base x 64 experts, expert
+    parallel over expert=8 (8 local experts per device), ZeRO-1 for the
+    dense grads — the training graph must plan and lower."""
+    import jax.numpy as jnp
+    cfg = get_gpt2_config("350m", n_positions=128, dtype=jnp.bfloat16, remat=True,
+                          moe_num_experts=64, moe_layer_freq=2, moe_k=1)
+    ds = {"train_batch_size": 8,
+          "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+          "bf16": {"enabled": True},
+          "zero_optimization": {"stage": 1}}
+    engine, text = _lower(GPT2LMHeadModel(cfg), ds, MeshTopology(expert=8))
+    # 64 experts' FFNs dominate: far above the 355M dense base
+    assert _param_count(engine) > 1e9
+    # the dispatch collective only appears post-SPMD: compile the same
+    # topology at unit scale and assert the a2a is on the wire
+    import numpy as np
+    small = get_gpt2_config("test", moe_num_experts=8, moe_layer_freq=2, moe_k=1)
+    eng2, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(small), topology=MeshTopology(expert=8),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                "zero_optimization": {"stage": 1}})
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, small.vocab_size, (8, 32)).astype(np.int32)}
+    eng2.initialize_state(batch)
+    assert "all-to-all" in eng2.lower_train_step(batch).compile().as_text()
+
+
+def test_moe_serving_tp8_generates():
+    """The ladder's serving rung: expert-parallel GPT-MoE served through
+    init_inference at TP=8 on the virtual mesh — runs, not just lowers."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    cfg = get_gpt2_config("test", n_embd=128, n_head=8, moe_num_experts=8,
+                          moe_layer_freq=2, moe_k=1)
+    model = GPT2LMHeadModel(cfg)
+    ids = np.arange(2 * 8, dtype=np.int32).reshape(2, 8) % cfg.vocab_size
+    variables = model.init(jax.random.PRNGKey(0), jnp.asarray(ids), deterministic=True)
+    engine = deepspeed_tpu.init_inference(model, config={"dtype": "fp32"}, mp_size=8,
+                                          params=variables["params"])
+    out = engine.generate(ids, max_new_tokens=4)
+    assert out.shape == (2, 12)
+    assert np.isfinite(np.asarray(out)).all()
+    # TP actually engaged: at least one served weight is sharded on tensor
+    from jax.sharding import PartitionSpec as P
+    flat = jax.tree.leaves(engine.param_specs, is_leaf=lambda x: isinstance(x, P))
+    assert any("tensor" in str(s) for s in flat)

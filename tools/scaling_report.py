@@ -87,8 +87,13 @@ def main():
         results[n] = payload
         print(json.dumps({"mesh": n, "tp": TP, "per_chip_collective_bytes": payload,
                           "compile_s": secs}), flush=True)
+    if len(MESHES) < 2:
+        # one mesh measures nothing about scaling — say so, don't pass
+        print(json.dumps({"model": MODEL, "weak_scaling_flat": None,
+                          "note": "need >=2 mesh sizes to compare"}), flush=True)
+        return 2
     base_n = MESHES[0]
-    worst = max((results[n] / results[base_n] for n in MESHES[1:]), default=1.0)
+    worst = max(results[n] / results[base_n] for n in MESHES[1:])
     flat = worst <= 1.35  # (N-1)/N ring factor + compiler headroom
     print(json.dumps({"model": MODEL, "weak_scaling_flat": flat,
                       "max_payload_growth_vs_first": round(worst, 3)}), flush=True)
