@@ -74,6 +74,7 @@ def test_zero3_no_batch_replication_at_scale():
     # MODEL/SEQ/TP/... from os.environ at import)
     scaling_report.MODEL, scaling_report.SEQ = "125m", 128
     scaling_report.VOCAB, scaling_report.TP = 50432, 1
+    scaling_report.MOE = 0
     scaling_report.MB_PER_CHIP = 1
 
     p16, _ = scaling_report.run_mesh(16)

@@ -38,3 +38,13 @@ def test_serve_llama_example_smoke():
               "--mp-size", "2", "--max-new", "4"])
     assert p.returncode == 0, p.stderr[-2000:]
     assert "output shape (2, 12)" in p.stdout
+
+
+def test_rlhf_hybrid_example_smoke():
+    env = cpu_subprocess_env(8)  # the hybrid reshard path needs a real mesh
+    env["RLHF_ITERS"] = "4"
+    p = subprocess.run([sys.executable, "examples/rlhf_hybrid.py"], cwd=REPO,
+                       env=env, capture_output=True, text=True, timeout=600)
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = [l for l in p.stdout.splitlines() if l.startswith("iter ")]
+    assert len(lines) == 4 and "mean_reward=" in lines[-1], p.stdout[-800:]

@@ -32,6 +32,11 @@ VOCAB = int(os.environ.get("VOCAB", "50432"))
 # ladder shape: fsdp grows, tensor stays constant); per-chip payload must
 # still stay flat as the fsdp factor grows
 TP = int(os.environ.get("TP", "1"))
+# MOE=k switches to expert-parallel weak scaling (the GPT-MoE ladder
+# rung): the mesh axis is `expert` instead of `fsdp`, with k local
+# experts per chip (total experts = k * N). Flatness here means the a2a
+# dispatch + replicated-dense allreduce per chip don't grow with N.
+MOE = int(os.environ.get("MOE", "0"))
 
 CHILD = r"""
 import os, sys, time
@@ -46,18 +51,21 @@ from unit.runtime.test_qcomm import collective_payload_bytes
 
 n = {n}
 tp = {tp}
+moe = {moe}
 t0 = time.time()
-cfg = get_gpt2_config({model!r}, n_positions={seq}, vocab_size={vocab})
+extra = dict(moe_num_experts=moe * n, moe_layer_freq=2, moe_k=1) if moe else {{}}
+cfg = get_gpt2_config({model!r}, n_positions={seq}, vocab_size={vocab}, **extra)
+topo = MeshTopology(expert=n) if moe else MeshTopology(fsdp=n // tp, tensor=tp)
 engine, _, _, _ = deepspeed_tpu.initialize(
-    model=GPT2LMHeadModel(cfg), topology=MeshTopology(fsdp=n // tp, tensor=tp),
-    config={{"train_batch_size": {mb} * (n // tp),
+    model=GPT2LMHeadModel(cfg), topology=topo,
+    config={{"train_batch_size": {mb} * (n if moe else n // tp),
             "optimizer": {{"type": "AdamW", "params": {{"lr": 1e-3}}}},
             "bf16": {{"enabled": True}},
-            "zero_optimization": {{"stage": 3,
+            "zero_optimization": {{"stage": 1 if moe else 3,
                                   "stage3_param_persistence_threshold": 0}}}})
 rng = np.random.default_rng(0)
 batch = {{"input_ids": rng.integers(0, cfg.vocab_size,
-                                    ({mb} * (n // tp), {seq})).astype(np.int32)}}
+                                    ({mb} * (n if moe else n // tp), {seq})).astype(np.int32)}}
 engine.initialize_state(batch)
 hlo = engine.lower_train_step(batch).compile().as_text()
 print("RESULT", n, collective_payload_bytes(hlo), round(time.time() - t0, 1))
@@ -70,7 +78,7 @@ def run_mesh(n):
     env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n}"
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     code = CHILD.format(repo=repo, n=n, model=MODEL, seq=SEQ, vocab=VOCAB,
-                        mb=MB_PER_CHIP, tp=TP)
+                        mb=MB_PER_CHIP, tp=TP, moe=MOE)
     r = subprocess.run([sys.executable, "-c", code], env=env,
                        capture_output=True, text=True, timeout=1800)
     for line in r.stdout.splitlines():
@@ -81,11 +89,16 @@ def run_mesh(n):
 
 
 def main():
+    if MOE and TP > 1:
+        print(json.dumps({"error": "MOE mode scales the expert axis; combine "
+                          "with TP via the config-ladder tests instead"}), flush=True)
+        return 2
     results = {}
     for n in MESHES:
         payload, secs = run_mesh(n)
         results[n] = payload
-        print(json.dumps({"mesh": n, "tp": TP, "per_chip_collective_bytes": payload,
+        print(json.dumps({"mesh": n, "tp": TP, "moe": MOE,
+                          "per_chip_collective_bytes": payload,
                           "compile_s": secs}), flush=True)
     if len(MESHES) < 2:
         # one mesh measures nothing about scaling — say so, don't pass
