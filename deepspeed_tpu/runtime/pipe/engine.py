@@ -160,7 +160,7 @@ class PipelineEngine(DeepSpeedEngine):
     def _build_step_fns(self):
         cfg = self.config
         clip = cfg.gradient_clipping
-        fp16 = self.fp16_enabled
+        fp16 = self._fp16_mode
         grad_shardings = self.plan.grad_shardings()
         mesh = self.mesh
         pipe_loss = self._pipeline_loss_fn()

@@ -10,7 +10,7 @@ import jax.numpy as jnp
 
 import deepspeed_tpu
 from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
-from deepspeed_tpu.parallel.topology import FSDP_AXIS
+from deepspeed_tpu.parallel.topology import FSDP_AXIS, MeshTopology
 
 
 def make_model(**overrides):
@@ -202,3 +202,33 @@ def test_hpz_mesh_resolution():
     assert engine.topology.axis_size("data") == 2
     losses = train_losses(engine, steps=3)
     assert losses[-1] < losses[0]
+
+
+def test_reference_accessor_surface():
+    """User scripts written against the reference engine's accessor surface
+    (reference engine.py:474-855) keep working: ranks, mesh sizes, typed
+    config views."""
+    cfg = get_gpt2_config("test", n_layer=1)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(cfg),
+        topology=MeshTopology(data=2, fsdp=2, tensor=2),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "gradient_clipping": 0.7,
+                "steps_per_print": 17,
+                "fp16": {"enabled": True},
+                "zero_optimization": {"stage": 2}})
+    assert engine.global_rank == 0
+    assert engine.world_size == 1  # single host process
+    assert engine.dp_world_size == 4  # data x fsdp
+    assert engine.mp_world_size == 2
+    assert engine.gradient_clipping() == 0.7
+    assert engine.steps_per_print() == 17
+    assert engine.fp16_enabled() is True
+    assert engine.bfloat16_enabled() is False
+    assert engine.dynamic_loss_scale() is True  # loss_scale 0 => dynamic
+    assert engine.zero_offload_optimizer() is None
+    assert engine.sparse_gradients_enabled() is False
+    assert engine.wall_clock_breakdown() is False
+    # default config: no communication dtype override configured
+    assert engine.communication_data_type is None
