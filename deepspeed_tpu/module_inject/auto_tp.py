@@ -75,15 +75,31 @@ class AutoTP:
         the explicit ``{"path.substring": "row"|"column"|"vocab"|
         "replicate"}`` mapping."""
         rules = []
+
+        def add(substr, role, origin):
+            if role not in ("row", "column", "vocab", "replicate"):
+                raise ValueError(f"injection_policy role {role!r} for {origin!r}: expected "
+                                 "'row', 'column', 'vocab' or 'replicate'")
+            rules.append((str(substr), role))
+
         for key, val in (policy or {}).items():
+            if hasattr(val, "tp_rules"):
+                # a replace_policy.DSPolicy (class or instance): expand its
+                # per-arch role mapping (same role validation as strings)
+                expanded = val.tp_rules()
+                if not expanded:
+                    from deepspeed_tpu.utils.logging import logger
+                    logger.warning(f"injection_policy {getattr(val, '__name__', val)!r} for "
+                                   f"{key!r} carries no TP rules (generic/spatial policy) — "
+                                   f"it does not change any weight layout")
+                for substr, role in expanded.items():
+                    add(substr, role, val)
+                continue
             if isinstance(val, str):
-                if val not in ("row", "column", "vocab", "replicate"):
-                    raise ValueError(f"injection_policy role {val!r} for {key!r}: expected "
-                                     "'row', 'column', 'vocab' or 'replicate'")
-                rules.append((str(key), val))
+                add(key, val, key)
             else:
                 for name in (val if isinstance(val, (tuple, list)) else (val,)):
-                    rules.append((str(name), "row"))
+                    add(name, "row", key)
         # most-specific (longest) substring wins: {"attn": "row",
         # "attn.c_attn": "column"} must let the second rule reach c_attn
         rules.sort(key=lambda r: len(r[0]), reverse=True)

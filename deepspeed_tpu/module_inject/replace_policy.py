@@ -1,0 +1,118 @@
+"""Per-architecture injection policies — import parity with reference
+``module_inject/replace_policy.py``.
+
+The reference's policy classes (``containers/*.py``) know how to pull
+qkv/mlp tensors out of a specific HF torch layer class for kernel
+injection and TP slicing. Here weight conversion is owned by the
+converters (``load_checkpoint.py`` / ``from_hf.py``), so a policy reduces
+to what the serving path still needs: the architecture tag and the
+Megatron roles of its projection names. ``tp_rules()`` returns the
+explicit ``{path-substring: role}`` mapping consumable by
+``init_inference(injection_policy=...)`` / ``AutoTP`` — useful when
+serving a model whose param paths don't match AutoTP's built-in name
+vocabulary (e.g. a renamed fine-tune).
+"""
+
+
+class DSPolicy:
+    """Base policy (reference ``module_inject/policy.py`` ``DSPolicy``)."""
+
+    arch: str = ""
+    # projections whose OUTPUT needs the TP all-reduce (row parallel)
+    row_parallel: tuple = ()
+    # projections sharded on the output dim (column parallel)
+    column_parallel: tuple = ()
+
+    @classmethod
+    def tp_rules(cls) -> dict:
+        rules = {name: "row" for name in cls.row_parallel}
+        rules.update({name: "column" for name in cls.column_parallel})
+        return rules
+
+
+class HFGPT2LayerPolicy(DSPolicy):
+    arch = "gpt2"
+    row_parallel = ("attn/c_proj", "mlp/c_proj")
+    column_parallel = ("attn/c_attn", "mlp/c_fc")
+
+
+class HFBertLayerPolicy(DSPolicy):
+    arch = "bert"
+    row_parallel = ("attention/output/dense", "output/dense")
+    column_parallel = ("query", "key", "value", "intermediate/dense")
+
+
+class HFDistilBertLayerPolicy(DSPolicy):
+    arch = "distilbert"
+    row_parallel = ("attention/out_lin", "ffn/lin2")
+    column_parallel = ("q_lin", "k_lin", "v_lin", "ffn/lin1")
+
+
+class LLAMALayerPolicy(DSPolicy):
+    arch = "llama"
+    row_parallel = ("self_attn/o_proj", "mlp/down_proj")
+    column_parallel = ("q_proj", "k_proj", "v_proj", "gate_proj", "up_proj")
+
+
+class HFGPTJLayerPolicy(DSPolicy):
+    arch = "gptj"
+    row_parallel = ("attn/out_proj", "mlp/fc_out")
+    column_parallel = ("q_proj", "k_proj", "v_proj", "mlp/fc_in")
+
+
+class HFGPTNEOLayerPolicy(DSPolicy):
+    arch = "gpt_neo"
+    row_parallel = ("attention/out_proj", "mlp/c_proj")
+    column_parallel = ("q_proj", "k_proj", "v_proj", "mlp/c_fc")
+
+
+class GPTNEOXLayerPolicy(DSPolicy):
+    arch = "gpt_neox"
+    row_parallel = ("attention/dense", "dense_4h_to_h")
+    column_parallel = ("query_key_value", "dense_h_to_4h")
+
+
+class HFOPTLayerPolicy(DSPolicy):
+    arch = "opt"
+    row_parallel = ("self_attn/out_proj", "fc2")
+    column_parallel = ("q_proj", "k_proj", "v_proj", "fc1")
+
+
+class BLOOMLayerPolicy(DSPolicy):
+    arch = "bloom"
+    row_parallel = ("self_attention/dense", "dense_4h_to_h")
+    column_parallel = ("query_key_value", "dense_h_to_4h")
+
+
+class MegatronLayerPolicy(DSPolicy):
+    arch = "megatron"
+    row_parallel = ("attention/dense", "dense_4h_to_h")
+    column_parallel = ("query_key_value", "dense_h_to_4h")
+
+
+class HFCLIPLayerPolicy(DSPolicy):
+    arch = "clip"
+    row_parallel = ("self_attn/out_proj", "mlp/fc2")
+    column_parallel = ("q_proj", "k_proj", "v_proj", "mlp/fc1")
+
+
+class UNetPolicy(DSPolicy):
+    """Diffusers UNet (reference generic policy) — spatial fusions only;
+    see ``ops/spatial``."""
+    arch = "unet"
+
+
+class VAEPolicy(DSPolicy):
+    """Diffusers VAE (reference generic policy) — spatial fusions only."""
+    arch = "vae"
+
+
+# transformer-based policies (reference replace_policy.py:21)
+replace_policies = [
+    HFBertLayerPolicy, HFGPTNEOLayerPolicy, GPTNEOXLayerPolicy, HFGPTJLayerPolicy,
+    MegatronLayerPolicy, HFGPT2LayerPolicy, BLOOMLayerPolicy, HFOPTLayerPolicy,
+    HFCLIPLayerPolicy, HFDistilBertLayerPolicy, LLAMALayerPolicy,
+]
+
+# non-transformer-based policies (reference replace_policy.py:27)
+generic_policies = [UNetPolicy, VAEPolicy]

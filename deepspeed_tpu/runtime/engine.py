@@ -1588,12 +1588,13 @@ class DeepSpeedEngine:
 
     @property
     def dp_world_size(self) -> int:
-        t = self.topology
-        return t.mesh.shape["data"] * t.mesh.shape["fsdp"]
+        # expert x data x fsdp — the batch-sharding world the config's
+        # batch triangle resolves against (topology.data_parallel_size)
+        return self.topology.data_parallel_size
 
     @property
     def mp_world_size(self) -> int:
-        return self.topology.mesh.shape["tensor"]
+        return self.topology.tensor_parallel_size
 
     def dynamic_loss_scale(self) -> bool:
         # loss_scale == 0 selects dynamic scaling (reference convention)

@@ -325,3 +325,18 @@ def test_injection_policy_specificity_and_unmatched_warning(caplog):
     finally:
         ds_logger.removeHandler(caplog.handler)
     assert any("matched no" in r.getMessage() for r in caplog.records)
+
+
+def test_replace_policy_classes_drive_tp_rules():
+    """replace_policy policy classes (reference replace_policy.py surface)
+    expand to TP role rules when passed as injection_policy values."""
+    from deepspeed_tpu.module_inject import (HFGPT2LayerPolicy, generic_policies,
+                                             replace_policies)
+    assert len(replace_policies) == 11 and len(generic_policies) == 2
+    params = {"h_0": {"attn": {"c_attn": {"kernel": np.zeros((64, 192))},
+                               "c_proj": {"kernel": np.zeros((64, 64))}}}}
+    from jax.sharding import PartitionSpec as P
+    specs = AutoTP.tp_parser(params, tp_size=4,
+                             policy={"GPT2Block": HFGPT2LayerPolicy})
+    assert specs["h_0"]["attn"]["c_attn"]["kernel"] == P(None, "tensor")
+    assert specs["h_0"]["attn"]["c_proj"]["kernel"] == P("tensor", None)

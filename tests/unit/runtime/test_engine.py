@@ -232,3 +232,16 @@ def test_reference_accessor_surface():
     assert engine.wall_clock_breakdown() is False
     # default config: no communication dtype override configured
     assert engine.communication_data_type is None
+
+
+def test_dp_world_size_includes_expert_axis():
+    """dp_world_size must agree with the batch triangle's DP world
+    (expert x data x fsdp), not just data x fsdp."""
+    cfg = get_gpt2_config("test", n_layer=1, moe_num_experts=2, moe_layer_freq=1)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(cfg),
+        topology=MeshTopology(expert=2, data=2, fsdp=2),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}})
+    assert engine.dp_world_size == 8
+    assert engine.mp_world_size == 1
