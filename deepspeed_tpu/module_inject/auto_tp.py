@@ -125,11 +125,21 @@ class AutoTP:
 
     @staticmethod
     def policy_role(path_parts: Sequence[str], rules: list) -> Optional[str]:
-        path = "/".join(p.lower() for p in path_parts)
+        """Match policy rules against a param path. Multi-part rules
+        ("attn/c_proj", "attention.output.dense") substring-match the
+        joined path; single-token rules ("query", "value") match whole
+        path PARTS (exact or suffix, like :meth:`classify`) — raw
+        containment would turn e.g. "value" into a trap for any path
+        containing "value_head" or "key_value_cache"."""
+        low_parts = [p.lower() for p in path_parts]
+        path = "/".join(low_parts)
         dotted = path.replace("/", ".")
         for substr, role in rules:
             s = substr.lower()
-            if s in path or s in dotted:
+            if "/" in s or "." in s:
+                if s in path or s in dotted:
+                    return role
+            elif any(p == s or p.endswith("_" + s) for p in low_parts):
                 return role
         return None
 

@@ -15,6 +15,7 @@ stashes) collapses into XLA remat policies. What survives as real surface:
   no-op); ``cpu_checkpointing`` → ``jax.checkpoint`` offload policies.
 """
 
+import contextlib
 from typing import Any, Callable, Optional
 
 import jax
@@ -95,10 +96,72 @@ def reset():
 
 
 def model_parallel_cuda_manual_seed(seed):  # reference API parity: RNG forking
-    """No-op on TPU: flax threads explicit PRNG keys, so remat replays the
-    same dropout keys by construction (the reference must fork/restore CUDA
-    RNG states around recompute, checkpointing.py:366)."""
+    """Seeds the RNG tracker's named streams (reference
+    ``model_parallel_cuda_manual_seed`` ``checkpointing.py:198`` adds the
+    model-parallel stream at ``seed + 2718``). Remat determinism itself
+    needs none of this on TPU — flax threads explicit PRNG keys — but the
+    standard Megatron call sequence (``manual_seed`` then
+    ``get_rng_state_tracker().fork()``) must work unchanged."""
+    _RNG_TRACKER.reset()
+    _RNG_TRACKER.add("model-parallel-rng", int(seed) + 2718)
     return None
+
+
+class _RNGStatesTracker:
+    """Functional stand-in for reference ``CudaRNGStatesTracker``
+    (``checkpointing.py:121``): named jax PRNG keys with a ``fork``
+    context. Megatron-style code calls ``get_rng_state_tracker().fork()``
+    around model-parallel regions; here forking just scopes a named key —
+    determinism under remat comes from explicit key threading, not from
+    saving/restoring device RNG state."""
+
+    def __init__(self):
+        self._states = {}
+
+    def get_states(self):
+        return dict(self._states)
+
+    def set_states(self, states):
+        self._states = dict(states)
+
+    def add(self, name, seed):
+        if name in self._states:
+            raise Exception(f"rng state {name} already exists")
+        self._states[name] = jax.random.PRNGKey(int(seed))
+
+    def key(self, name="model-parallel-rng"):
+        """The current key for a named stream (split on every read)."""
+        if name not in self._states:
+            raise Exception(f"rng state {name} is not added")
+        self._states[name], out = jax.random.split(self._states[name])
+        return out
+
+    def reset(self):
+        self._states = {}
+
+    @contextlib.contextmanager
+    def fork(self, name="model-parallel-rng"):
+        # no device RNG to swap; the named stream simply advances
+        yield self.key(name)
+
+
+_RNG_TRACKER = _RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> _RNGStatesTracker:
+    """Reference ``get_cuda_rng_tracker`` analog (Megatron interop)."""
+    return _RNG_TRACKER
+
+
+class CheckpointFunction:
+    """Reference ``CheckpointFunction`` (:474) call-surface shim: the
+    torch.autograd.Function is ``.apply(run_function, *args)``; here that
+    maps onto :func:`checkpoint` (jax.checkpoint under the configured
+    policy)."""
+
+    @staticmethod
+    def apply(run_function, *args):
+        return checkpoint(run_function, *args)
 
 
 def checkpoint(function: Callable, *args) -> Any:

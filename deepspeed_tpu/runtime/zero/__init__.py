@@ -1,5 +1,6 @@
 from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
 from deepspeed_tpu.runtime.zero.partition_parameters import (GatheredParameters, Init,
+                                                             ZeroParamStatus,
                                                              register_external_parameter,
                                                              unregister_external_parameter)
 from deepspeed_tpu.runtime.zero.planner import ZeroPlan, build_plan, resolve_topology_axes

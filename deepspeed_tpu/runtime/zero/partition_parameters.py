@@ -15,6 +15,7 @@ exposes the full values of sharded params (jax assembles shards on read).
 """
 
 import contextlib
+import enum
 from typing import Optional
 
 import jax
@@ -33,6 +34,19 @@ def get_active_init() -> Optional["Init"]:
     helpers, not to the engine's master/compute dtypes (those come from the
     ds_config's bf16/fp16 sections)."""
     return _ACTIVE_INIT
+
+
+class ZeroParamStatus(enum.Enum):
+    """Reference ``partition_parameters.py:209`` param lifecycle states.
+
+    Under the declarative planner a parameter has no runtime lifecycle to
+    track — sharded at rest, gathered by XLA inside the step — so the only
+    state user code can observe is AVAILABLE (inside ``GatheredParameters``
+    / step functions) or NOT_AVAILABLE (a sharded leaf at rest). INFLIGHT
+    never occurs (no hand-rolled prefetch), kept for import parity."""
+    NOT_AVAILABLE = 1
+    INFLIGHT = 2
+    AVAILABLE = 3
 
 
 class Init:

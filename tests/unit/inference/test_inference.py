@@ -340,3 +340,15 @@ def test_replace_policy_classes_drive_tp_rules():
                              policy={"GPT2Block": HFGPT2LayerPolicy})
     assert specs["h_0"]["attn"]["c_attn"]["kernel"] == P(None, "tensor")
     assert specs["h_0"]["attn"]["c_proj"]["kernel"] == P("tensor", None)
+
+
+def test_policy_single_token_rules_match_parts_not_substrings():
+    """Single-token policy rules must match whole path parts; raw substring
+    containment would let 'value' capture 'value_head'/'key_value_cache'."""
+    params = {"blk": {"value": {"kernel": np.zeros((64, 64))},
+                      "value_head": {"kernel": np.zeros((64, 64))},
+                      "my_cache_of_values": {"kernel": np.zeros((64, 64))}}}
+    from jax.sharding import PartitionSpec as P
+    specs = AutoTP.tp_parser(params, tp_size=4, policy={"value": "column"})
+    assert specs["blk"]["value"]["kernel"] == P(None, "tensor")
+    assert specs["blk"]["my_cache_of_values"]["kernel"] == P()

@@ -29,3 +29,4 @@ def test_unknown_attribute_raises():
 def test_zero_namespace():
     assert hasattr(ds.zero, "Init")
     assert hasattr(ds.zero, "GatheredParameters")
+    assert ds.zero.ZeroParamStatus.AVAILABLE.value == 3  # reference enum parity
