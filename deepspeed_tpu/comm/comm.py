@@ -227,6 +227,129 @@ def send_recv(tensor, perm, group: AxisNames = None):
     return lax.ppermute(tensor, axes[0], perm)
 
 
+# -- torch.distributed-shaped aliases / SPMD translations ------------------
+# (reference comm.py exposes the full torch.distributed vocabulary; under
+# SPMD some ops collapse into others — each alias documents the mapping)
+
+def reduce_scatter_tensor(tensor, op: ReduceOp = ReduceOp.SUM, group: AxisNames = None,
+                          axis: int = 0):
+    """Alias of :func:`reduce_scatter` (reference ``comm.py:280`` names the
+    tensor-in/tensor-out variant this way)."""
+    return reduce_scatter(tensor, op=op, group=group, axis=axis)
+
+
+def all_to_all(tensor, group: AxisNames = None, split_axis: int = 0, concat_axis: int = 0):
+    """Alias of :func:`all_to_all_single`: jax's single-array all_to_all IS
+    the list-form exchange with the list stacked on ``split_axis``."""
+    return all_to_all_single(tensor, group=group, split_axis=split_axis,
+                             concat_axis=concat_axis)
+
+
+def reduce(tensor, dst: int = 0, op: ReduceOp = ReduceOp.SUM, group: AxisNames = None):
+    """Reduce-to-root (reference ``comm.py`` ``reduce``). SPMD has no
+    rank-private storage — every member computes the reduction, which IS
+    the root's value (``dst`` kept for signature parity)."""
+    del dst
+    return all_reduce(tensor, op=op, group=group)
+
+
+def monitored_barrier(group=None, timeout=None, wait_all_ranks=False, name="monitored_barrier"):
+    """Reference ``monitored_barrier``: rank-failure detection belongs to
+    the runtime (jax.distributed heartbeats), so this reduces to
+    :func:`barrier`."""
+    del timeout, wait_all_ranks
+    return barrier(group=group, name=name)
+
+
+def gather(tensor, dst: int = 0, group: AxisNames = None, axis: int = 0):
+    """Gather-to-root (reference ``comm.py`` ``gather``): under SPMD every
+    member materializes the gathered value (= the root's view)."""
+    del dst
+    return all_gather(tensor, group=group, axis=axis)
+
+
+def scatter(tensor, src: int = 0, group: AxisNames = None, axis: int = 0):
+    """Scatter from root (reference ``comm.py`` ``scatter``): each member
+    keeps its chunk of the ``src`` member's tensor along ``axis``. Lowered
+    as a masked psum_scatter — reduce-scatter cost, no full-size broadcast
+    temporary."""
+    axes = _normalize_axes(group)
+    size = _axis_size(axes)
+    if tensor.shape[axis] % size != 0:
+        raise ValueError(f"scatter dim {axis} of size {tensor.shape[axis]} must divide "
+                         f"evenly over the {size}-member group (torch.distributed "
+                         f"errors on unequal splits too)")
+    _maybe_log("scatter", tensor, axes)
+    idx = _group_index(axes)
+    masked = jnp.where(idx == src, tensor, jnp.zeros_like(tensor))
+    return lax.psum_scatter(masked, axes, scatter_dimension=axis, tiled=True)
+
+
+def send(tensor, dst, group=None, tag=0):
+    """One-sided point-to-point does not exist in the SPMD model — both
+    sides of a transfer appear in one program (reference send/recv become
+    ``ppermute`` pairs). Use :func:`send_recv` with an explicit
+    permutation instead."""
+    raise NotImplementedError(
+        "send/recv are one-sided torch.distributed ops; under SPMD use "
+        "deepspeed_tpu.comm.send_recv(tensor, perm=[(src, dst)], group=...)")
+
+
+def recv(tensor, src, group=None, tag=0):
+    """See :func:`send`."""
+    raise NotImplementedError(
+        "send/recv are one-sided torch.distributed ops; under SPMD use "
+        "deepspeed_tpu.comm.send_recv(tensor, perm=[(src, dst)], group=...)")
+
+
+def new_group(ranks=None, axes: AxisNames = None):
+    """Reference ``comm.py:181`` ``new_group``. Groups here ARE mesh
+    sub-axes: pass ``axes=("data", "fsdp")`` (or a single name) and get
+    back the normalized axis tuple used as ``group=`` everywhere.
+    Arbitrary rank lists cannot name a mesh sub-axis and are rejected with
+    guidance (the reference builds NCCL communicators from rank lists; the
+    SPMD analog is choosing/reshaping the mesh axes in MeshTopology)."""
+    if axes is not None:
+        return _normalize_axes(axes)
+    raise NotImplementedError(
+        "new_group(ranks=[...]) has no SPMD analog — groups are named mesh "
+        "axes; construct the MeshTopology with the axis layout you need and "
+        "pass group=('axis', ...) to collectives")
+
+
+def get_global_rank(group: AxisNames = None, group_rank: int = 0,
+                    coords: Optional[dict] = None) -> int:
+    """Translate a group-relative rank to a global rank (reference
+    ``utils.get_global_rank``): with groups = mesh sub-axes, the global
+    rank of group member ``group_rank`` follows from the mesh's row-major
+    axis order. ``coords`` fixes the coordinates on the NON-group axes
+    (``{"tensor": 1}``); axes not given default to coordinate 0 — under
+    SPMD there is no per-rank Python frame whose "own" coordinates could
+    be implied, so identifying a peer in another slice requires saying
+    which slice."""
+    from deepspeed_tpu.parallel.topology import get_topology
+    topo = get_topology()
+    if topo is None:
+        return int(group_rank)
+    mesh = topo.mesh
+    axes = _normalize_axes(group)
+    sizes = dict(mesh.shape)
+    # decompose group_rank into coords over the group axes (row-major)
+    pos = dict(coords or {})
+    for a in pos:
+        if a in axes:
+            raise ValueError(f"coords names group axis {a!r}; group axes are "
+                             f"addressed by group_rank")
+    rem = int(group_rank)
+    for a in reversed(axes):
+        pos[a] = rem % sizes[a]
+        rem //= sizes[a]
+    global_rank = 0
+    for a in mesh.axis_names:
+        global_rank = global_rank * sizes[a] + pos.get(a, 0)
+    return global_rank
+
+
 def _axis_size(axes):
     total = 1
     for a in axes:

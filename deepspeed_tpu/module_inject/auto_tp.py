@@ -111,17 +111,19 @@ class AutoTP:
         hatch must not fail open silently (typos, torch-style paths)."""
         if not rules:
             return
-        paths = []
+        all_parts = []
         jax.tree_util.tree_map_with_path(
-            lambda path, leaf: paths.append("/".join(p.lower() for p in _path_parts(path))),
-            params)
+            lambda path, leaf: all_parts.append(_path_parts(path)), params)
         from deepspeed_tpu.utils.logging import logger
         for substr, role in rules:
-            s = substr.lower()
-            if not any(s in p or s in p.replace("/", ".") for p in paths):
+            # same matcher the rules are applied with (policy_role), so a
+            # rule that would silently no-op is exactly what warns
+            if not any(AutoTP.policy_role(parts, [(substr, role)]) is not None
+                       for parts in all_parts):
+                sample = "/".join(all_parts[0]) if all_parts else "<empty>"
                 logger.warning(f"injection_policy rule {substr!r} -> {role} matched no "
                                f"param path; the override did NOT apply (param paths "
-                               f"look like {paths[0] if paths else '<empty>'!r})")
+                               f"look like {sample!r})")
 
     @staticmethod
     def policy_role(path_parts: Sequence[str], rules: list) -> Optional[str]:
@@ -139,7 +141,8 @@ class AutoTP:
             if "/" in s or "." in s:
                 if s in path or s in dotted:
                     return role
-            elif any(p == s or p.endswith("_" + s) for p in low_parts):
+            elif any(p == s or p.endswith(s) for p in low_parts):
+                # same suffix semantics as classify()'s built-in vocabulary
                 return role
         return None
 

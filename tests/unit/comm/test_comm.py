@@ -148,3 +148,71 @@ def test_axis_index_and_size(topo):
     np.testing.assert_array_equal(np.asarray(idxs), np.arange(8, dtype=np.float32))
     assert (np.asarray(sizes) == 8).all()
 
+
+
+class TestTorchDistributedShapedAliases:
+    """The reference comm surface's remaining vocabulary: aliases and SPMD
+    translations (reduce/gather/scatter/monitored_barrier/new_group)."""
+
+    def test_reduce_and_gather_match_allreduce_allgather(self, mesh8):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        def body(x):
+            return (dist.reduce(x, dst=0, group=("data", "fsdp")),
+                    dist.gather(x, dst=0, group=("data", "fsdp")))
+
+        x = jnp.arange(8.0)
+        r, g = jax.jit(jax.shard_map(body, mesh=mesh8.mesh, in_specs=P(("data", "fsdp")),
+                                     out_specs=(P(("data", "fsdp")), P(("data", "fsdp")))))(x)
+        assert float(jnp.unique(r)[0]) == float(x.sum())
+        np.testing.assert_array_equal(np.asarray(g)[:8], np.asarray(x))
+
+    def test_scatter_keeps_own_chunk(self, mesh8):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        def body(x):
+            # local x is [1, 8]; scatter its columns: member k keeps col k
+            return dist.scatter(x, src=3, group=("data", "fsdp"), axis=1)
+
+        # every member holds a DIFFERENT row; src=3's row must win
+        x = jnp.arange(8.0 * 8).reshape(8, 8)
+        out = jax.jit(jax.shard_map(body, mesh=mesh8.mesh, in_specs=P(("data", "fsdp")),
+                                    out_specs=P(("data", "fsdp"))))(x)
+        np.testing.assert_allclose(np.asarray(out).ravel(), np.asarray(x[3]).ravel())
+
+    def test_alias_and_guidance_surfaces(self):
+        assert dist.new_group(axes=("data", "fsdp")) == ("data", "fsdp")
+        with pytest.raises(NotImplementedError, match="mesh"):
+            dist.new_group(ranks=[0, 1])
+        with pytest.raises(NotImplementedError, match="send_recv"):
+            dist.send(None, dst=1)
+        with pytest.raises(NotImplementedError, match="send_recv"):
+            dist.recv(None, src=0)
+        assert dist.monitored_barrier() is None  # delegates to barrier
+
+
+    def test_get_global_rank_coords(self):
+        from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
+        set_topology(MeshTopology(data=4, tensor=2))
+        try:
+            # axis order: pipe, expert, data, fsdp, sequence, tensor — tensor fastest
+            assert dist.get_global_rank(group="tensor", group_rank=1,
+                                        coords={"data": 2}) == 2 * 2 + 1
+            assert dist.get_global_rank(group="data", group_rank=3) == 3 * 2
+            with pytest.raises(ValueError, match="group axis"):
+                dist.get_global_rank(group="tensor", group_rank=0, coords={"tensor": 1})
+        finally:
+            set_topology(None)
+
+    def test_scatter_rejects_indivisible(self, mesh8):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        def body(x):
+            return dist.scatter(jnp.ones((1, 10)) * x, group=("data", "fsdp"), axis=1)
+
+        with pytest.raises(ValueError, match="divide"):
+            jax.jit(jax.shard_map(body, mesh=mesh8.mesh, in_specs=P(("data", "fsdp")),
+                                  out_specs=P(("data", "fsdp"))))(jnp.arange(8.0))
