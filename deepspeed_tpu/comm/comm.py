@@ -336,10 +336,19 @@ def get_global_rank(group: AxisNames = None, group_rank: int = 0,
     sizes = dict(mesh.shape)
     # decompose group_rank into coords over the group axes (row-major)
     pos = dict(coords or {})
-    for a in pos:
+    for a, c in pos.items():
         if a in axes:
             raise ValueError(f"coords names group axis {a!r}; group axes are "
                              f"addressed by group_rank")
+        if a not in sizes:
+            raise ValueError(f"coords axis {a!r} is not a mesh axis {tuple(sizes)}")
+        if not 0 <= int(c) < sizes[a]:
+            raise ValueError(f"coords[{a!r}]={c} out of range for axis size {sizes[a]}")
+    group_size = 1
+    for a in axes:
+        group_size *= sizes[a]
+    if not 0 <= int(group_rank) < group_size:
+        raise ValueError(f"group_rank {group_rank} out of range for group size {group_size}")
     rem = int(group_rank)
     for a in reversed(axes):
         pos[a] = rem % sizes[a]

@@ -20,8 +20,15 @@ def _f32p(a: np.ndarray):
 
 
 class DeepSpeedCPUAdam:
+    """Host Adam over the AVX2 C++ kernel (reference ``ops/adam/cpu_adam.py``
+    ``DeepSpeedCPUAdam``). The reference signature leads with
+    ``model_params`` (a torch param list the optimizer mutates); here the
+    engine's offload path feeds explicit numpy (param, grad) pairs per
+    step, so ``model_params`` is accepted for signature parity and ignored —
+    pass the numpy arrays to ``step``/``step_single`` instead."""
 
     def __init__(self,
+                 model_params=None,
                  lr: float = 1e-3,
                  betas: Tuple[float, float] = (0.9, 0.999),
                  eps: float = 1e-8,

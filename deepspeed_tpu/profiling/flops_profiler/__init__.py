@@ -1,0 +1,3 @@
+from deepspeed_tpu.profiling.flops_profiler.profiler import (FlopsProfiler,
+                                                              get_model_profile,
+                                                              profile_engine_step)

@@ -16,6 +16,7 @@ import sys
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 
 
 def _num(x) -> str:
@@ -183,3 +184,61 @@ def profile_engine_step(engine, device_batch, rng, step_latency_s=None,
     else:
         print(report, file=sys.stderr)
     return report
+
+
+def get_model_profile(model, input_shape=None, args=(), kwargs=None,
+                      print_profile=True, detailed=True, module_depth=-1,
+                      as_string=False, output_file=None, ignore_modules=None,
+                      params=None):
+    """Standalone model profile (reference ``get_model_profile``,
+    ``flops_profiler/profiler.py``): returns ``(flops, macs, params)`` for
+    ONE forward pass without building an engine.
+
+    ``input_shape`` is the token-id shape (e.g. ``(1, 128)``); extra
+    positional/keyword args pass through to ``model.apply``. FLOPs come
+    from XLA's ``cost_analysis`` of the compiled forward — the measured
+    program, not per-op bookkeeping (the reference monkey-patches torch
+    functionals instead, ``:847``). ``macs`` uses the flops/2 matmul
+    convention; ``as_string`` formats like the reference."""
+    import numpy as np
+
+    kwargs = dict(kwargs or {})
+    if input_shape is None:
+        raise ValueError("get_model_profile needs input_shape (token-id shape)")
+    ids = jnp.zeros(tuple(int(d) for d in input_shape), jnp.int32)
+    if params is None:
+        import flax.linen as nn
+        params = nn.meta.unbox(jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0), ids, *args, **kwargs))["params"])
+        params = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), params)
+    # single-device whole-model numbers like the reference: an ambient
+    # topology would turn this into an SPMD program with PER-DEVICE costs
+    from deepspeed_tpu.parallel.topology import get_topology, set_topology
+    prev = get_topology()
+    set_topology(None)
+    try:
+        compiled = jax.jit(lambda p, i: model.apply({"params": p}, i, *args, **kwargs)
+                           ).lower(params, ids).compile()
+    finally:
+        set_topology(prev)
+    cost = compiled_cost(compiled)
+    flops = int(cost.get("flops", 0.0))
+    macs = flops // 2
+    n_params = params_count(params)
+    if print_profile:
+        lines = [
+            "-------------------------- Model profile --------------------------",
+            f"params:              {_num(n_params)}",
+            f"fwd flops:           {_num(flops)}",
+            f"fwd macs:            {_num(macs)}",
+            f"fwd bytes accessed:  {_num(int(cost.get('bytes accessed', 0.0)))}",
+        ]
+        report = "\n".join(lines)
+        if output_file:
+            with open(output_file, "w") as f:
+                f.write(report)
+        else:
+            print(report, file=sys.stderr)
+    if as_string:
+        return _num(flops), _num(macs), _num(n_params)
+    return flops, macs, n_params

@@ -85,8 +85,8 @@ def _truncate_seq(batch, seqlen: int):
 
 
 def _global_norm(tree):
-    leaves = [jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree.leaves(tree)]
-    return jnp.sqrt(sum(leaves))
+    from deepspeed_tpu.runtime.utils import global_norm_l2
+    return global_norm_l2(tree)
 
 
 class DeepSpeedEngine:

@@ -1,0 +1,59 @@
+"""``deepspeed.runtime.utils`` import-path parity (reference
+``runtime/utils.py``): the grab-bag module reference user code imports
+``see_memory_usage`` / ``clip_grad_norm_`` / ``get_global_norm`` from.
+The real implementations live in ``utils.memory`` and as jit-safe
+functional helpers here (torch's in-place ``clip_grad_norm_`` mutates
+grads; jax returns new trees)."""
+import os
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.memory import see_memory_usage  # noqa: F401
+
+
+def get_global_norm(norm_list):
+    """Combine per-group norms into one global norm (reference
+    ``runtime/utils.py`` ``get_global_norm``: sqrt of sum of squares)."""
+    total = 0.0
+    for n in norm_list:
+        total = total + jnp.asarray(n, jnp.float32) ** 2
+    return jnp.sqrt(total)
+
+
+def global_norm_l2(tree):
+    """sqrt(sum of squares) over a pytree in fp32 — THE global-norm
+    implementation (the engine's step functions use this same helper)."""
+    leaves = [jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree.leaves(tree)]
+    return jnp.sqrt(sum(leaves))
+
+
+def get_grad_norm(grads, norm_type: float = 2.0):
+    """Global gradient p-norm over a pytree (reference ``get_grad_norm``
+    supports arbitrary p plus inf)."""
+    leaves = [g for g in jax.tree.leaves(grads) if hasattr(g, "dtype")]
+    if norm_type == float("inf"):
+        return jnp.max(jnp.asarray([jnp.max(jnp.abs(g)) for g in leaves]))
+    p = float(norm_type)
+    if p <= 0:
+        raise ValueError(f"norm_type must be positive or inf, got {norm_type}")
+    if p == 2.0:
+        return global_norm_l2(grads)
+    total = sum(jnp.sum(jnp.abs(g.astype(jnp.float32)) ** p) for g in leaves)
+    return total ** (1.0 / p)
+
+
+def clip_grad_norm_(grads, max_norm: float, norm_type: float = 2.0):
+    """Functional grad clipping (reference ``clip_grad_norm_`` mutates
+    in-place; jax arrays are immutable so the CLIPPED TREE IS RETURNED —
+    use it). Returns ``(clipped_grads, total_norm)``."""
+    total_norm = get_grad_norm(grads, norm_type)
+    factor = jnp.minimum(1.0, max_norm / (total_norm + 1e-6))
+    return jax.tree.map(lambda g: g * factor, grads), total_norm
+
+
+def ensure_directory_exists(filename: str) -> None:
+    """mkdir -p for a file's parent (reference ``ensure_directory_exists``)."""
+    dirname = os.path.dirname(filename)
+    if dirname:
+        os.makedirs(dirname, exist_ok=True)

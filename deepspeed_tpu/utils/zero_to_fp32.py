@@ -1,0 +1,15 @@
+"""``deepspeed.utils.zero_to_fp32`` import-path parity: the reference
+ships this consolidation tool as ``deepspeed/utils/zero_to_fp32.py`` (and
+copies it into every checkpoint directory); the implementation here lives
+in ``deepspeed_tpu.checkpoint.zero_to_fp32`` — this module re-exports the
+public functions and the CLI ``main`` so both import paths (and
+``python -m deepspeed_tpu.utils.zero_to_fp32``) work."""
+from deepspeed_tpu.checkpoint.zero_to_fp32 import (  # noqa: F401
+    convert_zero_checkpoint_to_fp32_state_dict,
+    get_fp32_state_dict_from_zero_checkpoint,
+    load_state_dict_from_npz,
+    main,
+)
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -61,3 +61,21 @@ def test_profiler_module_table_from_flax(tmp_path):
     prof = FlopsProfiler(model)
     table = prof.module_table(jnp.zeros((1, 16), jnp.int32))
     assert "flops" in table and "GPT2LMHeadModel" in table
+
+
+def test_get_model_profile_standalone():
+    """Reference get_model_profile surface: (flops, macs, params) for one
+    forward without an engine, numbers consistent with each other."""
+    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+    from deepspeed_tpu.profiling.flops_profiler import get_model_profile
+
+    model = GPT2LMHeadModel(get_gpt2_config("test"))
+    flops, macs, params = get_model_profile(model, input_shape=(2, 16),
+                                            print_profile=False)
+    assert flops > 0 and macs == flops // 2 and params > 0
+    # doubling the batch ~doubles fwd flops
+    flops2, _, _ = get_model_profile(model, input_shape=(4, 16), print_profile=False)
+    assert 1.5 < flops2 / flops < 2.5
+    fs, ms, ps = get_model_profile(model, input_shape=(2, 16), print_profile=False,
+                                   as_string=True)
+    assert all(isinstance(x, str) for x in (fs, ms, ps))

@@ -49,3 +49,21 @@ def test_on_device_disabled_passthrough():
     with OnDevice(device="meta", enabled=False) as ctx:
         tree = ctx.init(model, jax.random.PRNGKey(0), ids, deterministic=True)
     assert not isinstance(jax.tree.leaves(tree)[0], jax.ShapeDtypeStruct)
+
+
+def test_runtime_utils_import_path_parity():
+    """Reference user code imports from deepspeed.runtime.utils and
+    deepspeed.utils.zero_to_fp32 — both paths must resolve here."""
+    from deepspeed_tpu.runtime.utils import (clip_grad_norm_, ensure_directory_exists,
+                                             get_global_norm, get_grad_norm,
+                                             see_memory_usage)
+    from deepspeed_tpu.utils.zero_to_fp32 import get_fp32_state_dict_from_zero_checkpoint  # noqa: F401
+
+    grads = {"a": jnp.full((4,), 3.0), "b": jnp.full((2,), 4.0)}
+    gn = float(get_grad_norm(grads))
+    assert gn == pytest.approx((9 * 4 + 16 * 2) ** 0.5)
+    assert float(get_grad_norm(grads, float("inf"))) == 4.0
+    clipped, total = clip_grad_norm_(grads, max_norm=1.0)
+    assert float(total) == pytest.approx(gn)
+    assert float(get_grad_norm(clipped)) == pytest.approx(1.0, rel=1e-4)
+    assert float(get_global_norm([3.0, 4.0])) == pytest.approx(5.0)
