@@ -17,6 +17,26 @@ from deepspeed_tpu import comm
 
 __git_hash__ = None
 __git_branch__ = None
+git_hash = None
+git_branch = None
+# reference parity: deepspeed.version is the version STRING (its module
+# form lives at git_version_info) — this intentionally shadows attribute
+# access to the version.py submodule; import it via
+# `from deepspeed_tpu.version import ...` (unaffected)
+version = __version__
+import re as _re
+
+_m = _re.match(r"(\d+)\.(\d+)\.(\d+)", __version__)
+__version_major__, __version_minor__, __version_patch__ = (
+    (int(_m.group(1)), int(_m.group(2)), int(_m.group(3))) if _m else (0, 0, 0))
+HAS_TRITON = False  # reference flag (Triton kernels; TPU uses Pallas)
+
+# typing aliases (reference runtime/engine.py DeepSpeedOptimizerCallable /
+# DeepSpeedSchedulerCallable: factories receiving params / optimizer)
+from typing import Any as _Any, Callable as _Callable
+
+DeepSpeedOptimizerCallable = _Callable[..., _Any]
+DeepSpeedSchedulerCallable = _Callable[..., _Any]
 
 _LAZY = {
     "initialize": ("deepspeed_tpu.runtime.entry", "initialize"),
@@ -41,6 +61,16 @@ _LAZY = {
     "ops": ("deepspeed_tpu.ops", None),
     "moe": ("deepspeed_tpu.moe", None),
     "pipe": ("deepspeed_tpu.pipe", None),
+    "runtime": ("deepspeed_tpu.runtime", None),
+    "DeepSpeedOptimizer": ("deepspeed_tpu.runtime", "DeepSpeedOptimizer"),
+    "ZeROOptimizer": ("deepspeed_tpu.runtime", "ZeROOptimizer"),
+    "ADAM_OPTIMIZER": ("deepspeed_tpu.runtime.constants", "ADAM_OPTIMIZER"),
+    "LAMB_OPTIMIZER": ("deepspeed_tpu.runtime.constants", "LAMB_OPTIMIZER"),
+    "add_tuning_arguments": ("deepspeed_tpu.runtime.lr_schedules", "add_tuning_arguments"),
+    "replace_transformer_layer": ("deepspeed_tpu.module_inject.replace_module",
+                                  "replace_transformer_layer"),
+    "revert_transformer_layer": ("deepspeed_tpu.module_inject.replace_module",
+                                 "revert_transformer_layer"),
 }
 
 

@@ -58,7 +58,12 @@ def replace_transformer_layer(model: nn.Module, config) -> nn.Module:
         return model
     new_cfg = dataclasses.replace(mcfg, **updates)
     log_dist(f"inference injection: {type(model).__name__} config updates {list(updates)}")
-    return type(model)(new_cfg)
+    rebuilt = type(model)(new_cfg)
+    # remember the pre-injection module so revert_transformer_layer can hand
+    # it back even when the caller rebound their variable (the reference
+    # usage pattern); keyed by identity — configs are tiny
+    _INJECTION_ORIGINALS[id(rebuilt)] = model
+    return rebuilt
 
 
 def tp_shard_params(params, model: Optional[nn.Module], topology: MeshTopology,
@@ -120,3 +125,19 @@ def tp_shard_params(params, model: Optional[nn.Module], topology: MeshTopology,
                          is_leaf=lambda x: isinstance(x, P))
     shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P))
     return jax.device_put(params, shardings), specs
+
+
+_INJECTION_ORIGINALS: dict = {}
+
+
+def revert_transformer_layer(orig_layer_impl=None, model=None, config=None, preln=False):
+    """Reference ``module_inject/inject.py`` ``revert_transformer_layer``:
+    swaps the injected modules back for the originals. The TPU injection is
+    non-destructive (``replace_transformer_layer`` returns a REBUILT
+    module), so reverting means returning the remembered pre-injection
+    module — including for callers who rebound their variable to the
+    injected one (the reference usage). Accepts both conventions:
+    ``revert_transformer_layer(orig_impl, model, config)`` and
+    ``revert_transformer_layer(model)``."""
+    target = model if model is not None else orig_layer_impl
+    return _INJECTION_ORIGINALS.get(id(target), target)

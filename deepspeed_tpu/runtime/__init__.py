@@ -1,0 +1,13 @@
+"""Runtime package (reference ``deepspeed/runtime/__init__.py`` exposes the
+optimizer marker base classes user code isinstance-checks against)."""
+
+
+class DeepSpeedOptimizer:
+    """Marker base (reference ``runtime/__init__.py`` ``DeepSpeedOptimizer``):
+    identifies optimizers the engine owns. The TPU engine drives optax
+    transforms inside the jitted step, so these markers exist for
+    isinstance-parity, not dispatch."""
+
+
+class ZeROOptimizer(DeepSpeedOptimizer):
+    """Marker base for ZeRO-sharded optimizers (reference ``ZeROOptimizer``)."""

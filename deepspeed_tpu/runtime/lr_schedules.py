@@ -108,3 +108,38 @@ def get_lr_schedule(name: str, params: dict) -> Callable:
     if name not in _SCHEDULES:
         raise ValueError(f"unknown lr schedule {name!r}; valid: {VALID_LR_SCHEDULES}")
     return _SCHEDULES[name](**params)
+
+
+def add_tuning_arguments(parser):
+    """Add convergence-tuning CLI args (reference ``lr_schedules.py``
+    ``add_tuning_arguments``): the LR-schedule choice plus each schedule's
+    hyperparameters, named exactly as the config keys."""
+    group = parser.add_argument_group("Convergence Tuning",
+                                      "Convergence tuning configurations")
+    group.add_argument("--lr_schedule", type=str, default=None,
+                       help=f"LR schedule for training (one of {VALID_LR_SCHEDULES}).")
+    # LRRangeTest
+    group.add_argument("--lr_range_test_min_lr", type=float, default=0.001)
+    group.add_argument("--lr_range_test_step_rate", type=float, default=1.0)
+    group.add_argument("--lr_range_test_step_size", type=int, default=1000)
+    def _bool(v):
+        return str(v).lower() in ("true", "1", "yes")
+
+    # reference uses a value-taking bool arg (`--lr_range_test_staircase
+    # True`); also allow the bare-flag form
+    group.add_argument("--lr_range_test_staircase", type=_bool, nargs="?",
+                       const=True, default=False)
+    # OneCycle
+    group.add_argument("--cycle_min_lr", type=float, default=0.01)
+    group.add_argument("--cycle_max_lr", type=float, default=0.1)
+    group.add_argument("--cycle_first_step_size", type=int, default=1000)
+    group.add_argument("--cycle_second_step_size", type=int, default=None)
+    group.add_argument("--decay_step_size", type=int, default=0)
+    group.add_argument("--decay_lr_rate", type=float, default=0.0)
+    # Warmup
+    group.add_argument("--warmup_min_lr", type=float, default=0.0)
+    group.add_argument("--warmup_max_lr", type=float, default=0.001)
+    group.add_argument("--warmup_num_steps", type=int, default=1000)
+    group.add_argument("--warmup_type", type=str, default="log",
+                       choices=("log", "linear"))
+    return parser

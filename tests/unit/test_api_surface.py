@@ -13,6 +13,11 @@ REFERENCE_EXPORTS = [
     "DeepSpeedTransformerLayer", "DeepSpeedTransformerConfig",
     "checkpointing", "get_accelerator", "init_distributed",
     "OnDevice", "logger", "log_dist", "__version__",
+    "DeepSpeedOptimizer", "ZeROOptimizer", "DeepSpeedOptimizerCallable",
+    "DeepSpeedSchedulerCallable", "ADAM_OPTIMIZER", "LAMB_OPTIMIZER",
+    "add_tuning_arguments", "replace_transformer_layer",
+    "revert_transformer_layer", "HAS_TRITON", "version",
+    "__version_major__", "runtime",
 ]
 
 
