@@ -113,3 +113,110 @@ class DeepSpeedAccelerator(abc.ABC):
 
     @abc.abstractmethod
     def get_op_builder(self, class_name: str): ...
+
+    # -- CUDA-vocabulary surface with shared TPU semantics ----------------
+    # (reference abstract_accelerator.py:118-177 streams/events, :178-190
+    # graph/amp hooks — XLA owns scheduling, so these are honest
+    # immediates/no-ops rather than unimplemented holes)
+
+    class _NullStream:
+        """XLA orders execution itself; a stream is a no-op context."""
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+        def synchronize(self):
+            return None
+
+        def wait_stream(self, other):
+            return None
+
+    class _Event:
+        """Host-clock event (reference CUDA events time device work; on
+        TPU wall-clock around ``block_until_ready`` is the analog — the
+        engine's timers do exactly that, ``utils/timer.py``)."""
+
+        def __init__(self, enable_timing: bool = False, **_):
+            self._t = None
+
+        def record(self, stream=None):
+            import time
+            self._t = time.perf_counter()
+
+        def synchronize(self):
+            return None
+
+        def query(self):
+            return True
+
+        def elapsed_time(self, end) -> float:
+            return (end._t - self._t) * 1e3
+
+    def Stream(self, *args, **kwargs):
+        return DeepSpeedAccelerator._NullStream()
+
+    def stream(self, stream_obj):
+        return stream_obj if hasattr(stream_obj, "__enter__") else self.Stream()
+
+    def current_stream(self, device_index: Optional[int] = None):
+        return DeepSpeedAccelerator._NullStream()
+
+    def default_stream(self, device_index: Optional[int] = None):
+        return DeepSpeedAccelerator._NullStream()
+
+    def Event(self, enable_timing: bool = False, **kwargs):
+        return DeepSpeedAccelerator._Event(enable_timing=enable_timing, **kwargs)
+
+    def random(self):
+        """The RNG module handle (reference returns ``torch.random``)."""
+        import jax
+
+        return jax.random
+
+    def default_generator(self, device_index: Optional[int] = None):
+        """A seeded PRNG key stands in for torch's Generator."""
+        import jax
+
+        return jax.random.PRNGKey(self.initial_seed())
+
+    def reset_peak_memory_stats(self, device_index: Optional[int] = None) -> None:
+        return None  # peaks come from memory_stats() snapshots
+
+    def memory_reserved(self, device_index: Optional[int] = None) -> int:
+        return self.memory_allocated(device_index)
+
+    def max_memory_reserved(self, device_index: Optional[int] = None) -> int:
+        return self.max_memory_allocated(device_index)
+
+    def amp(self):
+        """Mixed precision is config-driven (bf16/fp16 blocks), not an
+        autocast context — the reference returns ``torch.cuda.amp``."""
+        return None
+
+    def lazy_call(self, callback):
+        """Reference defers one-time CUDA init; jit tracing gives laziness
+        for free, so the callback runs now."""
+        return callback()
+
+    def is_triton_supported(self) -> bool:
+        return False  # Pallas is the kernel DSL on TPU
+
+    def build_extension(self):
+        """torch.cpp_extension hook; our C++ goes through the ctypes op
+        builders (``ops/op_builder``)."""
+        from deepspeed_tpu.ops import op_builder
+
+        return op_builder
+
+    def export_envs(self) -> list:
+        """Env vars the launcher forwards to workers (reference lists
+        NCCL/PYTHONPATH prefixes)."""
+        return ["JAX", "XLA", "LIBTPU", "TPU", "PYTHON"]
+
+    def is_pinned(self, array) -> bool:
+        """Host numpy buffers are always directly DMA-able by the runtime;
+        there is no separate pinned pool to test membership of."""
+        return True

@@ -11,7 +11,7 @@ from deepspeed_tpu.module_inject.load_checkpoint import (load_hf_checkpoint, loa
                                                          load_hf_gpt_neo, load_hf_clip_text)
 from deepspeed_tpu.module_inject.from_hf import from_hf
 from deepspeed_tpu.module_inject.replace_module import (generic_injection, replace_transformer_layer,
-                                                        tp_shard_params)
+                                                        revert_transformer_layer, tp_shard_params)
 from deepspeed_tpu.module_inject.replace_policy import (BLOOMLayerPolicy, DSPolicy,
                                                         GPTNEOXLayerPolicy, HFBertLayerPolicy,
                                                         HFCLIPLayerPolicy, HFDistilBertLayerPolicy,
@@ -22,7 +22,7 @@ from deepspeed_tpu.module_inject.replace_policy import (BLOOMLayerPolicy, DSPoli
                                                         generic_policies, replace_policies)
 
 __all__ = ["AutoTP", "from_hf", "LinearAllreduce", "LinearLayer", "load_hf_checkpoint", "load_hf_gpt2", "load_hf_llama", "load_hf_opt", "load_hf_gpt_neox", "load_hf_bloom", "load_hf_t5", "load_hf_falcon", "load_hf_gptj", "load_hf_bert", "load_hf_distilbert", "load_hf_gpt_neo", "load_hf_clip_text", "generic_injection",
-           "replace_transformer_layer", "tp_shard_params",
+           "replace_transformer_layer", "revert_transformer_layer", "tp_shard_params",
            "DSPolicy", "HFBertLayerPolicy", "HFGPT2LayerPolicy", "LLAMALayerPolicy",
            "BLOOMLayerPolicy", "GPTNEOXLayerPolicy", "HFCLIPLayerPolicy",
            "HFDistilBertLayerPolicy", "HFGPTJLayerPolicy", "HFGPTNEOLayerPolicy",

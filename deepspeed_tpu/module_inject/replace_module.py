@@ -61,8 +61,11 @@ def replace_transformer_layer(model: nn.Module, config) -> nn.Module:
     rebuilt = type(model)(new_cfg)
     # remember the pre-injection module so revert_transformer_layer can hand
     # it back even when the caller rebound their variable (the reference
-    # usage pattern); keyed by identity — configs are tiny
+    # usage pattern). Keyed by identity with a weakref finalizer: the entry
+    # dies with the rebuilt module, so no leak and no stale id-reuse hit.
+    import weakref
     _INJECTION_ORIGINALS[id(rebuilt)] = model
+    weakref.finalize(rebuilt, _INJECTION_ORIGINALS.pop, id(rebuilt), None)
     return rebuilt
 
 

@@ -101,3 +101,27 @@ def test_op_builder_seam():
     assert cls is not None
     builder = a.create_op_builder("AsyncIOBuilder")
     assert builder is not None and hasattr(builder, "is_compatible")
+
+
+def test_cuda_vocabulary_surface():
+    """The reference ABC's stream/event/amp vocabulary must exist with
+    honest TPU semantics (no-op streams, host-clock events)."""
+    import time
+
+    acc = get_accelerator()
+    with acc.stream(acc.Stream()):
+        pass
+    acc.current_stream().synchronize()
+    acc.default_stream().wait_stream(None)
+    e1, e2 = acc.Event(enable_timing=True), acc.Event(enable_timing=True)
+    e1.record(); time.sleep(0.01); e2.record()
+    assert e2.query() and e1.elapsed_time(e2) >= 5.0  # ms
+    assert acc.is_triton_supported() is False
+    assert acc.memory_reserved() == acc.memory_allocated()
+    assert acc.lazy_call(lambda: 41) == 41
+    key = acc.default_generator()
+    import numpy as np
+    assert np.asarray(key).shape[-1] == 2  # a PRNG key
+    assert any(p.startswith("XLA") or p.startswith("JAX") for p in acc.export_envs())
+    assert acc.is_pinned(np.zeros(4)) is True
+    assert acc.build_extension() is not None
