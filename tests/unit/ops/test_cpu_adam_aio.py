@@ -205,3 +205,35 @@ def test_engine_nvme_offload_trains(tmp_path):
     assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
     swap_files = list((tmp_path / "optimizer").glob("exp_avg_*.bin"))
     assert len(swap_files) > 0, "no NVMe swap files created"
+
+
+def test_aio_bench_tool_smoke(tmp_path, monkeypatch):
+    """tools/aio_bench.py (the reference aio_bench_perf_sweep role) runs a
+    tiny sweep and emits the best-config JSON the swap config consumes."""
+    from deepspeed_tpu.ops.op_builder import AsyncIOBuilder
+    if not AsyncIOBuilder().is_compatible():
+        pytest.skip("no C++ compiler")
+    import importlib.util
+    import json
+    import os as _os
+
+    tools = _os.path.join(_os.path.dirname(_os.path.dirname(_os.path.dirname(
+        _os.path.dirname(_os.path.abspath(__file__))))), "tools")
+    monkeypatch.setenv("AIO_DIR", str(tmp_path))
+    monkeypatch.setenv("AIO_MB", "8")
+    monkeypatch.setenv("AIO_THREADS", "2")
+    monkeypatch.setenv("AIO_BLOCKS_MB", "4")
+    spec = importlib.util.spec_from_file_location(
+        "aio_bench", _os.path.join(tools, "aio_bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    import io
+    import contextlib
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = mod.main()
+    assert rc == 0
+    lines = [json.loads(l) for l in buf.getvalue().splitlines()]
+    assert any("best" in l for l in lines)
+    best = [l for l in lines if "best" in l][0]["best"]
+    assert set(best) == {"thread_count", "block_size", "use_direct"}
