@@ -35,6 +35,7 @@ struct AioHandle {
     std::condition_variable done_cv;
     std::atomic<int64_t> inflight{0};
     std::atomic<int64_t> errors{0};
+    std::atomic<int64_t> direct_fallbacks{0};  // direct-requested ops that ran buffered
     bool stop = false;
     bool direct = false;  // O_DIRECT data path (page-cache bypass)
 
@@ -112,7 +113,8 @@ bool read_all_buffered(int fd, char* dst, int64_t nbytes, off_t base) {
     return true;
 }
 
-bool write_all(const char* path, const void* buf, int64_t nbytes, bool use_direct) {
+bool write_all(const char* path, const void* buf, int64_t nbytes, bool use_direct,
+               bool* fell_back = nullptr) {
     const char* src = (const char*)buf;
 #ifdef O_DIRECT
     if (use_direct && nbytes >= kAlign) {
@@ -155,9 +157,11 @@ bool write_all(const char* path, const void* buf, int64_t nbytes, bool use_direc
             return true;
         }
         // open with O_DIRECT failed (e.g. tmpfs): buffered fallback below
+        if (fell_back) *fell_back = true;
     }
 #else
     (void)use_direct;
+    if (fell_back) *fell_back = use_direct;
 #endif
     int fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
     if (fd < 0) return false;
@@ -166,7 +170,8 @@ bool write_all(const char* path, const void* buf, int64_t nbytes, bool use_direc
     return ok;
 }
 
-bool read_all(const char* path, void* buf, int64_t nbytes, bool use_direct) {
+bool read_all(const char* path, void* buf, int64_t nbytes, bool use_direct,
+              bool* fell_back = nullptr) {
     char* dst = (char*)buf;
 #ifdef O_DIRECT
     if (use_direct && nbytes >= kAlign) {
@@ -205,9 +210,12 @@ bool read_all(const char* path, void* buf, int64_t nbytes, bool use_direct) {
             }
             return true;
         }
+        // open with O_DIRECT failed (e.g. tmpfs): buffered fallback below
+        if (fell_back) *fell_back = true;
     }
 #else
     (void)use_direct;
+    if (fell_back) *fell_back = use_direct;
 #endif
     int fd = ::open(path, O_RDONLY);
     if (fd < 0) return false;
@@ -241,7 +249,9 @@ void aio_pwrite_async(void* h, const char* path, const void* buf, int64_t nbytes
     auto* handle = (AioHandle*)h;
     std::string p(path);
     handle->submit([handle, p, buf, nbytes] {
-        if (!write_all(p.c_str(), buf, nbytes, handle->direct)) ++handle->errors;
+        bool fb = false;
+        if (!write_all(p.c_str(), buf, nbytes, handle->direct, &fb)) ++handle->errors;
+        if (fb) ++handle->direct_fallbacks;
     });
 }
 
@@ -250,13 +260,18 @@ void aio_pread_async(void* h, const char* path, void* buf, int64_t nbytes) {
     auto* handle = (AioHandle*)h;
     std::string p(path);
     handle->submit([handle, p, buf, nbytes] {
-        if (!read_all(p.c_str(), buf, nbytes, handle->direct)) ++handle->errors;
+        bool fb = false;
+        if (!read_all(p.c_str(), buf, nbytes, handle->direct, &fb)) ++handle->errors;
+        if (fb) ++handle->direct_fallbacks;
     });
 }
 
 // block until every submitted op completes; returns the number of failed ops
 // since the last wait
 int aio_wait(void* h) { return ((AioHandle*)h)->wait(); }
+
+// direct-requested ops that silently ran buffered (tmpfs etc.) since create
+int64_t aio_direct_fallbacks(void* h) { return ((AioHandle*)h)->direct_fallbacks.load(); }
 
 // synchronous helpers (reference deepspeed_py_aio.cpp sync paths)
 int aio_write_sync(const char* path, const void* buf, int64_t nbytes) {

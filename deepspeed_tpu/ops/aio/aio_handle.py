@@ -41,6 +41,14 @@ class AsyncIOHandle:
         self._pending.clear()
         return errors
 
+    def direct_fallbacks(self) -> int:
+        """How many direct-requested ops silently ran buffered (O_DIRECT
+        refused, e.g. tmpfs) since this handle was created — callers
+        benchmarking the O_DIRECT path must check this."""
+        if hasattr(self.lib, "aio_direct_fallbacks") and self._h is not None:
+            return int(self.lib.aio_direct_fallbacks(self._h))
+        return 0
+
     def sync_pwrite(self, buf: np.ndarray, path: str) -> int:
         buf = np.ascontiguousarray(buf)
         return self.lib.aio_write_sync(str(path).encode(), buf.ctypes.data, buf.nbytes)

@@ -128,6 +128,8 @@ class AsyncIOBuilder(OpBuilder):
                                         ctypes.c_int64]
         lib.aio_wait.argtypes = [ctypes.c_void_p]
         lib.aio_wait.restype = ctypes.c_int
+        lib.aio_direct_fallbacks.argtypes = [ctypes.c_void_p]
+        lib.aio_direct_fallbacks.restype = ctypes.c_int64
         lib.aio_write_sync.argtypes = [ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64]
         lib.aio_write_sync.restype = ctypes.c_int
         lib.aio_read_sync.argtypes = [ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64]

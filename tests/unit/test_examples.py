@@ -48,3 +48,12 @@ def test_rlhf_hybrid_example_smoke():
     assert p.returncode == 0, p.stderr[-2000:]
     lines = [l for l in p.stdout.splitlines() if l.startswith("iter ")]
     assert len(lines) == 4 and "mean_reward=" in lines[-1], p.stdout[-800:]
+
+
+def test_finetune_bert_example_smoke():
+    env = cpu_subprocess_env(8)
+    env["SQUAD_STEPS"] = "5"
+    p = subprocess.run([sys.executable, "examples/finetune_bert.py"], cwd=REPO,
+                       env=env, capture_output=True, text=True, timeout=600)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "final" in p.stdout, p.stdout[-500:]
