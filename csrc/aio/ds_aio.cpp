@@ -117,6 +117,7 @@ bool write_all(const char* path, const void* buf, int64_t nbytes, bool use_direc
                bool* fell_back = nullptr) {
     const char* src = (const char*)buf;
 #ifdef O_DIRECT
+    if (use_direct && nbytes < kAlign && fell_back) *fell_back = true;  // sub-sector: buffered
     if (use_direct && nbytes >= kAlign) {
         int dfd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC | O_DIRECT, 0644);
         if (dfd >= 0) {
@@ -174,6 +175,7 @@ bool read_all(const char* path, void* buf, int64_t nbytes, bool use_direct,
               bool* fell_back = nullptr) {
     char* dst = (char*)buf;
 #ifdef O_DIRECT
+    if (use_direct && nbytes < kAlign && fell_back) *fell_back = true;  // sub-sector: buffered
     if (use_direct && nbytes >= kAlign) {
         int dfd = ::open(path, O_RDONLY | O_DIRECT);
         if (dfd >= 0) {

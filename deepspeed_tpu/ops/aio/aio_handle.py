@@ -42,12 +42,14 @@ class AsyncIOHandle:
         return errors
 
     def direct_fallbacks(self) -> int:
-        """How many direct-requested ops silently ran buffered (O_DIRECT
-        refused, e.g. tmpfs) since this handle was created — callers
-        benchmarking the O_DIRECT path must check this."""
-        if hasattr(self.lib, "aio_direct_fallbacks") and self._h is not None:
-            return int(self.lib.aio_direct_fallbacks(self._h))
-        return 0
+        """How many direct-requested ops ran buffered instead (O_DIRECT
+        refused by the filesystem, or sub-sector sizes) since this handle
+        was created — callers benchmarking the O_DIRECT path must check
+        this. Raises on a closed handle: 'could not check' must never read
+        as 'no fallback occurred'."""
+        if self._h is None:
+            raise RuntimeError("direct_fallbacks() on a closed AsyncIOHandle")
+        return int(self.lib.aio_direct_fallbacks(self._h))
 
     def sync_pwrite(self, buf: np.ndarray, path: str) -> int:
         buf = np.ascontiguousarray(buf)

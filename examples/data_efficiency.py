@@ -1,0 +1,107 @@
+"""Data-efficiency training at toy scale — the engine-wired curriculum
+seqlen ramp (reference ``runtime/data_pipeline/curriculum_scheduler.py``)
+plus the random-LTD token-drop layer in its compositional form
+(reference ``data_routing/basic_layer.py``).
+
+Curriculum is pure config: the engine truncates each batch to the
+scheduled difficulty, so early steps are short and cheap. Random-LTD is a
+LAYER users place inside their model (the reference's
+``convert_to_random_ltd`` mutates torch modules; flax modules are
+descriptions, so composition is explicit) — its kept-token budget is a
+static shape, stepped through the RandomLTDScheduler's schedule between
+compiles.
+
+Run (CPU, 8 virtual devices):
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/data_efficiency.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+from deepspeed_tpu.runtime.data_pipeline.data_routing.basic_layer import RandomLayerTokenDrop
+from deepspeed_tpu.runtime.data_pipeline.data_routing.scheduler import RandomLTDScheduler
+
+SEQ = 64
+BATCH = 8
+STEPS = int(os.environ.get("DE_STEPS", "10"))
+
+
+def main():
+    cfg = get_gpt2_config("test", n_positions=SEQ)
+    ds_config = {
+        "train_batch_size": BATCH,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+        # seqlen curriculum: 16 -> 64 over the first 8 steps (engine-wired:
+        # batches are truncated to the scheduled difficulty)
+        "curriculum_learning": {
+            "enabled": True,
+            "curriculum_type": "seqlen",
+            "min_difficulty": 16,
+            "max_difficulty": SEQ,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 8, "difficulty_step": 8},
+        },
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2LMHeadModel(cfg),
+                                               config=ds_config)
+
+    # the random-LTD kept-token schedule users step alongside training;
+    # the layer itself composes into a model (see RandomLayerTokenDrop
+    # usage in tests/unit/runtime/data_pipeline) with reserved_length as a
+    # STATIC shape per compile
+    ltd_sched = RandomLTDScheduler({
+        "total_layer_num": 2, "random_ltd_layer_num": 1,
+        "random_ltd_schedule": {"min_value": 16, "max_value": SEQ,
+                                "schedule_type": "fixed_linear",
+                                "schedule_config": {"seq_per_step": 16,
+                                                    "require_steps": 2}},
+        "global_batch_size": BATCH,
+    })
+
+    import flax.linen as nn
+
+    import jax.numpy as jnp
+
+    class _Marker(nn.Module):
+        @nn.compact
+        def __call__(self, x, deterministic=True):
+            return x * 2.0  # tokens passing through the layer get doubled
+
+    layer = RandomLayerTokenDrop(layer=_Marker())
+    x0 = jnp.ones((BATCH, SEQ, 8))
+    layer_params = layer.init({"params": jax.random.PRNGKey(0),
+                               "random_ltd": jax.random.PRNGKey(1)},
+                              x0, False, reserved_length=16)
+    drop_rng = jax.random.PRNGKey(2)
+
+    rng = np.random.default_rng(0)
+    for step in range(STEPS):
+        batch = {"input_ids": rng.integers(0, cfg.vocab_size,
+                                           (BATCH, SEQ)).astype(np.int32)}
+        loss = float(engine.train_batch(batch))
+        cur = (engine.curriculum_scheduler.get_difficulty(step + 1)
+               if engine.curriculum_scheduler is not None else SEQ)
+        keep = int(ltd_sched.update_seq(step + 1))
+        # drive the drop layer at this step's budget: only `keep` tokens
+        # per sample pass through the wrapped layer (get doubled)
+        out = layer.apply(layer_params, x0, False, reserved_length=keep,
+                          rngs={"random_ltd": jax.random.fold_in(drop_rng, step)})
+        went_through = int((out[0, :, 0] == 2.0).sum())
+        print(f"step {step}: loss {loss:.4f} curriculum_seqlen {cur} "
+              f"ltd_keep {went_through}/{SEQ}")
+    assert cur == SEQ and went_through == SEQ  # both ramps completed
+    print("done: curriculum and random-LTD ramped to full length")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

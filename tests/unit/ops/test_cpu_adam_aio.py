@@ -251,13 +251,27 @@ def test_aio_direct_fallback_counter_api():
     from deepspeed_tpu.ops.aio import AsyncIOHandle
 
     with tempfile.TemporaryDirectory() as d:
-        h = AsyncIOHandle(n_threads=2, use_direct=True)
+        # buffered handle: direct-requested fallbacks are impossible
+        hb = AsyncIOHandle(n_threads=1, use_direct=False)
         buf = np.arange(8192, dtype=np.uint8)
+        hb.pwrite(buf, f"{d}/b.bin")
+        assert hb.wait() == 0
+        assert hb.direct_fallbacks() == 0
+        hb.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            hb.direct_fallbacks()
+
+        h = AsyncIOHandle(n_threads=2, use_direct=True)
         h.pwrite(buf, f"{d}/x.bin")
         assert h.wait() == 0
         out = np.empty_like(buf)
         h.pread(out, f"{d}/x.bin")
         assert h.wait() == 0
         np.testing.assert_array_equal(out, buf)
-        assert h.direct_fallbacks() >= 0  # counter readable
+        n_fb = h.direct_fallbacks()
+        # sub-sector direct ops count as fallbacks: a 100-byte direct write
+        # cannot be O_DIRECT and must be visible to benchmarks
+        h.pwrite(np.arange(100, dtype=np.uint8), f"{d}/tiny.bin")
+        assert h.wait() == 0
+        assert h.direct_fallbacks() == n_fb + 1
         h.close()

@@ -57,3 +57,12 @@ def test_finetune_bert_example_smoke():
                        env=env, capture_output=True, text=True, timeout=600)
     assert p.returncode == 0, p.stderr[-2000:]
     assert "final" in p.stdout, p.stdout[-500:]
+
+
+def test_data_efficiency_example_smoke():
+    env = cpu_subprocess_env(8)
+    env["DE_STEPS"] = "10"
+    p = subprocess.run([sys.executable, "examples/data_efficiency.py"], cwd=REPO,
+                       env=env, capture_output=True, text=True, timeout=600)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "ramped to full length" in p.stdout, p.stdout[-500:]
