@@ -677,6 +677,15 @@ class DeepSpeedEngine:
         if off is None or getattr(off, "device", "none") in (None, "none"):
             return
         device = off.device if isinstance(off.device, str) else str(off.device)
+        # guard BEFORE any host-optimizer construction (NVMeAdam creates
+        # swap dirs + aio thread pools in __init__): each process would
+        # otherwise hold masters for the whole model — see the note below
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "offload_optimizer on multi-host meshes needs per-process host-master "
+                "partitioning (each host updating only its addressable shards); "
+                "run offload single-host or use device optimizer states (stage 1-3 "
+                "shard them over fsdp without host round-trips)")
         params = dict(self.config.optimizer_params or {})
         lr = params.get("lr", 1e-3)
         betas = tuple(params.get("betas", (0.9, 0.999)))
@@ -694,7 +703,10 @@ class DeepSpeedEngine:
                                       lr=lr, betas=betas, eps=eps, weight_decay=wd, adamw_mode=adamw)
         else:
             raise ValueError(f"unknown offload_optimizer.device {device!r}")
-        # fp32 host masters (reference: fp32 flat master partitions in host RAM)
+        # fp32 host masters (reference: fp32 flat master partitions in host
+        # RAM, per rank — stage_1_and_2.py:1086). Each PROCESS holds the
+        # masters for the whole model; on one host that is exactly the
+        # reference's per-node footprint (multi-host is guarded above).
         self._host_masters = [np.ascontiguousarray(np.asarray(jax.device_get(p), np.float32))
                               for p in jax.tree.leaves(self.state.params)]
         log_dist(f"optimizer offload enabled: device={device} "
