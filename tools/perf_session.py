@@ -1,9 +1,13 @@
 """One clean-exit TPU perf session: measures the engine step per-dispatch
-vs fused-scan, prints each result immediately, exits cleanly (never kill
-this while running — a killed TPU process wedges the axon tunnel claim).
+vs fused-scan, prints each result immediately, exits cleanly.
 
-Run: timeout 1500 python tools/perf_session.py
-Budget: ~3 compiles (~2-4 min each cold) + ~12 timed dispatches.
+Run: python tools/perf_session.py          (background it; poll stdout)
+NEVER wrap in `timeout` and never kill it mid-run — a killed TPU process
+wedges the axon tunnel claim for hours (PERF.md wedges #3/#4). Note the
+per-dispatch numbers it prints are KNOWN-FAKE on the axon tunnel (the
+dedupe cache, PERF.md session 3); only the fused-scan timings count —
+this tool's A/B already answered that question, it remains as a
+diagnostic.
 """
 import json
 import os
