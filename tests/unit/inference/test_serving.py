@@ -194,3 +194,30 @@ def test_t5_seq2seq_generate_matches_hf():
         row_ref = ref[b, :n]
         stop = n if 1 not in row_ref[1:] else int(np.argmax(row_ref[1:] == 1)) + 2
         np.testing.assert_array_equal(ours[b, :stop], row_ref[:stop])
+
+
+def test_serve_bench_tool_smoke(monkeypatch):
+    """tools/serve_bench.py (decode-throughput bench) runs at test scale
+    and emits a well-formed JSON line."""
+    import importlib.util
+    import io
+    import contextlib
+    import json
+    import os as _os
+
+    tools = _os.path.join(_os.path.dirname(_os.path.dirname(_os.path.dirname(
+        _os.path.dirname(_os.path.abspath(__file__))))), "tools")
+    for k, v in {"SERVE_MODEL": "test", "SERVE_BATCH": "2", "SERVE_PROMPT": "16",
+                 "SERVE_NEW": "8", "SERVE_ROUNDS": "1"}.items():
+        monkeypatch.setenv(k, v)
+    spec = importlib.util.spec_from_file_location(
+        "serve_bench", _os.path.join(tools, "serve_bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = mod.main()
+    assert rc == 0
+    line = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert line["decode_tokens_per_s"] > 0 and line["new"] == 8
+    assert line["e2e_tokens_per_s_incl_prefill"] > 0

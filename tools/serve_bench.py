@@ -1,0 +1,86 @@
+"""Serving decode throughput on the real chip — the inference-side
+companion to bench.py (the reference's inference benchmarks live in
+DeepSpeedExamples; its headline is fused-kernel decode speed).
+
+Measures decode tokens/s by DIFFERENCING: each round times generate()
+at ``NEW`` and at ``2*NEW`` new tokens with the same prompt shape — the
+prefill cost cancels in the difference, so the decode rate is isolated
+from the per-dispatch chunked prefill (whose timing the tunnel's dedupe
+cache can flatter, PERF.md session 3; the decode while_loop itself
+chains state token-by-token). End-to-end rate reports alongside.
+
+Run: python tools/serve_bench.py    (background it; poll stdout)
+Env: SERVE_MODEL=350m SERVE_BATCH=8 SERVE_PROMPT=128 SERVE_NEW=128
+     SERVE_ROUNDS=3
+NEVER wrap in `timeout` — clean-exit only (PERF.md wedge lessons).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))  # bench_core
+
+import numpy as np
+
+MODEL = os.environ.get("SERVE_MODEL", "350m")
+BATCH = int(os.environ.get("SERVE_BATCH", "8"))
+PROMPT = int(os.environ.get("SERVE_PROMPT", "128"))
+NEW = int(os.environ.get("SERVE_NEW", "128"))
+ROUNDS = int(os.environ.get("SERVE_ROUNDS", "3"))
+
+
+def main():
+    import jax
+
+    from bench_core import enable_compile_cache
+
+    enable_compile_cache()
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+
+    cfg = get_gpt2_config(MODEL, n_positions=PROMPT + 2 * NEW, dtype=None)
+    model = GPT2LMHeadModel(cfg)
+    engine = deepspeed_tpu.init_inference(model, dtype="bf16",
+                                          replace_with_kernel_inject=True,
+                                          max_out_tokens=PROMPT + 2 * NEW)
+    rng = np.random.default_rng(0)
+
+    def run(new_tokens):
+        prompts = rng.integers(0, cfg.vocab_size, (BATCH, PROMPT)).astype(np.int32)
+        t0 = time.time()
+        out = np.asarray(engine.generate(prompts, max_new_tokens=new_tokens))
+        dt = time.time() - t0
+        assert out.shape == (BATCH, PROMPT + new_tokens)
+        return dt
+
+    t0 = time.time()
+    run(NEW)
+    run(2 * NEW)  # compile both programs
+    compile_s = time.time() - t0
+
+    short, long_ = [], []
+    for r in range(ROUNDS):
+        short.append(run(NEW))
+        long_.append(run(2 * NEW))
+    d_short, d_long = float(np.median(short)), float(np.median(long_))
+    # prefill cancels in the difference; decode rate from the extra NEW tokens
+    decode_dt = max(d_long - d_short, 1e-9)
+    decode_tok_s = BATCH * NEW / decode_dt
+    e2e_tok_s = BATCH * NEW / d_short
+    print(json.dumps({
+        "model": MODEL, "batch": BATCH, "prompt": PROMPT, "new": NEW,
+        "decode_tokens_per_s": round(decode_tok_s, 1),
+        "decode_ms_per_token": round(decode_dt / NEW * 1e3, 2),
+        "e2e_tokens_per_s_incl_prefill": round(e2e_tok_s, 1),
+        "round_s_short": [round(t, 3) for t in short],
+        "round_s_long": [round(t, 3) for t in long_],
+        "compile_s": round(compile_s, 1),
+        "backend": jax.default_backend(),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
