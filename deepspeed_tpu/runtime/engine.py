@@ -84,6 +84,26 @@ def _truncate_seq(batch, seqlen: int):
     return jax.tree.map(trunc, batch)
 
 
+
+def _comm_dtype(config):
+    """Resolve ``communication_data_type`` (reference engine property
+    ``engine.py:616``: the dtype gradients ride the wire in). None/fp32 ->
+    no recast; "fp16"/"bf16" halve the dense-path reduction payload (the
+    reference reduces in the comm dtype the same way; qcomm/1-bit own
+    their wire formats)."""
+    name = getattr(config, "communication_data_type", None)
+    if name is None:
+        return None
+    # NB: "bf16" works on TPU; current XLA CPU check-fails compiling bf16
+    # reduce-scatters inside large programs — use fp16 for CPU runs
+    from deepspeed_tpu.inference.config import _DTYPES  # shared spelling table
+    resolved = _DTYPES.get(str(name).lower())
+    if resolved is None or not jnp.issubdtype(resolved, jnp.floating):
+        raise ValueError(f"communication_data_type {name!r}: expected fp16/bf16/fp32 "
+                         f"(or float16/bfloat16/float32/half/float)")
+    return None if resolved == jnp.float32 else resolved
+
+
 def _global_norm(tree):
     from deepspeed_tpu.runtime.utils import global_norm_l2
     return global_norm_l2(tree)
@@ -495,7 +515,8 @@ class DeepSpeedEngine:
                 batch, self._batch_spec(with_gas_dim=True), gas=gas,
                 quantized_weights=bool(zc.zero_quantized_weights),
                 quantized_gradients=bool(zc.zero_quantized_gradients),
-                wire_dtype=self.compute_dtype)
+                wire_dtype=self.compute_dtype,
+                grad_wire_dtype=_comm_dtype(self.config))
             self._qcomm_tracing = True
             try:
                 loss_mean, grads = fn(params, batch, keys, scale)
@@ -955,7 +976,8 @@ class DeepSpeedEngine:
         # explicit shard_map path, which composes with pure-DP meshes only;
         # other topologies keep the QDQ numerics simulation
         zc = cfg.zero_config
-        want_qcomm = bool(zc.zero_quantized_gradients or zc.zero_quantized_weights)
+        want_qcomm = bool(zc.zero_quantized_gradients or zc.zero_quantized_weights
+                          or _comm_dtype(cfg) is not None)
         mcfg = getattr(self.module, "config", None)
         has_moe = mcfg is not None and getattr(mcfg, "moe_num_experts", 0) > 0
         # tensor axes compose: the qcomm shard_map is manual over (data,
@@ -966,9 +988,10 @@ class DeepSpeedEngine:
         self._use_qcomm = (want_qcomm and dp_compat and dp_world > 1 and not has_moe
                            and not getattr(self, "_offload_enabled", False))
         if want_qcomm and not self._use_qcomm:
-            log_dist("ZeRO++ quantized communication requires a DP(+TP) mesh without "
-                     "pipe/sequence/expert axes or MoE/offload; falling back to QDQ "
-                     "numerics (no wire-byte savings)")
+            log_dist("explicit-wire communication requires a DP(+TP) mesh without "
+                     "pipe/sequence/expert axes or MoE/offload; ZeRO++ quantized "
+                     "configs fall back to QDQ numerics and communication_data_type "
+                     "falls back to GSPMD default dtypes (no wire savings either way)")
 
         # 1-bit Adam compressed collective (reference compressed_allreduce,
         # runtime/comm/nccl.py:51): after freeze_step the DP exchange becomes
@@ -1058,8 +1081,11 @@ class DeepSpeedEngine:
                     moq if comp is None else (lambda p, s: moq(comp(p, s), s)))
             self._compression_pending = False
             if self._compression_transform is not None and self._use_qcomm:
-                log_dist("warning: compression-in-forward does not compose with the "
-                         "qcomm shard_map path; disabling quantized collectives")
+                dropped = ("communication_data_type reductions"
+                           if _comm_dtype(cfg) is not None else "quantized collectives")
+                log_dist(f"warning: compression-in-forward does not compose with the "
+                         f"qcomm shard_map path; disabling {dropped} "
+                         f"(reductions run at GSPMD default dtypes)")
                 self._use_qcomm = False
             if self._compression_transform is not None and (
                     getattr(self, "_offload_enabled", False)

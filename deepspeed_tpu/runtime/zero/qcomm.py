@@ -161,6 +161,7 @@ def quantized_grad_reduce(g: jax.Array, spec: P, *,
 # ---------------------------------------------------------------------------
 
 def qcomm_accumulate(loss_for, mesh, param_specs, grad_specs, batch, batch_spec, *,
+                     grad_wire_dtype=None,
                      gas: int,
                      quantized_weights: bool,
                      quantized_gradients: bool,
@@ -246,11 +247,16 @@ def qcomm_accumulate(loss_for, mesh, param_specs, grad_specs, batch, batch_spec,
 
         zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), full_params)
         grads, losses = jax.lax.scan(micro, zeros, (local_batch, keys))
-        grads = jax.tree.map(lambda g: g / (gas * scale), grads)
+        if grad_wire_dtype is None:
+            # legacy order (bit-stable for existing configs): unscale first
+            grads = jax.tree.map(lambda g: g / (gas * scale), grads)
 
         g_flat = jax.tree_util.tree_flatten(grads)[0]
         out_flat = []
         for i, (g, spec) in enumerate(zip(g_flat, grad_flat)):
+            if grad_wire_dtype is not None and quantized_gradients:
+                # qgZ owns its wire; just unscale as the legacy order would
+                g = g / (gas * scale)
             if quantized_gradients:
                 key = jax.random.fold_in(keys[0], 1000 + i) if stochastic_rounding else None
                 out_flat.append(quantized_grad_reduce(
@@ -258,8 +264,17 @@ def qcomm_accumulate(loss_for, mesh, param_specs, grad_specs, batch, batch_spec,
                     data_axis=data_axis, data_size=data_size,
                     group_size=group_size, rng=key))
             else:
-                # quantized weights only: grads still reduce in full precision
+                # unquantized reduce: full precision by default, or the
+                # configured communication_data_type on the wire (reference
+                # reduces gradients in the comm dtype). When recasting, the
+                # gradients ride the wire STILL LOSS-SCALED (unscale happens
+                # after the reduce, below) — fp16 wire + dynamic loss scale
+                # keeps small elements out of the subnormal range, exactly
+                # the reference's ordering
                 dim = _axis_dim(spec, fsdp_axis)
+                acc_dtype = g.dtype
+                if grad_wire_dtype is not None and jnp.issubdtype(g.dtype, jnp.floating):
+                    g = g.astype(grad_wire_dtype)
                 g = jax.lax.pmean(g, data_axis) if data_size > 1 else g
                 if dim is not None and fsdp_size > 1:
                     moved = jnp.moveaxis(g, dim, 0)
@@ -268,6 +283,9 @@ def qcomm_accumulate(loss_for, mesh, param_specs, grad_specs, batch, batch_spec,
                     g = jnp.moveaxis(red, 0, dim)
                 elif fsdp_size > 1:
                     g = jax.lax.pmean(g, fsdp_axis)
+                g = g.astype(acc_dtype)
+                if grad_wire_dtype is not None:
+                    g = g / (gas * scale)  # unscale AFTER the wire hop
                 out_flat.append(g)
         grad_shards = jax.tree_util.tree_unflatten(param_treedef, out_flat)
         loss = jax.lax.pmean(losses.mean(), (data_axis, fsdp_axis))

@@ -181,3 +181,47 @@ class TestQcommPrimitives:
         rel = np.abs(out - true_mean).max() / (np.abs(true_mean).max() + 1e-9)
         # int8 hop + two int4 hops: grouped-absmax error stays in the few-% range
         assert rel < 0.12, f"quantized reduce error {rel}"
+
+
+def test_communication_data_type_halves_dense_wire_bytes():
+    """communication_data_type must put 16-bit (not f32) gradient payloads
+    on the dense-path reduction wire — reference reduces in the configured
+    comm dtype (engine communication_data_type property). fp16 is the
+    pinned dtype here: current XLA CPU check-fails compiling bf16
+    reduce-scatters inside large programs ("Invalid binary instruction
+    opcode copy"); the lowering is dtype-generic, so fp16 coverage pins
+    the mechanism."""
+    from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
+
+    def payload(comm_dtype):
+        set_topology(None)
+        cfg = get_gpt2_config("test", n_embd=64, n_head=4, n_positions=32)
+        ds = {"train_batch_size": 8,
+              "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+              "zero_optimization": {"stage": 2}}
+        if comm_dtype:
+            ds["communication_data_type"] = comm_dtype
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT2LMHeadModel(cfg), topology=MeshTopology(fsdp=8), config=ds)
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(0, cfg.vocab_size, (8, 32)).astype(np.int32)}
+        engine.initialize_state(batch)
+        hlo = engine.lower_train_step(batch).compile().as_text()
+        set_topology(None)
+        return collective_payload_bytes(hlo)
+
+    full = payload(None)
+    half = payload("fp16")
+    assert half < 0.7 * full, (full, half)
+    # training still converges with 16-bit reductions
+    cfg = get_gpt2_config("test", n_embd=64, n_head=4, n_positions=32)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(cfg), topology=MeshTopology(fsdp=8),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+                "communication_data_type": "fp16",
+                "zero_optimization": {"stage": 2}})
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (8, 32)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch)) for _ in range(4)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
