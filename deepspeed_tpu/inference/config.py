@@ -12,12 +12,9 @@ from pydantic import Field, field_validator
 
 from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
 
-_DTYPES = {
-    "float32": jnp.float32, "fp32": jnp.float32, "float": jnp.float32,
-    "float16": jnp.float16, "fp16": jnp.float16, "half": jnp.float16,
-    "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
-    "int8": jnp.int8,
-}
+from deepspeed_tpu.runtime.config_utils import dtype_names
+
+_DTYPES = dtype_names()
 
 
 class DeepSpeedTPConfig(DeepSpeedConfigModel):

@@ -195,6 +195,19 @@ def top2gating(logits: jax.Array,
     return l_aux, combine_weights, dispatch_mask, exp_counts
 
 
+
+def _constrain_groups(x, spec, n_groups: int):
+    """Apply a sharding constraint when the group dim really maps onto the
+    DP shards (one guard for the gate/dispatch/combine sites; tiny
+    standalone batches fail divisibility and stay unconstrained)."""
+    topo = get_topology()
+    if topo is None or n_groups != topo.data_parallel_size or topo.mesh.size == 1:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.lax.with_sharding_constraint(x, NamedSharding(topo.mesh, P(*spec)))
+
+
 class TopKGate(nn.Module):
     """Gate module (reference ``TopKGate`` ``sharded_moe.py:347``): a bias-free
     fp32 linear + top-k gating. Operates on ``[groups, tokens, model]``."""
@@ -227,6 +240,12 @@ class TopKGate(nn.Module):
                 rng, jit_rng = jax.random.split(rng)
                 x = multiplicative_jitter(x, jit_rng)
         logits = jnp.einsum("gsm,me->gse", x, wg_value)
+        # pin the logits group-sharded: with_sharding_constraint transposes
+        # onto the COTANGENT, so the gate-weight gradient lowers as a local
+        # partial + tiny [M,E] all-reduce instead of all-gathering the full
+        # token array to every chip (per-chip bytes that grew with the mesh
+        # — caught by the EP scaling report)
+        logits = _constrain_groups(logits, (BATCH_AXES, None, None), logits.shape[0])
 
         cf = self.capacity_factor if not deterministic else self.eval_capacity_factor
         groups = logits.shape[0]
@@ -327,16 +346,8 @@ class MOELayer(nn.Module):
         groups = _num_groups(batch)
         tokens = hidden_states.reshape(groups, -1, d_model)  # [G, S, M]
 
-        topo = get_topology()
-        # constraints only make sense when the group dim actually maps onto
-        # the DP shards (tiny standalone batches would fail divisibility)
-        mesh = topo.mesh if topo is not None and groups == topo.data_parallel_size else None
-
         def constrain(x, spec):
-            if mesh is None:
-                return x
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+            return _constrain_groups(x, spec, groups)
 
         tokens = constrain(tokens, (BATCH_AXES, None, None))
 
@@ -345,8 +356,15 @@ class MOELayer(nn.Module):
                         self.drop_tokens, self.use_rts, name="gate")
         l_aux, combine_weights, dispatch_mask, exp_counts = gate(tokens, used_token, deterministic)
 
-        # dispatch: [G,S,E,C] × [G,S,M] → [G,E,C,M] (reference 'sec,sm->ecm')
+        # dispatch: [G,S,E,C] × [G,S,M] → [G,E,C,M] (reference 'sec,sm->ecm').
+        # Pin the einsum output G-sharded FIRST: both operands are G-sharded,
+        # so the einsum is comm-free, and the NEXT constraint reshards
+        # G-sharded→E-sharded as a capacity-bounded all-to-all (payload
+        # tokens×M per chip, flat in the mesh). Without this pin GSPMD may
+        # instead ALL-GATHER the full token array to every chip — per-chip
+        # bytes that grow with the mesh (caught by the EP scaling report).
         dispatched = jnp.einsum("gsec,gsm->gecm", dispatch_mask.astype(orig_dtype), tokens)
+        dispatched = constrain(dispatched, (BATCH_AXES, None, None, None))
         # "first all-to-all": group dim leaves the expert mesh axis, expert dim
         # takes it (reference _AllToAll forward, sharded_moe.py:475)
         dispatched = constrain(dispatched, ((DATA_AXIS, FSDP_AXIS), EXPERT_AXIS, None, None))
@@ -354,8 +372,15 @@ class MOELayer(nn.Module):
         expert_out = Experts(self.expert, self.num_experts, name="experts")(dispatched, deterministic)
         expert_out = constrain(expert_out, ((DATA_AXIS, FSDP_AXIS), EXPERT_AXIS, None, None))
 
-        # combine: [G,S,E,C] × [G,E,C,M] → [G,S,M]; the sharding constraint on
-        # the output is the "second all-to-all" back to token-sharded layout
+        # "second all-to-all" made EXPLICIT on the input side: reshard the
+        # expert outputs E-sharded -> G-sharded (capacity-bounded payload,
+        # flat per chip) so the combine einsum and its whole backward stay
+        # local. Leaving the reshard to the OUTPUT constraint let GSPMD
+        # all-gather the [G,S,M] cotangent in the backward instead —
+        # per-chip bytes growing with the mesh (EP scaling report).
+        expert_out = constrain(expert_out, (BATCH_AXES, None, None, None))
+
+        # combine: [G,S,E,C] × [G,E,C,M] → [G,S,M]
         combined = jnp.einsum("gsec,gecm->gsm", combine_weights.astype(orig_dtype), expert_out)
         combined = constrain(combined, (BATCH_AXES, None, None))
 

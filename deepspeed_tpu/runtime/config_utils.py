@@ -106,3 +106,16 @@ class pp_int(int):
 
 
 ScientificNotationFloat = float
+
+
+# dtype-name spelling table shared by the inference config and the training
+# engine's communication_data_type (one vocabulary for every config block)
+def dtype_names():
+    import jax.numpy as jnp
+
+    return {
+        "float32": jnp.float32, "fp32": jnp.float32, "float": jnp.float32,
+        "float16": jnp.float16, "fp16": jnp.float16, "half": jnp.float16,
+        "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+        "int8": jnp.int8,
+    }

@@ -96,8 +96,8 @@ def _comm_dtype(config):
         return None
     # NB: "bf16" works on TPU; current XLA CPU check-fails compiling bf16
     # reduce-scatters inside large programs — use fp16 for CPU runs
-    from deepspeed_tpu.inference.config import _DTYPES  # shared spelling table
-    resolved = _DTYPES.get(str(name).lower())
+    from deepspeed_tpu.runtime.config_utils import dtype_names
+    resolved = dtype_names().get(str(name).lower())
     if resolved is None or not jnp.issubdtype(resolved, jnp.floating):
         raise ValueError(f"communication_data_type {name!r}: expected fp16/bf16/fp32 "
                          f"(or float16/bfloat16/float32/half/float)")

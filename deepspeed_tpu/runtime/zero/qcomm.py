@@ -247,16 +247,21 @@ def qcomm_accumulate(loss_for, mesh, param_specs, grad_specs, batch, batch_spec,
 
         zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), full_params)
         grads, losses = jax.lax.scan(micro, zeros, (local_batch, keys))
-        if grad_wire_dtype is None:
-            # legacy order (bit-stable for existing configs): unscale first
+        if grad_wire_dtype is None or quantized_gradients:
+            # legacy order (bit-stable for existing configs; qgZ owns its
+            # own wire format): unscale before reducing
             grads = jax.tree.map(lambda g: g / (gas * scale), grads)
+        else:
+            # comm-dtype wire: divide out the STATIC gas factor now (the
+            # raw gas-sum would overflow fp16 for large gas in fp32/bf16
+            # training, where no dynamic scaler can recover) but keep the
+            # LOSS SCALE on through the wire — small fp16-mode elements
+            # stay out of the subnormal range (reference ordering)
+            grads = jax.tree.map(lambda g: g / gas, grads)
 
         g_flat = jax.tree_util.tree_flatten(grads)[0]
         out_flat = []
         for i, (g, spec) in enumerate(zip(g_flat, grad_flat)):
-            if grad_wire_dtype is not None and quantized_gradients:
-                # qgZ owns its wire; just unscale as the legacy order would
-                g = g / (gas * scale)
             if quantized_gradients:
                 key = jax.random.fold_in(keys[0], 1000 + i) if stochastic_rounding else None
                 out_flat.append(quantized_grad_reduce(
@@ -285,7 +290,7 @@ def qcomm_accumulate(loss_for, mesh, param_specs, grad_specs, batch, batch_spec,
                     g = jax.lax.pmean(g, fsdp_axis)
                 g = g.astype(acc_dtype)
                 if grad_wire_dtype is not None:
-                    g = g / (gas * scale)  # unscale AFTER the wire hop
+                    g = g / scale  # unscale AFTER the wire hop (gas already out)
                 out_flat.append(g)
         grad_shards = jax.tree_util.tree_unflatten(param_treedef, out_flat)
         loss = jax.lax.pmean(losses.mean(), (data_axis, fsdp_axis))
