@@ -52,6 +52,24 @@ def test_zero3_per_chip_wire_bytes_flat_in_world_size():
     assert b8 <= 1.35 * b4 <= 1.35 * 1.35 * b2, (b2, b4, b8)
 
 
+def _load_scaling_report(**pins):
+    """Load tools/scaling_report.py with the regression config pinned
+    (the tool reads its knobs from os.environ at import)."""
+    import importlib.util
+    import os
+    tools = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))), "tools")
+    spec = importlib.util.spec_from_file_location(
+        "scaling_report", os.path.join(tools, "scaling_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    defaults = dict(MODEL="125m", SEQ=128, VOCAB=50432, TP=1, MOE=0, MB_PER_CHIP=1)
+    defaults.update(pins)
+    for k, v in defaults.items():
+        setattr(mod, k, v)
+    return mod
+
+
 def test_zero3_no_batch_replication_at_scale():
     """Regression: at realistic model scale GSPMD used to drop the batch
     sharding after the fsdp-sharded embedding gather and replicate the
@@ -62,23 +80,26 @@ def test_zero3_no_batch_replication_at_scale():
     chips. Runs tools/scaling_report.py meshes in subprocesses (device
     count is fixed at jax import, so the 8-device conftest can't host
     this)."""
-    import importlib.util
-    import os
-    tools = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))))), "tools")
-    spec = importlib.util.spec_from_file_location(
-        "scaling_report", os.path.join(tools, "scaling_report.py"))
-    scaling_report = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(scaling_report)
-    # pin the regression config regardless of ambient env (the tool reads
-    # MODEL/SEQ/TP/... from os.environ at import)
-    scaling_report.MODEL, scaling_report.SEQ = "125m", 128
-    scaling_report.VOCAB, scaling_report.TP = 50432, 1
-    scaling_report.MOE = 0
-    scaling_report.MB_PER_CHIP = 1
+    scaling_report = _load_scaling_report()
 
     p16, _ = scaling_report.run_mesh(16)
     p64, _ = scaling_report.run_mesh(64)
     assert p16 > 0 and p64 > 0
     # flat within ring-factor + compiler headroom; the broken plan gave 4x
     assert p64 <= 1.35 * p16, (p16, p64)
+
+
+def test_moe_ep_no_token_gather_at_scale():
+    """Regression for the MoE EP scaling fix: the gate/combine einsum
+    backwards used to all-gather the FULL token array to every chip
+    (payload +42% per mesh doubling); with the logits-cotangent pin and
+    the explicit return a2a, per-chip payload must stay ~flat between 8
+    and 16 chips (experts growing with the mesh)."""
+    scaling_report = _load_scaling_report(MOE=2, MB_PER_CHIP=2)
+
+    p8, _ = scaling_report.run_mesh(8)
+    p16, _ = scaling_report.run_mesh(16)
+    assert p8 > 0 and p16 > 0
+    # broken plan gave ~1.42x here; ring factor + gating-mask growth stay
+    # well under 1.25x
+    assert p16 <= 1.25 * p8, (p8, p16)
