@@ -261,18 +261,41 @@ def monitored_barrier(group=None, timeout=None, wait_all_ranks=False, name="moni
     return barrier(group=group, name=name)
 
 
-def gather(tensor, dst: int = 0, group: AxisNames = None, axis: int = 0):
-    """Gather-to-root (reference ``comm.py`` ``gather``): under SPMD every
-    member materializes the gathered value (= the root's view)."""
+def gather(tensor, gather_list=None, dst: int = 0, group: AxisNames = None, axis: int = 0):
+    """Gather-to-root (reference ``comm.py`` ``gather(tensor, gather_list,
+    dst, ...)``): under SPMD every member materializes the gathered value
+    (= the root's view). ``gather_list`` is accepted for positional-call
+    parity with the reference signature; SPMD returns the gathered array
+    instead of filling a list, so a non-None list is rejected loudly."""
+    if gather_list is not None:
+        if isinstance(gather_list, int):
+            raise TypeError(
+                "gather(tensor, dst) positional form changed to match the "
+                "reference signature gather(tensor, gather_list=None, dst=0, "
+                "...) — pass dst as a keyword: gather(tensor, dst=%d)" % gather_list)
+        raise ValueError(
+            "gather_list is torch.distributed's out-parameter; under SPMD "
+            "gather() RETURNS the gathered array — drop the list argument")
     del dst
     return all_gather(tensor, group=group, axis=axis)
 
 
-def scatter(tensor, src: int = 0, group: AxisNames = None, axis: int = 0):
-    """Scatter from root (reference ``comm.py`` ``scatter``): each member
-    keeps its chunk of the ``src`` member's tensor along ``axis``. Lowered
-    as a masked psum_scatter — reduce-scatter cost, no full-size broadcast
-    temporary."""
+def scatter(tensor, scatter_list=None, src: int = 0, group: AxisNames = None, axis: int = 0):
+    """Scatter from root (reference ``comm.py`` ``scatter(tensor,
+    scatter_list, src, ...)``): each member keeps its chunk of the ``src``
+    member's tensor along ``axis``. Lowered as a masked psum_scatter —
+    reduce-scatter cost, no full-size broadcast temporary. ``scatter_list``
+    is accepted for positional-call parity and rejected loudly if non-None
+    (SPMD scatters the root's full ``tensor``, not a per-rank list)."""
+    if scatter_list is not None:
+        if isinstance(scatter_list, int):
+            raise TypeError(
+                "scatter(tensor, src) positional form changed to match the "
+                "reference signature scatter(tensor, scatter_list=None, src=0, "
+                "...) — pass src as a keyword: scatter(tensor, src=%d)" % scatter_list)
+        raise ValueError(
+            "scatter_list is torch.distributed's per-rank input list; under "
+            "SPMD pass the root's full tensor and it is split along `axis`")
     axes = _normalize_axes(group)
     size = _axis_size(axes)
     if tensor.shape[axis] % size != 0:

@@ -97,11 +97,14 @@ def reset():
 
 def model_parallel_cuda_manual_seed(seed):  # reference API parity: RNG forking
     """Seeds the RNG tracker's named streams (reference
-    ``model_parallel_cuda_manual_seed`` ``checkpointing.py:198`` adds the
-    model-parallel stream at ``seed + 2718``). Remat determinism itself
-    needs none of this on TPU — flax threads explicit PRNG keys — but the
-    standard Megatron call sequence (``manual_seed`` then
-    ``get_rng_state_tracker().fork()``) must work unchanged."""
+    ``model_parallel_cuda_manual_seed`` ``checkpointing.py:221`` seeds the
+    model-parallel stream at ``seed + 2718 + tp_rank``). The per-TP-rank
+    offset is intentionally dropped here: under SPMD there is one global
+    key and GSPMD shards the sampling itself, so per-rank decorrelation is
+    a property of the sharded op, not of rank-distinct seeds. Remat
+    determinism itself needs none of this on TPU — flax threads explicit
+    PRNG keys — but the standard Megatron call sequence (``manual_seed``
+    then ``get_rng_state_tracker().fork()``) must work unchanged."""
     _RNG_TRACKER.reset()
     _RNG_TRACKER.add("model-parallel-rng", int(seed) + 2718)
     return None

@@ -1660,7 +1660,20 @@ class DeepSpeedEngine:
 
     @property
     def communication_data_type(self):
-        return self.config.communication_data_type
+        """Resolved wire dtype (reference ``engine.py:797``): the configured
+        dtype if set, else the enabled compute precision (fp16 -> float16,
+        bf16 -> bfloat16, else float32) — a jnp dtype, comparable against
+        tensor dtypes, never the raw config string."""
+        resolved = _comm_dtype(self.config)
+        if resolved is not None:
+            return resolved
+        if getattr(self.config, "communication_data_type", None) is not None:
+            return jnp.float32  # explicitly configured fp32
+        if self.fp16_enabled():
+            return jnp.float16
+        if self.bfloat16_enabled():
+            return jnp.bfloat16
+        return jnp.float32
 
     def sparse_gradients_enabled(self) -> bool:
         return bool(self.config.sparse_gradients_enabled)

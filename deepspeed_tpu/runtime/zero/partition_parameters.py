@@ -44,9 +44,9 @@ class ZeroParamStatus(enum.Enum):
     state user code can observe is AVAILABLE (inside ``GatheredParameters``
     / step functions) or NOT_AVAILABLE (a sharded leaf at rest). INFLIGHT
     never occurs (no hand-rolled prefetch), kept for import parity."""
-    NOT_AVAILABLE = 1
-    INFLIGHT = 2
-    AVAILABLE = 3
+    AVAILABLE = 1
+    NOT_AVAILABLE = 2
+    INFLIGHT = 3
 
 
 class Init:

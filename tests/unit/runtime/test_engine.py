@@ -230,8 +230,9 @@ def test_reference_accessor_surface():
     assert engine.zero_offload_optimizer() is None
     assert engine.sparse_gradients_enabled() is False
     assert engine.wall_clock_breakdown() is False
-    # default config: no communication dtype override configured
-    assert engine.communication_data_type is None
+    # no override configured: resolves to the enabled compute precision
+    # (reference engine.py:797 falls back fp16 -> float16)
+    assert engine.communication_data_type == jnp.float16
 
 
 def test_dp_world_size_includes_expert_axis():

@@ -34,4 +34,6 @@ def test_unknown_attribute_raises():
 def test_zero_namespace():
     assert hasattr(ds.zero, "Init")
     assert hasattr(ds.zero, "GatheredParameters")
-    assert ds.zero.ZeroParamStatus.AVAILABLE.value == 3  # reference enum parity
+    assert ds.zero.ZeroParamStatus.AVAILABLE.value == 1  # reference enum parity
+    assert ds.zero.ZeroParamStatus.NOT_AVAILABLE.value == 2
+    assert ds.zero.ZeroParamStatus.INFLIGHT.value == 3
