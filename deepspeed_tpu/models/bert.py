@@ -178,6 +178,12 @@ class BertLayer(nn.Module):
 class BertModel(nn.Module):
     """Embeddings + encoder stack (+ pooler on [CLS])."""
 
+    # offload_param streaming: these block subtrees self-stream inside
+    # their remat region (param_offload.stream_block_params); the engine
+    # top-streams only the remaining leaves
+    streamed_block_prefixes = ("layer_",)
+
+
     config: BertConfig
 
     @nn.compact
@@ -200,9 +206,10 @@ class BertModel(nn.Module):
              jnp.take(typ_v, token_type_ids, axis=0)).astype(cfg.dtype)
         x = BertLayerNorm(cfg, name="embeddings_ln")(x)
 
-        layer_cls = BertLayer
+        from deepspeed_tpu.runtime.zero.param_offload import stream_block_params
+        layer_cls = stream_block_params(BertLayer)
         if cfg.remat:
-            layer_cls = nn.remat(BertLayer, static_argnums=(3,), prevent_cse=False)
+            layer_cls = nn.remat(layer_cls, static_argnums=(3,), prevent_cse=False)
         from deepspeed_tpu.models.common import constrain_activation
         # batch-parallel residual stream over fsdp-sharded weights — see
         # constrain_activation (the ZeRO-3 weak-scaling invariant)
@@ -226,6 +233,12 @@ class BertModel(nn.Module):
 
 class BertForMaskedLM(nn.Module):
     """MLM head tied to the word embeddings; returns logits [B, L, V]."""
+
+    # offload_param streaming: these block subtrees self-stream inside
+    # their remat region (param_offload.stream_block_params); the engine
+    # top-streams only the remaining leaves
+    streamed_block_prefixes = ("layer_",)
+
 
     config: BertConfig
 

@@ -151,6 +151,12 @@ class BloomBlock(nn.Module):
 class BloomForCausalLM(nn.Module):
     """BLOOM with tied word-embedding head and embedding layernorm."""
 
+    # offload_param streaming: these block subtrees self-stream inside
+    # their remat region (param_offload.stream_block_params); the engine
+    # top-streams only the remaining leaves
+    streamed_block_prefixes = ("h_",)
+
+
     config: BloomConfig
 
     @nn.compact
@@ -163,9 +169,10 @@ class BloomForCausalLM(nn.Module):
         x = jnp.take(wte_v, input_ids, axis=0).astype(cfg.dtype)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="word_embeddings_layernorm")(x)
-        block_cls = BloomBlock
+        from deepspeed_tpu.runtime.zero.param_offload import stream_block_params
+        block_cls = stream_block_params(BloomBlock)
         if cfg.remat:
-            block_cls = nn.remat(BloomBlock, prevent_cse=False)
+            block_cls = nn.remat(block_cls, prevent_cse=False)
         from deepspeed_tpu.models.common import constrain_activation
         # batch-parallel residual stream over fsdp-sharded weights — see
         # constrain_activation (the ZeRO-3 weak-scaling invariant)

@@ -122,6 +122,12 @@ class CLIPEncoderLayer(nn.Module):
 class CLIPTextModel(nn.Module):
     """Text tower: returns (last_hidden_state [B, L, E], pooled [B, E])."""
 
+    # offload_param streaming: these block subtrees self-stream inside
+    # their remat region (param_offload.stream_block_params); the engine
+    # top-streams only the remaining leaves
+    streamed_block_prefixes = ("layers_",)
+
+
     config: CLIPTextConfig
 
     @nn.compact

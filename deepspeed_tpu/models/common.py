@@ -114,7 +114,16 @@ def maybe_remat(block_cls, cfg, layer_idx: int, static_argnums=(), enabled=None)
     ``jax.checkpoint`` (with the config's ``remat_policy``) when remat is on
     and ``layer_idx`` hits the ``remat_every`` stride; otherwise return the
     class unchanged. ``enabled`` overrides ``cfg.remat`` for callers with
-    extra conditions (e.g. llama skips remat during decode)."""
+    extra conditions (e.g. llama skips remat during decode).
+
+    Every block additionally passes through ``stream_block_params`` — a
+    no-op unless a ZeRO-Infinity ``offload_param`` engine is tracing, in
+    which case the block's params are h2d-streamed *inside* the remat
+    region so backward re-streams per layer instead of holding every
+    layer's device copy from forward to backward (reference param
+    coordinator re-fetch, ``partitioned_param_coordinator.py:479``)."""
+    from deepspeed_tpu.runtime.zero.param_offload import stream_block_params
+    block_cls = stream_block_params(block_cls)
     enabled = getattr(cfg, "remat", False) if enabled is None else enabled
     if not enabled or layer_idx % max(getattr(cfg, "remat_every", 1), 1) != 0:
         return block_cls

@@ -149,6 +149,12 @@ class FalconBlock(nn.Module):
 class FalconForCausalLM(nn.Module):
     """Falcon with tied word-embedding head."""
 
+    # offload_param streaming: these block subtrees self-stream inside
+    # their remat region (param_offload.stream_block_params); the engine
+    # top-streams only the remaining leaves
+    streamed_block_prefixes = ("h_",)
+
+
     config: FalconConfig
 
     @nn.compact
@@ -159,9 +165,10 @@ class FalconForCausalLM(nn.Module):
                          (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
         wte_v = wte.value if isinstance(wte, nn.meta.AxisMetadata) else wte
         x = jnp.take(wte_v, input_ids, axis=0).astype(cfg.dtype)
-        block_cls = FalconBlock
+        from deepspeed_tpu.runtime.zero.param_offload import stream_block_params
+        block_cls = stream_block_params(FalconBlock)
         if cfg.remat:
-            block_cls = nn.remat(FalconBlock, prevent_cse=False)
+            block_cls = nn.remat(block_cls, prevent_cse=False)
         from deepspeed_tpu.models.common import constrain_activation
         # batch-parallel residual stream over fsdp-sharded weights — see
         # constrain_activation (the ZeRO-3 weak-scaling invariant)

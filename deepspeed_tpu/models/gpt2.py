@@ -242,6 +242,10 @@ class GPT2LMHeadModel(nn.Module):
     """GPT-2 with tied-embedding LM head. Returns logits [B, L, V]."""
 
     config: GPT2Config
+    # offload_param streaming: h_* blocks self-stream inside their remat
+    # region (maybe_remat); the engine top-streams only the rest (wte/wpe/
+    # ln_f), keeping per-layer device copies out of the remat residuals
+    streamed_block_prefixes = ("h_",)
 
     @nn.compact
     def __call__(self, input_ids, *, deterministic: bool = True, decode: bool = False,

@@ -140,6 +140,10 @@ class GPTNeoForCausalLM(nn.Module):
     """GPT-Neo with TIED LM head. Returns logits [B, L, V] (or the scalar
     loss when ``labels`` ride the fused head)."""
 
+    # offload_param streaming: blocks self-stream inside their remat
+    # region; the engine top-streams only the remaining leaves
+    streamed_block_prefixes = ("h_",)
+
     config: GPTNeoConfig
 
     @nn.compact

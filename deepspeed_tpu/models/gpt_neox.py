@@ -157,6 +157,10 @@ class GPTNeoXBlock(nn.Module):
 class GPTNeoXForCausalLM(nn.Module):
     """GPT-NeoX with UNTIED ``embed_out`` head. Returns logits [B, L, V]."""
 
+    # offload_param streaming: blocks self-stream inside their remat
+    # region; the engine top-streams only the remaining leaves
+    streamed_block_prefixes = ("layers_",)
+
     config: GPTNeoXConfig
 
     @nn.compact
@@ -167,9 +171,10 @@ class GPTNeoXForCausalLM(nn.Module):
                               (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
         wte = embed_in.value if isinstance(embed_in, nn.meta.AxisMetadata) else embed_in
         x = jnp.take(wte, input_ids, axis=0).astype(cfg.dtype)
-        block_cls = GPTNeoXBlock
+        from deepspeed_tpu.runtime.zero.param_offload import stream_block_params
+        block_cls = stream_block_params(GPTNeoXBlock)
         if cfg.remat:
-            block_cls = nn.remat(GPTNeoXBlock, prevent_cse=False)
+            block_cls = nn.remat(block_cls, prevent_cse=False)
         from deepspeed_tpu.models.common import constrain_activation
         # batch-parallel residual stream over fsdp-sharded weights — see
         # constrain_activation (the ZeRO-3 weak-scaling invariant)

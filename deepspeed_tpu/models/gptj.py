@@ -158,6 +158,12 @@ class GPTJForCausalLM(nn.Module):
     """GPT-J with UNTIED, BIASED ``lm_head``. Returns logits [B, L, V] (or
     the scalar loss when ``labels`` ride the fused head)."""
 
+    # offload_param streaming: these block subtrees self-stream inside
+    # their remat region (param_offload.stream_block_params); the engine
+    # top-streams only the remaining leaves
+    streamed_block_prefixes = ("h_",)
+
+
     config: GPTJConfig
 
     @nn.compact

@@ -270,6 +270,12 @@ class LlamaForCausalLM(nn.Module):
     (pass ``mutable=["cache"]`` to ``apply``).
     """
 
+    # offload_param streaming: these block subtrees self-stream inside
+    # their remat region (param_offload.stream_block_params); the engine
+    # top-streams only the remaining leaves
+    streamed_block_prefixes = ("layers_",)
+
+
     config: LlamaConfig
 
     @nn.compact

@@ -158,6 +158,12 @@ class OPTBlock(nn.Module):
 class OPTForCausalLM(nn.Module):
     """OPT with tied-embedding LM head. Returns logits [B, L, V]."""
 
+    # offload_param streaming: these block subtrees self-stream inside
+    # their remat region (param_offload.stream_block_params); the engine
+    # top-streams only the remaining leaves
+    streamed_block_prefixes = ("layers_",)
+
+
     config: OPTConfig
 
     @nn.compact
@@ -188,9 +194,10 @@ class OPTForCausalLM(nn.Module):
         else:
             x = x + wpe[POSITION_OFFSET:POSITION_OFFSET + l].astype(cfg.dtype)
 
-        block_cls = OPTBlock
+        from deepspeed_tpu.runtime.zero.param_offload import stream_block_params
+        block_cls = stream_block_params(OPTBlock)
         if cfg.remat:
-            block_cls = nn.remat(OPTBlock, prevent_cse=False)
+            block_cls = nn.remat(block_cls, prevent_cse=False)
         from deepspeed_tpu.models.common import constrain_activation
         # batch-parallel residual stream over fsdp-sharded weights — see
         # constrain_activation (the ZeRO-3 weak-scaling invariant)

@@ -233,10 +233,11 @@ class T5Stack(nn.Module):
         cfg = self.config
         n = cfg.n_dec_layers if self.is_decoder else cfg.num_layers
         bias = None
-        block_cls = T5Block
+        from deepspeed_tpu.runtime.zero.param_offload import stream_block_params
+        block_cls = stream_block_params(T5Block)
         if cfg.remat:
             # decode is arg index 5 of T5Block.__call__ (static python bool)
-            block_cls = nn.remat(T5Block, static_argnums=(5,), prevent_cse=False)
+            block_cls = nn.remat(block_cls, static_argnums=(5,), prevent_cse=False)
         from deepspeed_tpu.models.common import constrain_activation
         # batch-parallel residual stream over fsdp-sharded weights — see
         # constrain_activation (the ZeRO-3 weak-scaling invariant)
@@ -253,6 +254,12 @@ class T5ForConditionalGeneration(nn.Module):
     """Encoder-decoder LM. ``__call__(input_ids, decoder_input_ids)`` →
     logits; ``decode=True`` runs incremental decoder steps against a cached
     self-attention state (``encoder_outputs`` must then be supplied)."""
+
+    # offload_param streaming: these block subtrees self-stream inside
+    # their remat region (param_offload.stream_block_params); the engine
+    # top-streams only the remaining leaves
+    streamed_block_prefixes = ("block_",)
+
 
     config: T5Config
 

@@ -149,6 +149,15 @@ def profile_engine_step(engine, device_batch, rng, step_latency_s=None,
                 engine.state, engine._onebit_errors, device_batch, rng).compile()
             notes.append("1-bit compression phase: profiled program is the "
                          "compressed-collective step")
+        elif getattr(engine, "_param_offload_enabled", False):
+            # offload_param splits the step args so the device-resident rest
+            # donates (engine._build_step_fns): (params, rest, batch, rng)
+            st = engine.state
+            train_compiled = engine._train_step_fn.lower(
+                st.params, (st.step, st.opt_state, st.loss_scale),
+                device_batch, rng).compile()
+            notes.append("offload_param path: params stream from pinned host "
+                         "memory inside the profiled program")
         elif engine._train_step_fn is not None:
             train_compiled = engine._train_step_fn.lower(
                 engine.state, device_batch, rng).compile()

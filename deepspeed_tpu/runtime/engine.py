@@ -200,6 +200,22 @@ class DeepSpeedEngine:
         self._onebit_errors = None
         self._use_qcomm = False
         self._offload_enabled = False
+        # derived from config here (not just _prepare_plan) because
+        # train_batches routes on it before initialize_state has run —
+        # and misconfigurations should fail at initialize(), not at the
+        # first train_batch's lazy plan build
+        _poff = config.zero_config.offload_param
+        self._param_offload_enabled = (_poff is not None
+                                       and getattr(_poff, "device", "none") not in (None, "none"))
+        if self._param_offload_enabled:
+            if config.zero_config.stage != 3:
+                raise ValueError("offload_param requires ZeRO stage 3 "
+                                 f"(got stage {config.zero_config.stage})")
+            if config.zero_config.zero_quantized_weights:
+                raise ValueError("offload_param does not compose with "
+                                 "zero_quantized_weights (the QDQ transform would run "
+                                 "on host-resident leaves); pick one")
+        self._param_swapper = None
         self._zeroone_runner = None
         self._autotune = None  # (mode, raw config dict), set by entry.initialize
         # compression-in-forward (set via compression.init_compression)
@@ -382,6 +398,24 @@ class DeepSpeedEngine:
         param_shardings = self.plan.param_shardings()
         aparams = jax.eval_shape(init_params, rng)
 
+        poff = self.config.zero_config.offload_param
+        self._param_offload_enabled = (poff is not None
+                                       and getattr(poff, "device", "none") not in (None, "none"))
+        if self._param_offload_enabled:
+            # reference config contract: offload_param is a ZeRO-3 feature
+            # (zero/config.py validator "offload_param ... stage 3 only")
+            if self.config.zero_config.stage != 3:
+                raise ValueError("offload_param requires ZeRO stage 3 "
+                                 f"(got stage {self.config.zero_config.stage})")
+            if self.config.zero_config.zero_quantized_weights:
+                raise ValueError("offload_param does not compose with "
+                                 "zero_quantized_weights (the QDQ transform would run "
+                                 "on host-resident leaves); pick one")
+            # resting placement: pinned host memory, same fsdp sharding —
+            # every step streams the shards through the chip (param_offload.py)
+            from deepspeed_tpu.runtime.zero.param_offload import host_shardings
+            param_shardings = host_shardings(param_shardings)
+
         off = self.config.zero_config.offload_optimizer
         self._offload_enabled = off is not None and getattr(off, "device", "none") not in (None, "none")
         if self._offload_enabled:
@@ -417,14 +451,42 @@ class DeepSpeedEngine:
         opt_shardings = self.state_shardings.opt_state
 
         if self._initial_params is not None:
+            # device_put handles host memory kinds directly (offload_param:
+            # param_shardings rest in pinned_host)
             params = jax.device_put(nn.meta.unbox(self._initial_params), param_shardings)
+        elif self._param_offload_enabled:
+            # jit out_shardings cannot carry host memory kinds through the
+            # SPMD partitioner (see param_offload.py): init shard-by-shard
+            # onto device, then migrate to the pinned-host resting placement
+            # (transient device footprint = the offload-free sharded params;
+            # beyond-HBM models load via _initial_params / checkpoint restore,
+            # which go straight to host)
+            params = jax.jit(init_params, out_shardings=self.plan.param_shardings())(rng)
         else:
             params = jax.jit(init_params, out_shardings=param_shardings)(rng)
 
         if self._offload_enabled:
             opt_state = {}
+        elif self._param_offload_enabled and self._initial_params is not None:
+            # beyond-HBM path: never materialize the loaded params on
+            # device. Optimizer state depends only on shapes/dtypes (optax
+            # moments init as zeros), so build it from in-graph zeros — XLA
+            # folds the zero params away and emits the sharded zero moments
+            # directly
+            shapes = jax.tree.map(lambda l: jax.ShapeDtypeStruct(jnp.shape(l), l.dtype), params)
+            opt_state = jax.jit(
+                lambda: self.optimizer.init(
+                    jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)),
+                out_shardings=opt_shardings)()
         else:
+            # params may transiently be the device copy (offload_param init
+            # path above) — optimizer.init consumes it before migration
             opt_state = jax.jit(self.optimizer.init, out_shardings=opt_shardings)(params)
+
+        if self._param_offload_enabled and self._initial_params is None:
+            params_dev, params = params, jax.device_put(params, param_shardings)
+            jax.block_until_ready(params)
+            del params_dev
 
         repl = NamedSharding(self.mesh, P())
         ls_state = jax.device_put(self._ls_state0, repl)
@@ -433,6 +495,7 @@ class DeepSpeedEngine:
                                 opt_state=opt_state,
                                 loss_scale=ls_state)
         self._setup_offload_optimizer()
+        self._setup_param_offload()
         self._build_step_fns()
 
     def abstract_state(self, example_batch, rng: Optional[jax.Array] = None) -> TrainState:
@@ -467,6 +530,12 @@ class DeepSpeedEngine:
 
         abatch = jax.tree.map(leaf, example_batch)
         arng = jax.ShapeDtypeStruct(self._base_rng.shape, self._base_rng.dtype)
+        if getattr(self, "_param_offload_enabled", False):
+            # the offload step fn splits (params, rest) so the device-resident
+            # rest can be donated; memory_analysis() of this lowering is the
+            # HBM-residency evidence (host params land in host_argument_size)
+            rest = (abstract.step, abstract.opt_state, abstract.loss_scale)
+            return self._train_step_fn.lower(abstract.params, rest, abatch, arng)
         return self._train_step_fn.lower(abstract, abatch, arng)
 
     # ------------------------------------------------------------------
@@ -686,11 +755,21 @@ class DeepSpeedEngine:
             return self._accumulate_grads(params, batch, rng, jnp.float32(1.0), grad_shardings,
                                           gas, clip, fp16=False)
 
-        self._grads_only_fn = jax.jit(
-            grads_only,
-            in_shardings=(self.state_shardings.params, None, NamedSharding(mesh, P())),
-            out_shardings=(NamedSharding(mesh, P()), grad_shardings, NamedSharding(mesh, P()),
-                           NamedSharding(mesh, P())))
+        if getattr(self, "_param_offload_enabled", False):
+            # ZeRO-Infinity full combo (param + optimizer offload): params
+            # rest on host and stream through the grads pass; outputs keep
+            # propagated shardings (explicit out_shardings on host-derived
+            # values trip the SPMD partitioner — _accumulate_grads constrains
+            # the grads in-graph)
+            self._grads_only_fn = jax.jit(
+                grads_only,
+                in_shardings=(self.state_shardings.params, None, NamedSharding(mesh, P())))
+        else:
+            self._grads_only_fn = jax.jit(
+                grads_only,
+                in_shardings=(self.state_shardings.params, None, NamedSharding(mesh, P())),
+                out_shardings=(NamedSharding(mesh, P()), grad_shardings, NamedSharding(mesh, P()),
+                               NamedSharding(mesh, P())))
 
     def _setup_offload_optimizer(self):
         off = self.config.zero_config.offload_optimizer
@@ -737,6 +816,7 @@ class DeepSpeedEngine:
         """fwd+bwd on device (jitted), optimizer update on host via the C++
         kernel (reference async_accumulate_grad_in_cpu_via_gpu +
         cpu_adam path, stage_1_and_2.py:1086)."""
+        self._ensure_params_resident()
         loss, grads, gnorm, overflow = self._grads_only_fn(self.state.params, device_batch, rng)
         if bool(overflow):
             new_ls = self._ls_update(self.state.loss_scale, jnp.asarray(True))
@@ -773,8 +853,78 @@ class DeepSpeedEngine:
         new_ls = self._ls_update(self.state.loss_scale, jnp.asarray(False))
         self.state = TrainState(step=self.state.step + 1, params=new_params,
                                 opt_state=self.state.opt_state, loss_scale=new_ls)
+        self._journal_params_to_nvme()
         return loss, {"loss": loss, "grad_norm": gnorm, "overflow": jnp.asarray(False),
                       "loss_scale": new_ls.loss_scale}
+
+    def _setup_param_offload(self):
+        """offload_param residency backends (param_offload.py). cpu: the
+        pinned-host resting placement set up by the plan is the whole story.
+        nvme: additionally journal every leaf to O_DIRECT files via the
+        PartitionedParamSwapper (reference AsyncPartitionedParameterSwapper,
+        ``partitioned_param_swapper.py:403``), keeping a ``max_in_cpu``-
+        bounded window resident between steps."""
+        self._param_swapper = None
+        if not getattr(self, "_param_offload_enabled", False):
+            return
+        poff = self.config.zero_config.offload_param
+        device = poff.device if isinstance(poff.device, str) else str(poff.device)
+        if device == "nvme":
+            if jax.process_count() > 1:
+                raise NotImplementedError("offload_param device=nvme is single-host "
+                                          "(per-process swap files need a shared layout "
+                                          "contract); use device=cpu on multi-host meshes")
+            from deepspeed_tpu.runtime.zero.param_offload import PartitionedParamSwapper
+            nvme_path = getattr(poff, "nvme_path", None) or "/tmp/ds_tpu_nvme"
+            self._param_swapper = PartitionedParamSwapper(
+                os.path.join(str(nvme_path), "params"),
+                window_bytes=int(getattr(poff, "max_in_cpu", 1e9)),
+                n_threads=max(int(getattr(poff, "buffer_count", 5)), 1))
+            leaves = [np.asarray(jax.device_get(l)) for l in jax.tree.leaves(self.state.params)]
+            self._param_swapper.initialize(leaves)
+        n_bytes = sum(int(np.prod(jnp.shape(l))) * jnp.asarray(l).dtype.itemsize
+                      for l in jax.tree.leaves(self.state.params))
+        log_dist(f"parameter offload enabled: device={device} "
+                 f"({n_bytes / 1e6:.1f} MB resting off-HBM)")
+
+    def _param_offload_train_batch(self, device_batch, rng):
+        """One step of the streamed-parameter path: host params in, device
+        shard outputs out, async d2h home (the out-of-graph half of
+        param_offload.py's loop), NVMe journal when configured."""
+        self._ensure_params_resident()
+        rest = (self.state.step, self.state.opt_state, self.state.loss_scale)
+        new_params_dev, new_rest, metrics = self._train_step_fn(
+            self.state.params, rest, device_batch, rng)
+        params_host = jax.device_put(new_params_dev, self.state_shardings.params)
+        self.state = TrainState(step=new_rest[0], params=params_host,
+                                opt_state=new_rest[1], loss_scale=new_rest[2])
+        self._journal_params_to_nvme()
+        return metrics
+
+    def _journal_params_to_nvme(self):
+        """nvme tier post-step: persist updated leaves to the swap files and
+        release the full pinned-host copy — between steps, host RAM holds
+        only the swapper's ``max_in_cpu`` window (reference steady-state
+        contract, ``partitioned_param_swapper.py``); the next consumer
+        rematerializes via :meth:`_ensure_params_resident`."""
+        if self._param_swapper is None:
+            return
+        leaves = [np.asarray(jax.device_get(l)) for l in jax.tree.leaves(self.state.params)]
+        self._param_swapper.write_back(leaves)
+        self._params_treedef = jax.tree.structure(self.state.params)
+        self._params_released = True
+        self.state = self.state._replace(params=None)
+
+    def _ensure_params_resident(self):
+        """Rebuild host-resident params from the NVMe journal if the last
+        step released them (pipelined disk reads, window leaves from RAM)."""
+        if not getattr(self, "_params_released", False):
+            return
+        leaves = self._param_swapper.fetch_all()
+        tree = jax.tree.unflatten(self._params_treedef, leaves)
+        self.state = self.state._replace(
+            params=jax.device_put(tree, self.state_shardings.params))
+        self._params_released = False
 
     def _example_ids(self, batch):
         ids = batch["input_ids"] if isinstance(batch, dict) else batch
@@ -847,13 +997,27 @@ class DeepSpeedEngine:
         return jax.tree.unflatten(treedef, [qdq((i, g)) for i, g in enumerate(leaves)])
 
     def _loss_for(self, params, mb, key, scale, train: bool = True):
+        if getattr(self, "_param_offload_enabled", False):
+            # ZeRO-Infinity param streaming: non-block leaves h2d here; block
+            # subtrees pass through as host references and self-stream inside
+            # their remat region (maybe_remat -> stream_block_params), so
+            # backward re-streams per layer. The compute-dtype cast rides the
+            # transfer (host-space leaves cannot be cast in place).
+            from deepspeed_tpu.runtime.zero.param_offload import param_streaming, stream_tree
+            with param_streaming(cast_dtype=self.compute_dtype):
+                params = stream_tree(
+                    params, skip_prefixes=getattr(self.module, "streamed_block_prefixes", ()))
+                return self._loss_for_impl(params, mb, key, scale, train, precast=True)
+        return self._loss_for_impl(params, mb, key, scale, train)
+
+    def _loss_for_impl(self, params, mb, key, scale, train: bool = True, precast: bool = False):
         if self.config.zero_config.zero_quantized_weights and not getattr(self, "_qcomm_tracing", False):
             # QDQ numerics apply everywhere EXCEPT inside the qcomm trace,
             # where the gather itself carries the int8 payload
             # (qcomm.quantized_allgather) — the forward/backward shim path
             # keeps its QDQ weight numerics either way
             params = self._quantize_gathered_weights(params)
-        cparams = _cast_floating(params, self.compute_dtype)
+        cparams = params if precast else _cast_floating(params, self.compute_dtype)
         ids = mb["input_ids"] if isinstance(mb, dict) else mb
         extra = self._module_kwargs(mb)
         mcfg = getattr(self.module, "config", None)
@@ -986,7 +1150,8 @@ class DeepSpeedEngine:
         dp_compat = all(self.mesh.shape[a] == 1 for a in ("pipe", "sequence", "expert"))
         dp_world = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
         self._use_qcomm = (want_qcomm and dp_compat and dp_world > 1 and not has_moe
-                           and not getattr(self, "_offload_enabled", False))
+                           and not getattr(self, "_offload_enabled", False)
+                           and not getattr(self, "_param_offload_enabled", False))
         if want_qcomm and not self._use_qcomm:
             log_dist("explicit-wire communication requires a DP(+TP) mesh without "
                      "pipe/sequence/expert axes or MoE/offload; ZeRO++ quantized "
@@ -1094,6 +1259,14 @@ class DeepSpeedEngine:
                 logger.warning("compression-in-forward only applies on the fused "
                                "train_batch path; offload/1-bit/0-1 Adam steps run "
                                "uncompressed")
+            if self._compression_transform is not None and getattr(
+                    self, "_param_offload_enabled", False):
+                # the transform would run on pinned-host leaves before
+                # _loss_for's streaming h2d — compute on host-space operands
+                # fails at compile; fail here with the fix named
+                raise ValueError("compression-in-forward does not compose with "
+                                 "offload_param (masks/quantization would apply to "
+                                 "host-resident leaves); disable one of the two")
 
         if getattr(self, "_offload_enabled", False):
             self._build_offload_step_fns(grad_shardings)
@@ -1118,6 +1291,14 @@ class DeepSpeedEngine:
             losses, grads, gnorm, overflow = self._accumulate_grads(
                 state.params, batch, rng, scale, grad_shardings, gas, clip, fp16,
                 params_transform=pt, model_extra=extra)
+            if getattr(self, "_param_offload_enabled", False):
+                # second touch of the step (reference optimizer-substep param
+                # access): stream the host-resident masters in for the update
+                # math; no compute-dtype cast — the update runs at param dtype
+                from deepspeed_tpu.runtime.zero.param_offload import (param_streaming,
+                                                                      stream_tree)
+                with param_streaming():
+                    state = state._replace(params=stream_tree(state.params))
 
             # overflow → skip update (reference stage step-skip semantics).
             # Applied in every dtype mode: for bf16/fp32 `overflow` is a
@@ -1143,12 +1324,37 @@ class DeepSpeedEngine:
 
         # batch leaves keep the shardings _shard_batch placed them with (a
         # single broadcast spec would rank-mismatch scalar/per-sample leaves)
-        self._train_step_fn = jax.jit(
-            train_step,
-            in_shardings=(self.state_shardings, None, NamedSharding(mesh, P())),
-            out_shardings=(self.state_shardings, NamedSharding(mesh, P())),
-            donate_argnums=(0,),
-        )
+        if getattr(self, "_param_offload_enabled", False):
+            # offload_param jit contract (param_offload.py): host-space
+            # in_shardings for the resting params, NO out_shardings (this
+            # XLA's SPMD partitioner cannot partition placement annotations
+            # on non-parameters — updated params exit in device memory and
+            # go home via a plain async device_put in _dispatch_train_step),
+            # and donation only of the device-resident rest (params cannot
+            # alias across memory kinds)
+            def train_step_off(params, rest, batch, rng):
+                state = TrainState(step=rest[0], params=params, opt_state=rest[1],
+                                   loss_scale=rest[2])
+                new_state, metrics = train_step(state, batch, rng)
+                return (new_state.params,
+                        (new_state.step, new_state.opt_state, new_state.loss_scale),
+                        metrics)
+
+            repl = NamedSharding(mesh, P())
+            rest_shardings = (self.state_shardings.step, self.state_shardings.opt_state,
+                              self.state_shardings.loss_scale)
+            self._train_step_fn = jax.jit(
+                train_step_off,
+                in_shardings=(self.state_shardings.params, rest_shardings, None, repl),
+                donate_argnums=(1,),
+            )
+        else:
+            self._train_step_fn = jax.jit(
+                train_step,
+                in_shardings=(self.state_shardings, None, NamedSharding(mesh, P())),
+                out_shardings=(self.state_shardings, NamedSharding(mesh, P())),
+                donate_argnums=(0,),
+            )
 
         # N optimizer steps per dispatch: scan train_step over a leading
         # steps axis of device-resident batches. The idiomatic TPU training
@@ -1164,12 +1370,18 @@ class DeepSpeedEngine:
 
             return jax.lax.scan(body, state, (batches, keys))
 
-        self._train_steps_fn = jax.jit(
-            train_steps,
-            in_shardings=(self.state_shardings, None, NamedSharding(mesh, P())),
-            out_shardings=(self.state_shardings, NamedSharding(mesh, P())),
-            donate_argnums=(0,),
-        )
+        if getattr(self, "_param_offload_enabled", False):
+            # a scanned multi-step would carry params on device across the
+            # whole scan — exactly the residency offload removes. train_batches
+            # falls back to per-step dispatch (the host round-trip IS the point).
+            self._train_steps_fn = None
+        else:
+            self._train_steps_fn = jax.jit(
+                train_steps,
+                in_shardings=(self.state_shardings, None, NamedSharding(mesh, P())),
+                out_shardings=(self.state_shardings, NamedSharding(mesh, P())),
+                donate_argnums=(0,),
+            )
 
         def eval_step(params, mb, step):
             # eval must score the same network training optimizes: the
@@ -1179,10 +1391,18 @@ class DeepSpeedEngine:
             _, loss = self._loss_for(params, mb, jax.random.PRNGKey(0), jnp.float32(1.0), train=False)
             return loss
 
-        self._eval_step_fn = jax.jit(eval_step,
-                                     in_shardings=(self.state_shardings.params, None,
-                                                   NamedSharding(mesh, P())),
-                                     out_shardings=NamedSharding(mesh, P()))
+        if getattr(self, "_param_offload_enabled", False):
+            # explicit out_shardings on host-derived values trip the SPMD
+            # partitioner's placement-annotation handling; let the scalar
+            # loss placement propagate
+            self._eval_step_fn = jax.jit(eval_step,
+                                         in_shardings=(self.state_shardings.params, None,
+                                                       NamedSharding(mesh, P())))
+        else:
+            self._eval_step_fn = jax.jit(eval_step,
+                                         in_shardings=(self.state_shardings.params, None,
+                                                       NamedSharding(mesh, P())),
+                                         out_shardings=NamedSharding(mesh, P()))
 
         # shim path: per-microbatch grads + deferred apply
         def micro_grads(params, mb, key, scale):
@@ -1309,6 +1529,7 @@ class DeepSpeedEngine:
             raise ValueError("train_batches needs [n_steps, global_batch, ...] leaves")
         n_steps = np.shape(leaves[0])[0]
         host_paths = (getattr(self, "_host_opt", None) is not None
+                      or getattr(self, "_param_offload_enabled", False)
                       or self._zeroone_runner is not None
                       or self._onebit_cfg is not None
                       or self.curriculum_scheduler is not None
@@ -1396,6 +1617,8 @@ class DeepSpeedEngine:
                 self._build_onebit_step_fn(device_batch)
             self.state, self._onebit_errors, metrics = self._onebit_step_fn(
                 self.state, self._onebit_errors, device_batch, rng)
+        elif getattr(self, "_param_offload_enabled", False):
+            metrics = self._param_offload_train_batch(device_batch, rng)
         else:
             self.state, metrics = self._train_step_fn(self.state, device_batch, rng)
         self.global_steps += 1
@@ -1421,6 +1644,7 @@ class DeepSpeedEngine:
 
     def eval_batch(self, batch):
         self.initialize_state(batch)
+        self._ensure_params_resident()
         device_batch = self._shard_batch(batch, with_gas_dim=False)
         return self._eval_step_fn(self.state.params, device_batch, self.state.step)
 
@@ -1468,6 +1692,10 @@ class DeepSpeedEngine:
         if getattr(self, "_host_opt", None) is not None:
             raise NotImplementedError("offload_optimizer requires the fused train_batch() path; "
                                       "the forward/backward/step shims keep state on device")
+        if getattr(self, "_param_offload_enabled", False):
+            raise NotImplementedError("offload_param requires the fused train_batch() path; "
+                                      "the forward/backward/step shims donate device-resident "
+                                      "state that offload keeps in host memory")
         self._pending_batch = self._shard_batch(batch, with_gas_dim=False)
         key = jax.random.fold_in(self._base_rng, self.micro_steps)
         scale = self.state.loss_scale.loss_scale if self._fp16_mode else jnp.float32(1.0)
@@ -1709,6 +1937,7 @@ class DeepSpeedEngine:
     # checkpointing (reference engine.py:2906 save / 2601 load)
     # ------------------------------------------------------------------
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True):
+        self._ensure_params_resident()
         from deepspeed_tpu.runtime.checkpoint_engine.orbax_engine import OrbaxCheckpointEngine
         assert self.state is not None, "nothing to checkpoint: state not initialized"
         tag = tag or f"global_step{self.global_steps}"
@@ -1792,6 +2021,7 @@ class DeepSpeedEngine:
         self._pending_ckpt = None
 
     def save_16bit_model(self, save_dir, output_file=None):
+        self._ensure_params_resident()
         """Consolidated bf16 deployment weights from the LIVE params
         (reference ``engine.py:3376`` ``save_16bit_model`` →
         pytorch_model.bin; here an npz any flax/numpy user can read)."""

@@ -1,0 +1,201 @@
+"""ZeRO-Infinity parameter offload (``offload_param``) tests.
+
+Reference behavior being matched: params rest off the accelerator
+(``runtime/swap_tensor/partitioned_param_swapper.py:36``,
+``runtime/zero/partitioned_param_coordinator.py:479``,
+``runtime/zero/stage3.py:1263``) and stream through it per step, with cpu
+and nvme resting tiers. The TPU design (``runtime/zero/param_offload.py``)
+rests params in ``pinned_host`` memory and streams them in-graph; these
+tests pin numerics parity, residency evidence, tier plumbing, and config
+contracts on the 8-device CPU mesh.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+from deepspeed_tpu.parallel.topology import MeshTopology
+from deepspeed_tpu.runtime.zero.param_offload import (HOST_MEMORY_KIND,
+                                                      PartitionedParamSwapper,
+                                                      param_streaming, stream_in,
+                                                      stream_tree)
+
+
+def _engine(zero_extra, n_layer=2, topology=None, opt="Adam"):
+    cfg = get_gpt2_config("test", n_layer=n_layer, remat=True)
+    ds = {"train_batch_size": 8,
+          "optimizer": {"type": opt, "params": {"lr": 1e-3}},
+          "zero_optimization": dict({"stage": 3}, **zero_extra)}
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(cfg),
+        topology=topology or MeshTopology(fsdp=8),
+        config=ds)
+    return eng, cfg
+
+
+def _train(eng, cfg, steps=3, seed=0):
+    rng = np.random.RandomState(seed)
+    losses = []
+    for _ in range(steps):
+        batch = {"input_ids": rng.randint(0, cfg.vocab_size, (8, 16)).astype(np.int32)}
+        losses.append(float(jnp.asarray(eng.train_batch(batch))))
+    return losses
+
+
+def test_stream_in_gradient_is_identity():
+    """The streaming custom_vjp must be gradient-transparent: grads stay on
+    device (no d2h transpose) and match the un-streamed computation."""
+    mesh = MeshTopology(fsdp=8).mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    host = NamedSharding(mesh, P("fsdp"), memory_kind=HOST_MEMORY_KIND)
+    w = jax.device_put(jnp.arange(32.0).reshape(8, 4), host)
+    x = jnp.ones((2, 8))
+
+    def loss_streamed(w, x):
+        with param_streaming():
+            return jnp.tanh(x @ stream_in(w)).sum()
+
+    g = jax.jit(jax.grad(loss_streamed), in_shardings=(host, None))(w, x)
+    g_ref = jax.grad(lambda w, x: jnp.tanh(x @ w).sum())(jnp.arange(32.0).reshape(8, 4), x)
+    np.testing.assert_array_equal(np.asarray(jax.device_get(g)), np.asarray(g_ref))
+
+
+def test_offload_param_matches_dense_bitwise():
+    """cpu-tier offload changes WHERE params rest, not the math: the loss
+    sequence must equal the dense ZeRO-3 run bit for bit."""
+    eng_off, cfg = _engine({"offload_param": {"device": "cpu"}})
+    l_off = _train(eng_off, cfg)
+    eng_ref, cfg = _engine({})
+    l_ref = _train(eng_ref, cfg)
+    assert l_off == l_ref, f"offload {l_off} != dense {l_ref}"
+
+
+def test_offload_param_host_residency():
+    """Residency evidence checkable without a real HBM split (XLA:CPU maps
+    both spaces to RAM): every param leaf RESTS in pinned_host, and every
+    param entry of the lowered step carries the host memory kind."""
+    eng, cfg = _engine({"offload_param": {"device": "cpu"}})
+    _train(eng, cfg, steps=1)
+    leaves = jax.tree.leaves(eng.state.params)
+    assert leaves and all(l.sharding.memory_kind == HOST_MEMORY_KIND for l in leaves)
+
+    batch = {"input_ids": np.zeros((8, 16), np.int32)}
+    txt = eng.lower_train_step(batch).as_text()
+    n_host_args = txt.count('mhlo.memory_kind = "pinned_host"')
+    assert n_host_args == len(leaves), (
+        f"{n_host_args} host-space entry params in the lowered step, expected "
+        f"{len(leaves)} (one per param leaf)")
+
+
+def test_offload_param_eval_batch():
+    eng, cfg = _engine({"offload_param": {"device": "cpu"}})
+    _train(eng, cfg, steps=1)
+    loss = eng.eval_batch({"input_ids": np.zeros((8, 16), np.int32)})
+    assert np.isfinite(float(np.asarray(jax.device_get(loss)).mean()))
+
+
+def test_offload_param_with_optimizer_offload():
+    """The full ZeRO-Infinity combo (reference's single-GPU billion-param
+    recipe): host-resting params stream through the grads-only pass, the
+    C++ host Adam updates host masters, and updated params go straight
+    back to their host resting placement — no device round-trip."""
+    eng, cfg = _engine({"offload_param": {"device": "cpu"},
+                        "offload_optimizer": {"device": "cpu"}})
+    losses = _train(eng, cfg, steps=3)
+    assert all(np.isfinite(l) for l in losses)
+    leaves = jax.tree.leaves(eng.state.params)
+    assert all(l.sharding.memory_kind == HOST_MEMORY_KIND for l in leaves)
+    # parity with the param-offload-only path on the same data: both are
+    # plain Adam at lr 1e-3 from the same init seed
+    eng2, cfg = _engine({"offload_param": {"device": "cpu"}})
+    l2 = _train(eng2, cfg, steps=3)
+    np.testing.assert_allclose(losses, l2, rtol=1e-5)
+
+
+def test_offload_param_nvme_tier(tmp_path):
+    """nvme tier: every leaf journaled to an O_DIRECT-backed file via the
+    aio pool, steady-state window bounded by max_in_cpu, fetch parity."""
+    eng, cfg = _engine({"offload_param": {"device": "nvme",
+                                          "nvme_path": str(tmp_path),
+                                          "max_in_cpu": 50000}})
+    losses = _train(eng, cfg, steps=2)
+    assert all(np.isfinite(l) for l in losses)
+    sw = eng._param_swapper
+    # between steps the full host copy is RELEASED: disk + window only
+    # (reference max_in_cpu steady-state contract)
+    assert eng.state.params is None
+    assert sw.resident_bytes() <= 50000
+    eng._ensure_params_resident()
+    n_leaves = len(jax.tree.leaves(eng.state.params))
+    assert len(os.listdir(tmp_path / "params")) == n_leaves
+    fetched = sw.fetch_all()
+    live = [np.asarray(jax.device_get(l)) for l in jax.tree.leaves(eng.state.params)]
+    assert len(fetched) == len(live)
+    for a, b in zip(fetched, live):
+        np.testing.assert_array_equal(a, b)
+    # training continues cleanly after an explicit rematerialization
+    more = _train(eng, cfg, steps=1, seed=7)
+    assert np.isfinite(more[0])
+
+
+def test_param_swapper_roundtrip(tmp_path):
+    sw = PartitionedParamSwapper(str(tmp_path), window_bytes=300)
+    leaves = [np.arange(10, dtype=np.float32),
+              np.ones((4, 4), np.float32),
+              np.arange(6, dtype=np.int32).reshape(2, 3)]
+    sw.initialize(leaves)
+    got = sw.fetch_all()
+    for a, b in zip(got, leaves):
+        np.testing.assert_array_equal(a, b)
+        assert a.dtype == b.dtype and a.shape == b.shape
+    updated = [l * 2 for l in leaves]
+    sw.write_back(updated)
+    assert sw.resident_bytes() <= 300
+    got2 = sw.fetch_all()
+    for a, b in zip(got2, updated):
+        np.testing.assert_array_equal(a, b)
+    sw.close()
+
+
+def test_offload_param_requires_stage3():
+    cfg = get_gpt2_config("test", n_layer=1)
+    with pytest.raises(ValueError, match="stage 3"):
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT2LMHeadModel(cfg), topology=MeshTopology(fsdp=8),
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 2,
+                                          "offload_param": {"device": "cpu"}}})
+        eng.initialize_state({"input_ids": np.zeros((8, 8), np.int32)})
+
+
+def test_offload_param_checkpoint_roundtrip(tmp_path):
+    """save/load must work with host-resident params and restore them to
+    the host resting placement."""
+    eng, cfg = _engine({"offload_param": {"device": "cpu"}})
+    l0 = _train(eng, cfg, steps=2)
+    eng.save_checkpoint(str(tmp_path), tag="ck")
+    eng2, cfg = _engine({"offload_param": {"device": "cpu"}})
+    eng2.initialize_state({"input_ids": np.zeros((8, 16), np.int32)})
+    eng2.load_checkpoint(str(tmp_path), tag="ck")
+    leaves = jax.tree.leaves(eng2.state.params)
+    a = [np.asarray(jax.device_get(l)) for l in leaves]
+    b = [np.asarray(jax.device_get(l)) for l in jax.tree.leaves(eng.state.params)]
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_stream_tree_skip_prefixes():
+    """Leaves under skip prefixes pass through untouched (host refs for the
+    blocks to self-stream); everything else is streamed+cast."""
+    tree = {"h_0": {"w": jnp.ones((2, 2))}, "wte": jnp.ones((2, 2))}
+    with param_streaming(cast_dtype=jnp.bfloat16):
+        out = jax.eval_shape(lambda t: stream_tree(t, skip_prefixes=("h_",)), tree)
+    assert out["h_0"]["w"].dtype == jnp.float32  # untouched host ref
+    assert out["wte"].dtype == jnp.bfloat16  # streamed + cast

@@ -30,8 +30,12 @@ def run_rung(tag, model_name, mb, offload=False, steps=None, seq=None,
              fused_xent=False, ds=None):
     ds_overrides = dict(ds or {})
     if offload:
+        # full ZeRO-Infinity single-chip recipe: params rest pinned-host and
+        # stream through the step (offload_param), masters + moments on the
+        # host C++ Adam (offload_optimizer) — runtime/zero/param_offload.py
         ds_overrides["zero_optimization"] = {
-            "stage": 2,
+            "stage": 3,
+            "offload_param": {"device": "cpu", "pin_memory": True},
             "offload_optimizer": {"device": "cpu", "pin_memory": True},
         }
     if model_name.startswith("bert_"):
