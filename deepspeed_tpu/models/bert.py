@@ -202,8 +202,10 @@ class BertModel(nn.Module):
         b, l = input_ids.shape
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
-        x = (jnp.take(word_v, input_ids, axis=0) + pos_v[None, :l] +
-             jnp.take(typ_v, token_type_ids, axis=0)).astype(cfg.dtype)
+        from deepspeed_tpu.models.common import embed_lookup
+        x = (embed_lookup(word_v, input_ids, getattr(cfg, 'embed_onehot_grad', True))
+             + pos_v[None, :l]
+             + jnp.take(typ_v, token_type_ids, axis=0)).astype(cfg.dtype)
         x = BertLayerNorm(cfg, name="embeddings_ln")(x)
 
         from deepspeed_tpu.runtime.zero.param_offload import stream_block_params

@@ -166,7 +166,9 @@ class BloomForCausalLM(nn.Module):
         wte = self.param("word_embeddings", nn.with_logical_partitioning(_init(), ("vocab", "embed")),
                          (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
         wte_v = wte.value if isinstance(wte, nn.meta.AxisMetadata) else wte
-        x = jnp.take(wte_v, input_ids, axis=0).astype(cfg.dtype)
+        from deepspeed_tpu.models.common import embed_lookup
+        x = embed_lookup(wte_v, input_ids,
+                         getattr(cfg, 'embed_onehot_grad', True), decode).astype(cfg.dtype)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="word_embeddings_layernorm")(x)
         from deepspeed_tpu.runtime.zero.param_offload import stream_block_params

@@ -140,7 +140,9 @@ class CLIPTextModel(nn.Module):
         tok = tok.value if isinstance(tok, nn.meta.AxisMetadata) else tok
         pos = pos.value if isinstance(pos, nn.meta.AxisMetadata) else pos
         b, l = input_ids.shape
-        x = (jnp.take(tok, input_ids, axis=0) + pos[None, :l]).astype(cfg.dtype)
+        from deepspeed_tpu.models.common import embed_lookup
+        x = (embed_lookup(tok, input_ids, getattr(cfg, 'embed_onehot_grad', True))
+             + pos[None, :l]).astype(cfg.dtype)
         from deepspeed_tpu.models.common import constrain_activation, maybe_remat
         # batch-parallel residual stream over fsdp-sharded weights — see
         # constrain_activation (the ZeRO-3 weak-scaling invariant)

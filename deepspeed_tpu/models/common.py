@@ -211,9 +211,42 @@ def take_embed_onehot_grad(wte, ids):
     return _onehot_embed_fn(int(wte.shape[0]), jnp.dtype(wte.dtype).name)(wte, ids)
 
 
-def embed_lookup(wte, ids, onehot_grad: bool = False):
-    """Token-embedding gather with a selectable backward formulation."""
-    if onehot_grad:
+def lookup_table_view(table):
+    """A gather-friendly view of an embedding table on tensor/sequence
+    meshes.
+
+    With the vocab dim sharded over ``tensor`` (logical rules), GSPMD
+    partitions ``take`` by psum-ing partial gathers and leaves the output
+    embed-sharded; the residual-stream constraint then needs a transition
+    the partitioner cannot produce — it replicates the whole activation
+    ("Involuntary full rematerialization", ``spmd_partitioner.cc:652``;
+    MULTICHIP_r03 tail). Pinning the TABLE un-sharded for the lookup moves
+    the reshard onto the parameter (an ordinary all-gather — exactly the
+    ZeRO-3 gather-on-use) so the gather emits (batch, length, embed)
+    directly. Skipped on tensor=sequence=1 meshes, where the default
+    strategy is already transition-free and the extra constraint would
+    pin the ZeRO-3 table gather into a fixed materialization."""
+    from deepspeed_tpu.parallel.topology import get_topology
+    topo = get_topology()
+    if topo is None or (topo.tensor_parallel_size <= 1
+                        and topo.sequence_parallel_size <= 1):
+        return table
+    return constrain_activation(table, None, None)
+
+
+def embed_lookup(wte, ids, onehot_grad: bool = True, decode: bool = False):
+    """Token-embedding gather, shared across the model zoo.
+
+    ``onehot_grad`` (default on): backward as a one-hot einsum instead of a
+    scatter-add — MXU-friendly and cleanly partitionable (the scatter's
+    batch→embed update reshard is a GSPMD involuntary-remat source).
+    ``decode``: per-token serving step — skip the table reshard
+    (:func:`lookup_table_view`); a whole-table all-gather per generated
+    token would dwarf the [B,1,E] gather it optimizes, and the decode
+    gather's output transition is negligible at one token."""
+    if not decode:
+        wte = lookup_table_view(wte)
+    if onehot_grad and not decode:
         return take_embed_onehot_grad(wte, ids)
     return jnp.take(wte, ids, axis=0)
 

@@ -164,7 +164,9 @@ class FalconForCausalLM(nn.Module):
         wte = self.param("word_embeddings", nn.with_logical_partitioning(_init(), ("vocab", "embed")),
                          (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
         wte_v = wte.value if isinstance(wte, nn.meta.AxisMetadata) else wte
-        x = jnp.take(wte_v, input_ids, axis=0).astype(cfg.dtype)
+        from deepspeed_tpu.models.common import embed_lookup
+        x = embed_lookup(wte_v, input_ids,
+                         getattr(cfg, 'embed_onehot_grad', True), decode).astype(cfg.dtype)
         from deepspeed_tpu.runtime.zero.param_offload import stream_block_params
         block_cls = stream_block_params(FalconBlock)
         if cfg.remat:

@@ -47,8 +47,12 @@ class GPT2Config:
     remat_every: int = 1
     attention_backend: str = "xla"
     # backward of the token-embedding gather as a one-hot matmul instead of
-    # a scatter-add (MXU-friendly; ~V*T*E extra FLOPs) — perf knob
-    embed_onehot_grad: bool = False
+    # a scatter-add. Default ON: scatter serializes on TPU (measured +10%
+    # with the matmul form, PERF.md r3 session 3) AND the scatter-add's
+    # batch-sharded→embed-sharded update reshard is the "Involuntary full
+    # rematerialization" GSPMD warns about on expert/fsdp meshes — the
+    # einsum backward partitions cleanly (contraction psum)
+    embed_onehot_grad: bool = True
     # >0: when called with ``labels=``, compute the loss via the chunked
     # fused LM head (models/common.py fused_lm_head_loss) — never
     # materializes [B, L, V] logits; the value is tokens per chunk

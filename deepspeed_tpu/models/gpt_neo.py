@@ -158,7 +158,9 @@ class GPTNeoForCausalLM(nn.Module):
         wpe = wpe.value if isinstance(wpe, nn.meta.AxisMetadata) else wpe
 
         b, l = input_ids.shape
-        x = jnp.take(wte, input_ids, axis=0).astype(cfg.dtype)
+        from deepspeed_tpu.models.common import embed_lookup
+        x = embed_lookup(wte, input_ids,
+                         getattr(cfg, 'embed_onehot_grad', True), decode).astype(cfg.dtype)
         if decode:
             pos_idx = self.variable("cache", "position_index", lambda: jnp.zeros([], jnp.int32))
             positions = pos_idx.value + jnp.arange(l)

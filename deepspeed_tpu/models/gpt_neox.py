@@ -170,7 +170,9 @@ class GPTNeoXForCausalLM(nn.Module):
         embed_in = self.param("embed_in", nn.with_logical_partitioning(_init(), ("vocab", "embed")),
                               (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
         wte = embed_in.value if isinstance(embed_in, nn.meta.AxisMetadata) else embed_in
-        x = jnp.take(wte, input_ids, axis=0).astype(cfg.dtype)
+        from deepspeed_tpu.models.common import embed_lookup
+        x = embed_lookup(wte, input_ids,
+                         getattr(cfg, 'embed_onehot_grad', True), decode).astype(cfg.dtype)
         from deepspeed_tpu.runtime.zero.param_offload import stream_block_params
         block_cls = stream_block_params(GPTNeoXBlock)
         if cfg.remat:

@@ -173,7 +173,9 @@ class GPTJForCausalLM(nn.Module):
         wte = self.param("wte", nn.with_logical_partitioning(_init(), ("vocab", "embed")),
                          (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
         wte = wte.value if isinstance(wte, nn.meta.AxisMetadata) else wte
-        x = jnp.take(wte, input_ids, axis=0).astype(cfg.dtype)
+        from deepspeed_tpu.models.common import embed_lookup
+        x = embed_lookup(wte, input_ids,
+                         getattr(cfg, 'embed_onehot_grad', True), decode).astype(cfg.dtype)
         from deepspeed_tpu.models.common import constrain_activation
         # batch-parallel residual stream over fsdp-sharded weights — see
         # constrain_activation (the ZeRO-3 weak-scaling invariant)

@@ -285,7 +285,9 @@ class LlamaForCausalLM(nn.Module):
         wte = self.param("embed_tokens", nn.with_logical_partitioning(_init(), ("vocab", "embed")),
                          (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
         wte_value = wte.value if isinstance(wte, nn.meta.AxisMetadata) else wte
-        x = jnp.take(wte_value, input_ids, axis=0).astype(cfg.dtype)
+        from deepspeed_tpu.models.common import embed_lookup
+        x = embed_lookup(wte_value, input_ids,
+                         getattr(cfg, 'embed_onehot_grad', True), decode).astype(cfg.dtype)
 
         from deepspeed_tpu.models.common import constrain_activation, maybe_remat
         # residual stream stays batch-parallel over fsdp-sharded weights —

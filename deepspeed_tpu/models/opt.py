@@ -180,7 +180,9 @@ class OPTForCausalLM(nn.Module):
         wpe = embed_positions.value if isinstance(embed_positions, nn.meta.AxisMetadata) else embed_positions
 
         b, l = input_ids.shape
-        x = jnp.take(wte, input_ids, axis=0).astype(cfg.dtype)
+        from deepspeed_tpu.models.common import embed_lookup
+        x = embed_lookup(wte, input_ids,
+                         getattr(cfg, 'embed_onehot_grad', True), decode).astype(cfg.dtype)
         if cfg.has_embed_proj:
             x = nn.Dense(features=cfg.hidden_size, use_bias=False, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype,

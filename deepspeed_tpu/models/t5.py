@@ -276,9 +276,11 @@ class T5ForConditionalGeneration(nn.Module):
                                         _init(), ("embed", "vocab")),
                                     name="lm_head")
 
-    def _embed(self, ids):
+    def _embed(self, ids, decode=False):
+        from deepspeed_tpu.models.common import embed_lookup
         w = self.shared.value if isinstance(self.shared, nn.meta.AxisMetadata) else self.shared
-        return jnp.take(w, ids, axis=0).astype(self.config.dtype)
+        return embed_lookup(w, ids, getattr(self.config, 'embed_onehot_grad', True),
+                            decode).astype(self.config.dtype)
 
     def _head(self, x):
         cfg = self.config
@@ -296,5 +298,5 @@ class T5ForConditionalGeneration(nn.Module):
                  encoder_outputs=None, decode: bool = False, deterministic: bool = True):
         if encoder_outputs is None:
             encoder_outputs = self.encode(input_ids)
-        x = self.decoder(self._embed(decoder_input_ids), enc=encoder_outputs, decode=decode)
+        x = self.decoder(self._embed(decoder_input_ids, decode=decode), enc=encoder_outputs, decode=decode)
         return self._head(x)

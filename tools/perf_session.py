@@ -40,7 +40,7 @@ def report(tag, steps, dt, n_params):
 def main():
     cfg = get_gpt2_config(MODEL, n_positions=SEQ, remat=True,
                           attention_backend="flash", dtype=jnp.bfloat16,
-                          embed_onehot_grad=os.environ.get("BENCH_EMBED_ONEHOT") == "1")
+                          embed_onehot_grad=os.environ.get("BENCH_EMBED_ONEHOT", "1") == "1")
     model = GPT2LMHeadModel(cfg)
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
         "train_batch_size": MB,
