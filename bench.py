@@ -159,6 +159,13 @@ def run_child():
     }))
 
 
+def run_parity():
+    """Emit this backend's reproducible loss curve (tools/parity_check)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import parity_check
+    parity_check.main()
+
+
 def run_probe():
     """Tiny end-to-end check that the backend can init AND compile."""
     import jax
@@ -187,6 +194,39 @@ def _run(mode, env, timeout):
     except subprocess.TimeoutExpired as e:
         from envutil import to_text
         return 124, to_text(e.stdout), to_text(e.stderr)
+
+
+def _parity_report(timeout):
+    """BASELINE north star: accelerator-vs-CPU loss-curve parity. Runs the
+    reproducible curve (tools/parity_check) once on the accelerator and
+    once on a plugin-scrubbed CPU subprocess, and reports bit-identity /
+    max-ULP. Failures degrade to an explanatory dict — parity must never
+    cost the bench its throughput number."""
+    try:
+        rc_a, out_a, err_a = _run("parity", dict(os.environ), timeout)
+        a = _last_json_line(out_a)
+        if rc_a != 0 or a is None:
+            return {"error": f"accel curve rc={rc_a}: "
+                    f"{err_a.strip().splitlines()[-1] if err_a.strip() else 'no output'}"}
+        from envutil import cpu_subprocess_env
+        # one pinned CPU device: the curve's workload is single-device by
+        # construction (parity_check.curve), keep the device count fixed too
+        rc_c, out_c, err_c = _run("parity", cpu_subprocess_env(n_virtual_devices=1), timeout)
+        c = _last_json_line(out_c)
+        if rc_c != 0 or c is None:
+            return {"error": f"cpu curve rc={rc_c}: "
+                    f"{err_c.strip().splitlines()[-1] if err_c.strip() else 'no output'}"}
+        sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import parity_check
+        rep = parity_check.compare(parity_check.from_hex(a["curve_hex"]),
+                                   parity_check.from_hex(c["curve_hex"]))
+        rep["backends"] = [a.get("backend"), c.get("backend")]
+        envelope = int(os.environ.get("PARITY_MAX_ULP", "0"))
+        rep["within_envelope"] = rep["max_ulp"] <= envelope or rep["bit_identical"]
+        rep["envelope_ulp"] = envelope
+        return rep
+    except Exception as e:  # noqa: BLE001
+        return {"error": f"{type(e).__name__}: {e}"}
 
 
 def _last_json_line(text):
@@ -228,6 +268,9 @@ def main():
         rc, out, err = _run("child", dict(os.environ), run_timeout)
         result = _last_json_line(out)
         if rc == 0 and result is not None:
+            if os.environ.get("BENCH_PARITY", "1") == "1":
+                result["parity"] = _parity_report(
+                    int(os.environ.get("BENCH_PARITY_TIMEOUT", "600")))
             print(json.dumps(result))
             return
         errors.append(f"accel bench: rc={rc} "
@@ -268,5 +311,7 @@ if __name__ == "__main__":
         run_child()
     elif len(sys.argv) > 1 and sys.argv[1] == "probe":
         run_probe()
+    elif len(sys.argv) > 1 and sys.argv[1] == "parity":
+        run_parity()
     else:
         main()

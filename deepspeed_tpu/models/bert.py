@@ -203,7 +203,7 @@ class BertModel(nn.Module):
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
         from deepspeed_tpu.models.common import embed_lookup
-        x = (embed_lookup(word_v, input_ids, getattr(cfg, 'embed_onehot_grad', True))
+        x = (embed_lookup(word_v, input_ids, getattr(cfg, 'embed_onehot_grad', None))
              + pos_v[None, :l]
              + jnp.take(typ_v, token_type_ids, axis=0)).astype(cfg.dtype)
         x = BertLayerNorm(cfg, name="embeddings_ln")(x)

@@ -168,7 +168,7 @@ class BloomForCausalLM(nn.Module):
         wte_v = wte.value if isinstance(wte, nn.meta.AxisMetadata) else wte
         from deepspeed_tpu.models.common import embed_lookup
         x = embed_lookup(wte_v, input_ids,
-                         getattr(cfg, 'embed_onehot_grad', True), decode).astype(cfg.dtype)
+                         getattr(cfg, 'embed_onehot_grad', None), decode).astype(cfg.dtype)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="word_embeddings_layernorm")(x)
         from deepspeed_tpu.runtime.zero.param_offload import stream_block_params

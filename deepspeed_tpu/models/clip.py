@@ -141,7 +141,7 @@ class CLIPTextModel(nn.Module):
         pos = pos.value if isinstance(pos, nn.meta.AxisMetadata) else pos
         b, l = input_ids.shape
         from deepspeed_tpu.models.common import embed_lookup
-        x = (embed_lookup(tok, input_ids, getattr(cfg, 'embed_onehot_grad', True))
+        x = (embed_lookup(tok, input_ids, getattr(cfg, 'embed_onehot_grad', None))
              + pos[None, :l]).astype(cfg.dtype)
         from deepspeed_tpu.models.common import constrain_activation, maybe_remat
         # batch-parallel residual stream over fsdp-sharded weights — see

@@ -234,16 +234,18 @@ def lookup_table_view(table):
     return constrain_activation(table, None, None)
 
 
-def embed_lookup(wte, ids, onehot_grad: bool = True, decode: bool = False):
+def embed_lookup(wte, ids, onehot_grad=None, decode: bool = False):
     """Token-embedding gather, shared across the model zoo.
 
-    ``onehot_grad`` (default on): backward as a one-hot einsum instead of a
+    ``onehot_grad`` (None = policy default, on): backward as a one-hot einsum instead of a
     scatter-add — MXU-friendly and cleanly partitionable (the scatter's
     batch→embed update reshard is a GSPMD involuntary-remat source).
     ``decode``: per-token serving step — skip the table reshard
     (:func:`lookup_table_view`); a whole-table all-gather per generated
     token would dwarf the [B,1,E] gather it optimizes, and the decode
     gather's output transition is negligible at one token."""
+    if onehot_grad is None:
+        onehot_grad = True  # the one policy site; callers pass getattr(cfg, ..., None)
     if not decode:
         wte = lookup_table_view(wte)
     if onehot_grad and not decode:

@@ -166,7 +166,7 @@ class FalconForCausalLM(nn.Module):
         wte_v = wte.value if isinstance(wte, nn.meta.AxisMetadata) else wte
         from deepspeed_tpu.models.common import embed_lookup
         x = embed_lookup(wte_v, input_ids,
-                         getattr(cfg, 'embed_onehot_grad', True), decode).astype(cfg.dtype)
+                         getattr(cfg, 'embed_onehot_grad', None), decode).astype(cfg.dtype)
         from deepspeed_tpu.runtime.zero.param_offload import stream_block_params
         block_cls = stream_block_params(FalconBlock)
         if cfg.remat:

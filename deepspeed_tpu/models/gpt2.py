@@ -263,7 +263,7 @@ class GPT2LMHeadModel(nn.Module):
         wpe_value = wpe.value if isinstance(wpe, nn.meta.AxisMetadata) else wpe
 
         _, seq_len = input_ids.shape
-        x = embed_lookup(wte_value, input_ids, cfg.embed_onehot_grad).astype(cfg.dtype)
+        x = embed_lookup(wte_value, input_ids, cfg.embed_onehot_grad, decode).astype(cfg.dtype)
         if decode:
             # position offset for wpe; advances in lockstep with each
             # attention layer's cache_index (same increment per call — flax

@@ -160,7 +160,7 @@ class GPTNeoForCausalLM(nn.Module):
         b, l = input_ids.shape
         from deepspeed_tpu.models.common import embed_lookup
         x = embed_lookup(wte, input_ids,
-                         getattr(cfg, 'embed_onehot_grad', True), decode).astype(cfg.dtype)
+                         getattr(cfg, 'embed_onehot_grad', None), decode).astype(cfg.dtype)
         if decode:
             pos_idx = self.variable("cache", "position_index", lambda: jnp.zeros([], jnp.int32))
             positions = pos_idx.value + jnp.arange(l)

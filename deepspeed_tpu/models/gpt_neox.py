@@ -172,7 +172,7 @@ class GPTNeoXForCausalLM(nn.Module):
         wte = embed_in.value if isinstance(embed_in, nn.meta.AxisMetadata) else embed_in
         from deepspeed_tpu.models.common import embed_lookup
         x = embed_lookup(wte, input_ids,
-                         getattr(cfg, 'embed_onehot_grad', True), decode).astype(cfg.dtype)
+                         getattr(cfg, 'embed_onehot_grad', None), decode).astype(cfg.dtype)
         from deepspeed_tpu.runtime.zero.param_offload import stream_block_params
         block_cls = stream_block_params(GPTNeoXBlock)
         if cfg.remat:

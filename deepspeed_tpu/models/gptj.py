@@ -175,7 +175,7 @@ class GPTJForCausalLM(nn.Module):
         wte = wte.value if isinstance(wte, nn.meta.AxisMetadata) else wte
         from deepspeed_tpu.models.common import embed_lookup
         x = embed_lookup(wte, input_ids,
-                         getattr(cfg, 'embed_onehot_grad', True), decode).astype(cfg.dtype)
+                         getattr(cfg, 'embed_onehot_grad', None), decode).astype(cfg.dtype)
         from deepspeed_tpu.models.common import constrain_activation
         # batch-parallel residual stream over fsdp-sharded weights — see
         # constrain_activation (the ZeRO-3 weak-scaling invariant)

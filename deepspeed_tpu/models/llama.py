@@ -287,7 +287,7 @@ class LlamaForCausalLM(nn.Module):
         wte_value = wte.value if isinstance(wte, nn.meta.AxisMetadata) else wte
         from deepspeed_tpu.models.common import embed_lookup
         x = embed_lookup(wte_value, input_ids,
-                         getattr(cfg, 'embed_onehot_grad', True), decode).astype(cfg.dtype)
+                         getattr(cfg, 'embed_onehot_grad', None), decode).astype(cfg.dtype)
 
         from deepspeed_tpu.models.common import constrain_activation, maybe_remat
         # residual stream stays batch-parallel over fsdp-sharded weights —

@@ -182,7 +182,7 @@ class OPTForCausalLM(nn.Module):
         b, l = input_ids.shape
         from deepspeed_tpu.models.common import embed_lookup
         x = embed_lookup(wte, input_ids,
-                         getattr(cfg, 'embed_onehot_grad', True), decode).astype(cfg.dtype)
+                         getattr(cfg, 'embed_onehot_grad', None), decode).astype(cfg.dtype)
         if cfg.has_embed_proj:
             x = nn.Dense(features=cfg.hidden_size, use_bias=False, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype,

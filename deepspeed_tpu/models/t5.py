@@ -279,7 +279,7 @@ class T5ForConditionalGeneration(nn.Module):
     def _embed(self, ids, decode=False):
         from deepspeed_tpu.models.common import embed_lookup
         w = self.shared.value if isinstance(self.shared, nn.meta.AxisMetadata) else self.shared
-        return embed_lookup(w, ids, getattr(self.config, 'embed_onehot_grad', True),
+        return embed_lookup(w, ids, getattr(self.config, 'embed_onehot_grad', None),
                             decode).astype(self.config.dtype)
 
     def _head(self, x):

@@ -40,7 +40,8 @@ def test_dryrun_mesh_compiles_without_involuntary_remat(mesh_fn):
         [sys.executable, "-c",
          f"import sys; sys.path.insert(0, {REPO!r}); "
          f"import jax; jax.config.update('jax_platforms', 'cpu'); "
-         f"jax.config.update('jax_compilation_cache_dir', {os.path.join(REPO, '.jax_cache')!r}); "
+         # NO persistent compile cache: the spmd_partitioner warning only
+         # fires during an actual compile — a cache hit would pass vacuously
          f"import __graft_entry__ as g; g.{mesh_fn}(8)"],
         env=env, cwd=REPO, capture_output=True, text=True, timeout=600)
     assert proc.returncode == 0, f"{mesh_fn} failed:\n{proc.stderr[-2000:]}"
