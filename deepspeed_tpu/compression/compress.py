@@ -22,7 +22,8 @@ import jax.numpy as jnp
 
 from deepspeed_tpu.compression import basic_layer as BL
 from deepspeed_tpu.compression.config import (CHANNEL_PRUNING,
-                                              DIFFERENT_GROUPS, HEAD_PRUNING, ROW_PRUNING,
+                                              DIFFERENT_GROUPS, HEAD_PRUNING,
+                                              LAYER_REDUCTION, ROW_PRUNING,
                                               SHARED_PARAMETERS, SPARSE_PRUNING,
                                               WEIGHT_QUANTIZATION, get_compression_config)
 from deepspeed_tpu.utils.logging import log_dist, logger
@@ -126,10 +127,64 @@ def build_compression_transform(params, ds_config: Dict[str, Any]) -> Optional[C
     return spec.transform() if spec.rules else None
 
 
+def _layer_key(prefix: str, idx: int) -> str:
+    """Reference dotted layer path → flax tree key: ``transformer.h`` + 3 →
+    ``h_3`` (our zoo names blocks ``{base}_{i}`` at one tree level)."""
+    base = prefix.replace(".", "/").rstrip("/").split("/")[-1]
+    return f"{base}_{idx}"
+
+
+def student_initialization(student_params, teacher_params, deepspeed_config):
+    """Reinitialize a shallower student from selected teacher layers
+    (reference ``student_initialization``, ``compress.py:192``): layer
+    ``teacher_layer[i]`` of the teacher seeds layer ``i`` of the student,
+    and ``other_module_name`` subtrees (embeddings, final LN, heads) copy
+    over verbatim. Operates on flax param PYTREES — the TPU analog of the
+    reference's ``recursive_getattr`` + ``param.data.copy_`` walk — and
+    returns a NEW student tree (host arrays; the caller places it)."""
+    cfg = get_compression_config(deepspeed_config if isinstance(deepspeed_config, dict)
+                                 else deepspeed_config.raw_dict)
+    lr = cfg[LAYER_REDUCTION]
+    if not lr.get("enabled", False):
+        return student_params
+    prefix = lr.get("module_name_prefix", "h")
+    teacher_layer = list(lr.get("teacher_layer", []))
+    other = list(lr.get("other_module_name", []))
+
+    out = dict(student_params)
+    for s_idx, t_idx in enumerate(teacher_layer):
+        t_key, s_key = _layer_key(prefix, int(t_idx)), _layer_key(prefix, s_idx)
+        if s_key not in out or t_key not in teacher_params:
+            raise KeyError(f"layer_reduction: student[{s_key}] or teacher[{t_key}] missing "
+                           f"(student keys: {sorted(student_params)[:8]}...)")
+        src, dst = teacher_params[t_key], out[s_key]
+        jax.tree.map(lambda a, b: None, src, dst)  # structure must match
+        out[s_key] = jax.tree.map(jnp.asarray, src)
+    for name in other:
+        key = name.replace(".", "/").rstrip("/").split("/")[-1]
+        if key not in teacher_params or key not in out:
+            raise KeyError(f"layer_reduction other_module_name {name!r}: {key!r} not a "
+                           f"top-level subtree of both trees")
+        out[key] = jax.tree.map(jnp.asarray, teacher_params[key])
+    n = sum(1 for _ in teacher_layer) + len(other)
+    log_dist(f"student_initialization: {n} subtrees seeded from the teacher "
+             f"(layers {teacher_layer} -> 0..{len(teacher_layer) - 1})")
+    return out
+
+
 def init_compression(model_or_engine, deepspeed_config=None, teacher_model=None, mpu=None):
     """Install compression on an engine (reference ``init_compression``
     compress.py:100 swaps modules in place; here the engine's jitted step
-    transforms the compute params). Returns its argument for API parity."""
+    transforms the compute params). Returns its argument for API parity.
+
+    ``teacher_model``: honored (reference ``compress.py:119``): required
+    when ``layer_reduction`` is enabled — the student's layers are seeded
+    from the teacher — and when ``knowledge_distillation`` is enabled the
+    teacher forward runs IN-GRAPH (stop-gradient) inside the student's
+    jitted step, its logit-KL and layerwise hidden-MSE terms mixed into
+    the loss under the schedule's in-graph gate. Accepts a flax module
+    (params from the engine's init rng), a ``(module, params)`` tuple, or
+    a torch module convertible via ``module_inject.from_hf``."""
     from deepspeed_tpu.runtime.engine import DeepSpeedEngine
 
     if isinstance(model_or_engine, DeepSpeedEngine):
@@ -141,6 +196,47 @@ def init_compression(model_or_engine, deepspeed_config=None, teacher_model=None,
         raw = deepspeed_config if isinstance(deepspeed_config, dict) else engine.config.raw_dict
         engine._compression_config = raw
         engine._compression_pending = True
+
+        cfg = get_compression_config(raw)
+        from deepspeed_tpu.compression.config import KNOWLEDGE_DISTILLATION, LAYER_REDUCTION as LR
+        needs_teacher = cfg[LR].get("enabled", False) or cfg[KNOWLEDGE_DISTILLATION]["enabled"]
+        if needs_teacher and teacher_model is None:
+            raise ValueError("Teacher model is required for layer reduction / knowledge "
+                             "distillation (reference compress.py:119)")
+        if cfg[KNOWLEDGE_DISTILLATION]["enabled"]:
+            # KD's schedule gate rides the fused step's in-graph counter; the
+            # host-driven optimizer schedules never inject it — fail loudly
+            # instead of silently training pure CE with a dead teacher forward
+            zc = engine.config.zero_config
+            off = zc.offload_optimizer is not None and getattr(
+                zc.offload_optimizer, "device", "none") not in (None, "none")
+            from deepspeed_tpu.runtime import constants as _C
+            onebit = engine.config.optimizer_name in (
+                _C.ONEBIT_ADAM_OPTIMIZER, _C.ONEBIT_LAMB_OPTIMIZER,
+                _C.ZERO_ONE_ADAM_OPTIMIZER)
+            if off or onebit:
+                raise ValueError("knowledge_distillation requires the fused "
+                                 "train_batch path; offload_optimizer and 1-bit/0-1 "
+                                 "Adam schedules never reach the KD gate")
+        if teacher_model is not None and needs_teacher:
+            t_module, t_params = _resolve_teacher(teacher_model, engine)
+            if cfg[LR].get("enabled", False):
+                engine._pending_student_init = (t_params, raw)
+                if engine.state is not None:
+                    new = student_initialization(
+                        jax.device_get(engine.state.params), jax.device_get(t_params), raw)
+                    engine.state = engine.state._replace(
+                        params=jax.device_put(new, engine.state_shardings.params))
+                    engine._pending_student_init = None
+            if cfg[KNOWLEDGE_DISTILLATION]["enabled"]:
+                engine._kd_config = dict(cfg[KNOWLEDGE_DISTILLATION],
+                                         module=t_module, params=t_params)
+                log_dist(f"knowledge distillation active: kd_coef="
+                         f"{engine._kd_config['kd_coef']} T={engine._kd_config['temperature']} "
+                         f"layerwise={engine._kd_config['layerwise_coef']} "
+                         f"steps [{engine._kd_config['schedule_offset']}, "
+                         f"{engine._kd_config['schedule_offset_end']})")
+
         # force a rebuild so the compression hook lands in the step program
         engine._train_step_fn = None
         if engine.state is not None:
@@ -149,6 +245,30 @@ def init_compression(model_or_engine, deepspeed_config=None, teacher_model=None,
         return engine
     raise TypeError("init_compression expects a DeepSpeedEngine; for raw flax params use "
                     "build_compression_transform(params, ds_config)")
+
+
+def _resolve_teacher(teacher_model, engine):
+    """Normalize teacher_model to (flax module, host param tree).
+
+    A bare flax ``nn.Module`` is REJECTED: flax modules carry no weights,
+    so accepting one would silently distill against freshly-initialized
+    noise — pass ``(module, trained_params)`` (or an HF torch module,
+    whose weights travel with it)."""
+    import flax.linen as fnn
+    if isinstance(teacher_model, tuple):
+        module, params = teacher_model
+        return module, jax.device_get(fnn.meta.unbox(params))
+    if isinstance(teacher_model, fnn.Module):
+        raise TypeError("teacher_model is a bare flax Module, which has no weights — "
+                        "pass (module, trained_params) so the student distills from "
+                        "the TRAINED teacher, not from a fresh init")
+    try:  # torch module → flax via the injection importer
+        from deepspeed_tpu.module_inject.from_hf import from_hf
+        module, params = from_hf(teacher_model)
+        return module, jax.device_get(params)
+    except Exception as e:  # noqa: BLE001
+        raise TypeError(f"teacher_model must be a (flax module, params) tuple or "
+                        f"an HF torch module ({type(teacher_model).__name__}: {e})")
 
 
 def redundancy_clean(params, deepspeed_config: Dict[str, Any], step: Optional[int] = None):

@@ -14,6 +14,20 @@ ROW_PRUNING = "row_pruning"
 HEAD_PRUNING = "head_pruning"
 CHANNEL_PRUNING = "channel_pruning"
 LAYER_REDUCTION = "layer_reduction"
+# staged knowledge distillation (the reference keeps the KD loss in its
+# example training scripts — DeepSpeedExamples model_compression — and the
+# schedule in compression/scheduler.py; here both live in the framework so
+# `teacher_model` passed to init_compression actually does something)
+KNOWLEDGE_DISTILLATION = "knowledge_distillation"
+
+_KD_DEFAULTS: Dict[str, Any] = dict(
+    enabled=False,
+    kd_coef=0.5,           # weight of the logit-KD term in the mixed loss
+    temperature=2.0,       # softmax temperature (Hinton KD); loss scales T^2
+    layerwise_coef=0.0,    # weight of the hidden-state MSE term (staged/layerwise)
+    schedule_offset=0,     # step the KD terms switch ON (in-graph gate)
+    schedule_offset_end=2 ** 31 - 1,  # step the KD terms switch back OFF
+)
 
 SHARED_PARAMETERS = "shared_parameters"
 DIFFERENT_GROUPS = "different_groups"
@@ -70,4 +84,9 @@ def get_compression_config(param_dict: Dict[str, Any]) -> Dict[str, Any]:
     lr = block.get(LAYER_REDUCTION, {}) or {}
     out[LAYER_REDUCTION] = dict(enabled=bool(lr.get("enabled", False)), **{
         k: v for k, v in lr.items() if k != "enabled"})
+    kd = block.get(KNOWLEDGE_DISTILLATION, {}) or {}
+    kd_out = dict(_KD_DEFAULTS)
+    kd_out.update(kd)
+    kd_out["enabled"] = bool(kd_out.get("enabled", False))
+    out[KNOWLEDGE_DISTILLATION] = kd_out
     return out

@@ -1,0 +1,93 @@
+"""Compression scheduling (reference ``compression/scheduler.py:12``
+``compression_scheduler``).
+
+On TPU the schedule gates are *in-graph* — ``jnp.where(step >= offset)``
+on the live step counter inside the jitted train step
+(``compress.CompressionSpec.transform``), so techniques activate without
+retraces and without this class. What the reference class additionally
+provides is host-side bookkeeping: a ``step()`` the training loop calls,
+``training_steps``, and activation logging/flags the moment a technique's
+offset is crossed. This class keeps that surface (and the KD schedule
+below) so reference training loops port unchanged.
+"""
+
+from typing import Any, Dict
+
+from deepspeed_tpu.compression.config import (CHANNEL_PRUNING, HEAD_PRUNING,
+                                              ROW_PRUNING, SHARED_PARAMETERS,
+                                              SPARSE_PRUNING, WEIGHT_QUANTIZATION,
+                                              get_compression_config)
+from deepspeed_tpu.utils.logging import log_dist
+
+_TECHNIQUES = (WEIGHT_QUANTIZATION, SPARSE_PRUNING, HEAD_PRUNING, ROW_PRUNING,
+               CHANNEL_PRUNING)
+ACTIVATION_QUANTIZATION = "activation_quantization"
+
+
+class compression_scheduler:
+    """Reference-shaped scheduler: tracks ``training_steps`` and reports
+    which techniques are active. ``model`` may be an engine, module, or
+    params pytree — activation is config-driven (offsets), not hook-driven,
+    so the model is held only for API parity."""
+
+    def __init__(self, model, compression_config: Dict[str, Any]):
+        self.model = model
+        # accept a raw ds_config or an already-resolved compression block
+        if WEIGHT_QUANTIZATION not in compression_config:
+            compression_config = get_compression_config(compression_config)
+        self.compression_config = compression_config
+        self.training_steps = 0
+        self.weight_quantization_enabled = False
+        self.verbose = {t: False for t in _TECHNIQUES}
+        self.verbose[ACTIVATION_QUANTIZATION] = False
+
+    def _offset(self, tech: str) -> int:
+        return int(self.compression_config[tech][SHARED_PARAMETERS].get(
+            "schedule_offset", 0))
+
+    def _enabled(self, tech: str) -> bool:
+        return bool(self.compression_config[tech][SHARED_PARAMETERS].get(
+            "enabled", False))
+
+    def is_active(self, tech: str) -> bool:
+        return self._enabled(tech) and self.training_steps >= self._offset(tech)
+
+    def _check(self, tech: str):
+        if not self._enabled(tech):
+            return
+        if self.training_steps >= self._offset(tech) and not self.verbose[tech]:
+            log_dist(f"{tech} is enabled at step {self.training_steps}")
+            self.verbose[tech] = True
+            if tech == WEIGHT_QUANTIZATION:
+                self.weight_quantization_enabled = True
+
+    def check_weight_quantization(self):
+        self._check(WEIGHT_QUANTIZATION)
+
+    def check_activation_quantization(self):
+        # activation quantization is not a weight transform; the engine's
+        # in-forward QDQ handles it — flag only
+        pass
+
+    def check_sparse_pruning(self):
+        self._check(SPARSE_PRUNING)
+
+    def check_head_pruning(self):
+        self._check(HEAD_PRUNING)
+
+    def check_row_pruning(self):
+        self._check(ROW_PRUNING)
+
+    def check_channel_pruning(self):
+        self._check(CHANNEL_PRUNING)
+
+    def check_all_modules(self):
+        for tech in _TECHNIQUES:
+            self._check(tech)
+
+    def step(self, step_zero_check: bool = False):
+        """Advance the step counter (reference increments then re-checks
+        every technique's gate)."""
+        if not step_zero_check:
+            self.training_steps += 1
+        self.check_all_modules()

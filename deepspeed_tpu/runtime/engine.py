@@ -221,6 +221,10 @@ class DeepSpeedEngine:
         # compression-in-forward (set via compression.init_compression)
         self._compression_pending = False
         self._compression_config = None
+        # staged knowledge distillation (compression.init_compression with
+        # teacher_model): in-graph teacher forward + scheduled loss mixing
+        self._kd_config = None
+        self._pending_student_init = None
         if config.quantize_training_config.get("enabled", False):
             # MoQ via config alone (no init_compression call) still resolves
             # once the param tree exists
@@ -494,6 +498,7 @@ class DeepSpeedEngine:
                                 params=params,
                                 opt_state=opt_state,
                                 loss_scale=ls_state)
+        self._maybe_apply_student_init()
         self._setup_offload_optimizer()
         self._setup_param_offload()
         self._build_step_fns()
@@ -1031,6 +1036,10 @@ class DeepSpeedEngine:
             extra = dict(extra,
                          labels=mb.get("labels", ids) if isinstance(mb, dict) else mb)
         has_pld = "pld_theta" in extra  # only set when the module accepts it
+        kd = self._kd_config if train else None
+        want_caps = (kd is not None and not fused_head
+                     and float(kd.get("layerwise_coef", 0.0)) > 0.0)
+        caps = None
         if train and (has_dropout or has_moe or has_pld):
             # 2-way split preserved when PLD is off: existing dropout/gating
             # rng streams are a reproducibility contract
@@ -1040,8 +1049,21 @@ class DeepSpeedEngine:
             else:
                 drop_key, gate_key = jax.random.split(key)
                 rngs = {"dropout": drop_key, "gating": gate_key}
-            outputs = self.module.apply({"params": cparams}, ids, deterministic=False,
-                                        rngs=rngs, **extra)
+            if want_caps:
+                outputs, ivars = self.module.apply(
+                    {"params": cparams}, ids, deterministic=False, rngs=rngs,
+                    capture_intermediates=self._kd_block_filter(), **extra)
+                caps = ivars["intermediates"]
+            else:
+                outputs = self.module.apply({"params": cparams}, ids, deterministic=False,
+                                            rngs=rngs, **extra)
+        elif want_caps:
+            # train without stochastic layers (dropout/moe/pld all off):
+            # deterministic apply, but layerwise KD still needs the captures
+            outputs, ivars = self.module.apply(
+                {"params": cparams}, ids, deterministic=True,
+                capture_intermediates=self._kd_block_filter(), **extra)
+            caps = ivars["intermediates"]
         else:
             # eval: deterministic gating (eval capacity factor, no RTS/noise);
             # the aux loss is a training-only regularizer — report pure CE
@@ -1049,7 +1071,145 @@ class DeepSpeedEngine:
             if has_moe and isinstance(outputs, (tuple, list)):
                 outputs = outputs[0]
         loss = outputs if fused_head else self.loss_fn(outputs, mb)
+        if kd is not None:
+            if fused_head:
+                raise ValueError("knowledge_distillation needs student logits; "
+                                 "fused_head_loss_chunk never materializes them — "
+                                 "disable one of the two")
+            loss = self._apply_kd(loss, outputs, ids, mb, caps, extra)
         return (loss * scale).astype(jnp.float32), loss
+
+    def _maybe_apply_student_init(self):
+        """Consume a staged layer_reduction seed (single implementation for
+        both the init_compression-after-state and initialize_state orders)."""
+        if self._pending_student_init is None or self.state is None:
+            return
+        from deepspeed_tpu.compression.compress import student_initialization
+        t_params, raw = self._pending_student_init
+        new = student_initialization(jax.device_get(self.state.params),
+                                     jax.device_get(t_params), raw)
+        self.state = self.state._replace(
+            params=jax.device_put(new, self.state_shardings.params))
+        self._pending_student_init = None
+
+    def _kd_block_filter(self, module=None):
+        """flax capture_intermediates filter selecting transformer blocks by
+        name (``h_3``/``layers_3``/...). Prefixes come from the KD config's
+        ``block_prefix`` override, else from the TARGET module's own
+        ``streamed_block_prefixes`` — the teacher's naming may differ from
+        the student's (GPT-2 ``h_`` vs LLaMA ``layers_``)."""
+        import re
+        kd = self._kd_config
+        prefixes = kd.get("block_prefix")
+        if prefixes is None:
+            prefixes = getattr(module if module is not None else self.module,
+                               "streamed_block_prefixes", ("h_",))
+        if isinstance(prefixes, str):
+            prefixes = (prefixes,)
+        pats = [re.compile(re.escape(p) + r"\d+") for p in prefixes]
+
+        def filt(mdl, method_name):
+            name = getattr(mdl, "name", None) or ""
+            return method_name == "__call__" and any(p.fullmatch(name) for p in pats)
+
+        return filt
+
+    @staticmethod
+    def _kd_hidden(caps, name):
+        """Block output from a capture tree: the first __call__'s return,
+        unwrapping (x, aux) block tuples to the hidden state."""
+        entry = caps[name]["__call__"][0]
+        return entry[0] if isinstance(entry, (tuple, list)) else entry
+
+    def _apply_kd(self, ce_loss, outputs, ids, mb, student_caps, extra_kwargs):
+        """Staged knowledge distillation (reference role: SLW scheduler
+        ``compression/scheduler.py`` + the KD losses its example training
+        scripts compute around ``init_compression``'s teacher). The teacher
+        forward runs IN-GRAPH under stop_gradient and under ``lax.cond`` on
+        the schedule gate — outside [schedule_offset, schedule_offset_end)
+        the loss is exactly CE and the teacher FLOPs are skipped. The logit
+        term is Hinton KL at temperature T (scaled T^2); the layerwise term
+        an MSE between matched block hiddens (student layer i vs teacher
+        layer ``teacher_layer[i]`` when layer_reduction maps them, else the
+        teacher's i-th block): loss = (1-a)·CE + a·KL + gate·lw·MSE with
+        a = kd_coef·gate.
+
+        Teacher placement: the teacher tree rides the trace as closed-over
+        device constants — one replicated copy per device. Fine for the
+        compress-a-model use case; a teacher near HBM capacity would need
+        sharded threading through the step signature (not implemented)."""
+        kd = self._kd_config
+        t_module, t_params = kd["module"], kd["params"]
+        step = mb.get("_kd_step") if isinstance(mb, dict) else None
+        if step is None:
+            # paths without in-graph step injection (shims) run pure CE
+            return ce_loss
+        step = jnp.asarray(step)
+        gate_on = ((step >= int(kd["schedule_offset"]))
+                   & (step < int(kd["schedule_offset_end"])))
+        want_caps = student_caps is not None
+        T = float(kd.get("temperature", 2.0))
+        lw = float(kd.get("layerwise_coef", 0.0))
+        kd_coef = float(kd.get("kd_coef", 0.5))
+        s_logits = outputs[0] if isinstance(outputs, (tuple, list)) else outputs
+
+        def kd_terms(_):
+            t_vars = {"params": jax.tree.map(jnp.asarray, t_params)}
+            t_kwargs = {k: v for k, v in (extra_kwargs or {}).items()
+                        if k in self._module_kwargs_names(t_module)}
+            if want_caps:
+                t_out, t_ivars = t_module.apply(
+                    t_vars, ids, deterministic=True,
+                    capture_intermediates=self._kd_block_filter(t_module), **t_kwargs)
+                t_caps = jax.lax.stop_gradient(t_ivars["intermediates"])
+            else:
+                t_out = t_module.apply(t_vars, ids, deterministic=True, **t_kwargs)
+            t_logits = t_out[0] if isinstance(t_out, (tuple, list)) else t_out
+            t_logits = jax.lax.stop_gradient(t_logits).astype(jnp.float32)
+            s = s_logits.astype(jnp.float32) / T
+            t = t_logits / T
+            t_prob = jax.nn.softmax(t, axis=-1)
+            kl = jnp.sum(t_prob * (jax.nn.log_softmax(t, axis=-1)
+                                   - jax.nn.log_softmax(s, axis=-1)), axis=-1)
+            kd_kl = jnp.mean(kl) * (T * T)
+            mse = jnp.float32(0.0)
+            if lw > 0.0 and want_caps:
+                from deepspeed_tpu.compression.config import (LAYER_REDUCTION,
+                                                              get_compression_config)
+                lr = get_compression_config(self._compression_config or {})[LAYER_REDUCTION]
+                s_names = sorted(student_caps.keys(),
+                                 key=lambda n: int(n.rsplit("_", 1)[-1]))
+                t_sorted = sorted(t_caps.keys(), key=lambda n: int(n.rsplit("_", 1)[-1]))
+                if lr.get("enabled", False) and lr.get("teacher_layer"):
+                    # indices into the TEACHER'S OWN block list (its prefix
+                    # may differ from the student's)
+                    idxs = [int(i) for i in lr["teacher_layer"]][:len(s_names)]
+                    t_names = [t_sorted[i] for i in idxs]
+                else:
+                    if len(t_sorted) < len(s_names):
+                        raise ValueError(
+                            f"layerwise KD: teacher has {len(t_sorted)} blocks for "
+                            f"{len(s_names)} student blocks and no layer_reduction "
+                            f"teacher_layer mapping; provide one")
+                    t_names = t_sorted[:len(s_names)]
+                for s_name, t_name in zip(s_names, t_names):
+                    hs = self._kd_hidden(student_caps, s_name).astype(jnp.float32)
+                    ht = self._kd_hidden(t_caps, t_name).astype(jnp.float32)
+                    mse = mse + jnp.mean(jnp.square(hs - ht))
+                mse = mse / max(len(s_names), 1)
+            return ((1.0 - kd_coef) * ce_loss + kd_coef * kd_kl
+                    + jnp.float32(lw) * mse).astype(jnp.float32)
+
+        return jax.lax.cond(gate_on, kd_terms,
+                            lambda _: ce_loss.astype(jnp.float32), operand=None)
+
+    @staticmethod
+    def _module_kwargs_names(module):
+        import inspect
+        try:
+            return set(inspect.signature(type(module).__call__).parameters)
+        except (TypeError, ValueError):
+            return set()
 
     def _moq_eigenvalue_factors(self):
         """Eigenvalue-modulated MoQ periods (reference ``engine.py`` wires
@@ -1288,6 +1448,10 @@ class DeepSpeedEngine:
                 theta = ((1.0 - pld.theta) * jnp.exp(-pld.gamma * state.step.astype(jnp.float32))
                          + pld.theta)
                 extra = {"pld_theta": theta}
+            if self._kd_config is not None:
+                # the KD schedule gate reads the live step counter in-graph
+                # (same mechanism as the PLD theta — no retrace on activation)
+                extra = dict(extra or {}, _kd_step=state.step)
             losses, grads, gnorm, overflow = self._accumulate_grads(
                 state.params, batch, rng, scale, grad_shardings, gas, clip, fp16,
                 params_transform=pt, model_extra=extra)
