@@ -63,8 +63,12 @@ class Experiment:
     zero_stage: int
     micro_batch_size: int
     config: Dict[str, Any]
+    tensor: int = 1
+    sequence: int = 1
+    offload: str = "none"          # none | optimizer | infinity
     status: str = "pending"        # pruned | compiled | measured | failed
     mem_bytes: Optional[int] = None
+    arg_bytes: Optional[int] = None  # device-resident inputs (state) alone
     flops: Optional[float] = None
     bytes_accessed: Optional[float] = None
     est_step_s: Optional[float] = None
@@ -74,7 +78,8 @@ class Experiment:
 
     def record(self) -> Dict[str, Any]:
         return {k: getattr(self, k) for k in
-                ("name", "zero_stage", "micro_batch_size", "status", "mem_bytes", "flops",
+                ("name", "zero_stage", "micro_batch_size", "tensor", "sequence", "offload",
+                 "status", "mem_bytes", "arg_bytes", "flops",
                  "bytes_accessed", "est_step_s", "measured_step_s", "metric_val", "error")} | {
                     "ds_config": self.config}
 
@@ -139,29 +144,51 @@ class Autotuner:
         return self.model_info
 
     # ------------------------------------------------------------------
-    def _dp_world(self) -> int:
-        if self.topology is not None:
+    def _dp_world(self, tensor: int = 1, sequence: int = 1) -> int:
+        if (tensor, sequence) == (1, 1) and self.topology is not None:
             return (self.topology.mesh.shape["data"] * self.topology.mesh.shape["fsdp"]
                     * self.topology.mesh.shape["expert"])
         import jax
-        return max(len(jax.devices()) // self.mp_size(), 1)
+        return max(len(jax.devices()) // max(self.mp_size(), tensor * sequence), 1)
 
-    def _build_engine(self, overrides: Dict[str, Any], micro_batch_size: int = 1):
+    def _candidate_topology(self, tensor: int, sequence: int):
+        """Mesh for a candidate: the user's topology when the mesh axes are
+        not being tuned, else a fresh tensor x sequence x (auto fsdp) carve —
+        same shape family as the dryrun/production meshes."""
+        if (tensor, sequence) == (1, 1):
+            return self.topology
+        from deepspeed_tpu.parallel.topology import MeshTopology
+        return MeshTopology(tensor=tensor, sequence=sequence)
+
+    def _build_engine(self, overrides: Dict[str, Any], micro_batch_size: int = 1,
+                      tensor: int = 1, sequence: int = 1, offload: str = "none"):
+        """Build the engine for a candidate from the SAME config dict that
+        gets recorded/emitted (``_candidate_config``) — one construction
+        path, so the benchmarked engine and the optimal-config artifact can
+        never drift."""
         import deepspeed_tpu
 
-        cfg = json.loads(json.dumps({k: v for k, v in self.user_config.items() if k != AUTOTUNING}))
-        zero = cfg.setdefault("zero_optimization", {})
-        if "zero_stage" in overrides:
-            zero["stage"] = overrides["zero_stage"]
-        gas = int(cfg.get("gradient_accumulation_steps", 1))
-        cfg["train_batch_size"] = micro_batch_size * gas * self._dp_world()
-        cfg.pop("train_micro_batch_size_per_gpu", None)
+        stage = overrides.get("zero_stage",
+                              (self.user_config.get("zero_optimization") or {}).get("stage", 0))
+        cfg = self._candidate_config(stage, micro_batch_size, tensor, sequence, offload)
+        cfg.pop("mesh", None)  # expressed as the topology object below
         model = self.model_factory(overrides)
-        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg, topology=self.topology)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, config=cfg, topology=self._candidate_topology(tensor, sequence))
         # candidate engines must never re-enter autotuning themselves
         # (DS_AUTOTUNING is still set in the environment)
         engine._autotune = None
         return engine
+
+    @staticmethod
+    def _apply_offload(zero: Dict[str, Any], offload: str) -> None:
+        if offload == "optimizer":
+            zero["offload_optimizer"] = {"device": "cpu"}
+        elif offload == "infinity":
+            # the full ZeRO-Infinity recipe (stage 3 enforced by candidate
+            # generation): params rest pinned-host + host C++ Adam
+            zero["offload_param"] = {"device": "cpu"}
+            zero["offload_optimizer"] = {"device": "cpu"}
 
     def _scaled_batch(self, global_batch: int):
         """Tile the user's example batch out to ``global_batch`` samples."""
@@ -178,7 +205,8 @@ class Autotuner:
         Returns True if the candidate fits."""
         peak_flops, peak_bw = _device_peaks()
         try:
-            engine = self._build_engine({"zero_stage": exp.zero_stage}, exp.micro_batch_size)
+            engine = self._build_engine({"zero_stage": exp.zero_stage}, exp.micro_batch_size,
+                                        exp.tensor, exp.sequence, exp.offload)
             batch = self._scaled_batch(engine.config.train_batch_size)
             compiled = engine.lower_train_step(batch).compile()
         except Exception as e:  # shape/mesh/unsupported combos prune cleanly
@@ -189,6 +217,7 @@ class Autotuner:
         if ma is not None:
             exp.mem_bytes = int(ma.argument_size_in_bytes + ma.output_size_in_bytes
                                 - ma.alias_size_in_bytes + ma.temp_size_in_bytes)
+            exp.arg_bytes = int(ma.argument_size_in_bytes)
         ca = compiled.cost_analysis()
         if ca:
             exp.flops = float(ca.get("flops", 0.0))
@@ -209,7 +238,8 @@ class Autotuner:
         at = self.autotuning_config
         steps = max(at.end_profile_step - at.start_profile_step, 1)
         try:
-            engine = self._build_engine({"zero_stage": exp.zero_stage}, exp.micro_batch_size)
+            engine = self._build_engine({"zero_stage": exp.zero_stage}, exp.micro_batch_size,
+                                        exp.tensor, exp.sequence, exp.offload)
             batch = self._scaled_batch(engine.config.train_batch_size)
             engine.initialize_state(batch)
             for _ in range(max(at.start_profile_step, 1)):  # warmup + compile
@@ -252,12 +282,15 @@ class Autotuner:
             return [0, 1, 2, 3]
         return [int(zs)]
 
-    def _mbs_ladder(self) -> List[int]:
+    def _mbs_ladder(self, tensor: int = 1, sequence: int = 1) -> List[int]:
         lo = max(self.min_train_micro_batch_size_per_gpu(), 1)
         hi = self.max_train_micro_batch_size_per_gpu()
         if self.autotuning_config.max_train_batch_size:
             gas = int(self.user_config.get("gradient_accumulation_steps", 1))
-            hi = min(hi, self.autotuning_config.max_train_batch_size // (gas * self._dp_world()))
+            # cap against the CANDIDATE's dp world: a tp=4 mesh has fewer dp
+            # replicas, so its per-replica micro-batch may legally be larger
+            hi = min(hi, self.autotuning_config.max_train_batch_size
+                     // (gas * self._dp_world(tensor, sequence)))
         ladder, v = [], lo
         while v <= hi:
             ladder.append(v)
@@ -275,17 +308,43 @@ class Autotuner:
         log_dist(f"autotuning: memory budget {mem_budget / 2**30:.2f} GiB, "
                  f"metric={self.metric()}, stages={self._stages_to_tune()}")
 
-        ladder = self._mbs_ladder()
+        import jax
+        n_dev = len(jax.devices())
+        at_cfg = self.autotuning_config
+        meshes = []
+        for t in sorted(set(int(x) for x in at_cfg.tp_sizes)):
+            for sq in sorted(set(int(x) for x in at_cfg.sp_sizes)):
+                if t * sq <= n_dev and n_dev % (t * sq) == 0:
+                    meshes.append((t, sq))
+                else:
+                    logger.warning(f"autotuning: mesh tensor={t} x sequence={sq} does not "
+                                   f"divide {n_dev} devices; skipped")
+        if not meshes:
+            raise ValueError(f"autotuning: no (tp, sp) pair from tp_sizes="
+                             f"{at_cfg.tp_sizes} x sp_sizes={at_cfg.sp_sizes} divides "
+                             f"{n_dev} devices — include 1 in the lists for a baseline")
         for stage in self._stages_to_tune():
-            for mbs in ladder:
-                exp = Experiment(name=f"z{stage}_mbs{mbs}", zero_stage=stage,
-                                 micro_batch_size=mbs, config=self._candidate_config(stage, mbs))
-                self.records.append(exp)
-                if not self._compile_candidate(exp, mem_budget):
-                    # doubling mbs only grows memory: end this stage's ladder
-                    # on the first pruned (or failed) candidate — reference
-                    # get_min_max_micro_batch_size stops the same way
-                    break
+            offloads = ["none"]
+            if at_cfg.tune_offload:
+                offloads.append("optimizer")
+                if stage == 3:
+                    offloads.append("infinity")
+            for t, sq in meshes:
+                for off in offloads:
+                    suffix = (f"_tp{t}" if t > 1 else "") + (f"_sp{sq}" if sq > 1 else "") \
+                        + (f"_{off}" if off != "none" else "")
+                    for mbs in self._mbs_ladder(t, sq):
+                        exp = Experiment(name=f"z{stage}_mbs{mbs}{suffix}",
+                                         zero_stage=stage, micro_batch_size=mbs,
+                                         tensor=t, sequence=sq, offload=off,
+                                         config=self._candidate_config(stage, mbs, t, sq, off))
+                        self.records.append(exp)
+                        if not self._compile_candidate(exp, mem_budget):
+                            # doubling mbs only grows memory: end this ladder
+                            # on the first pruned (or failed) candidate —
+                            # reference get_min_max_micro_batch_size stops
+                            # the same way
+                            break
 
         survivors = [e for e in self.records if e.status == "compiled"]
         for exp in survivors:
@@ -293,6 +352,14 @@ class Autotuner:
 
         if at.measure and survivors:
             top = sorted(survivors, key=lambda e: e.metric_val or 0.0, reverse=True)[:at.top_k]
+            # offload estimates come from the grads-only device program and
+            # omit host-update time — optimistic. Guarantee the best DENSE
+            # survivor is also measured so offload crowding the top_k can
+            # never shadow a faster dense config.
+            if any(e.offload != "none" for e in top):
+                dense = [e for e in survivors if e.offload == "none" and e not in top]
+                if dense:
+                    top.append(max(dense, key=lambda e: e.metric_val or 0.0))
             for exp in top:
                 self._measure_candidate(exp)
                 if exp.status == "measured":
@@ -310,12 +377,17 @@ class Autotuner:
                      f"{len(self.records)} experiments, {time.time() - self.start_time:.0f}s)")
         return self.best
 
-    def _candidate_config(self, stage: int, mbs: int) -> Dict[str, Any]:
+    def _candidate_config(self, stage: int, mbs: int, tensor: int = 1,
+                          sequence: int = 1, offload: str = "none") -> Dict[str, Any]:
         cfg = json.loads(json.dumps({k: v for k, v in self.user_config.items() if k != AUTOTUNING}))
-        cfg.setdefault("zero_optimization", {})["stage"] = stage
+        zero = cfg.setdefault("zero_optimization", {})
+        zero["stage"] = stage
+        self._apply_offload(zero, offload)
         gas = int(cfg.get("gradient_accumulation_steps", 1))
-        cfg["train_batch_size"] = mbs * gas * self._dp_world()
+        cfg["train_batch_size"] = mbs * gas * self._dp_world(tensor, sequence)
         cfg["train_micro_batch_size_per_gpu"] = mbs
+        if tensor > 1 or sequence > 1:
+            cfg["mesh"] = {"tensor": tensor, "sequence": sequence}
         return cfg
 
     # ------------------------------------------------------------------

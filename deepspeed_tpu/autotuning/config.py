@@ -61,6 +61,16 @@ class DeepSpeedAutotuningConfig(BaseModel):
     measure: bool = True
     # how many compile-survivors get real timed steps
     top_k: int = 3
+    # mesh-axis search space: tensor/sequence sizes to explore per (stage,
+    # mbs) point. The reference fixes mp_size as an input (autotuner mp_size
+    # knob); here the mesh IS a tunable — candidates whose axes don't divide
+    # the device count or the model's heads prune at compile. [1] = off.
+    tp_sizes: List[int] = [1]
+    sp_sizes: List[int] = [1]
+    # explore ZeRO-Offload / ZeRO-Infinity variants: adds offload_optimizer
+    # (any stage) and offload_param+offload_optimizer (stage 3) candidates —
+    # the configs that trade HBM for host traffic when nothing dense fits
+    tune_offload: bool = False
 
     model_config = ConfigDict(extra="ignore")
 

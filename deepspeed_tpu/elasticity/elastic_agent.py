@@ -134,6 +134,15 @@ class DSElasticAgent:
         # unique per-agent file: two supervisors sharing a workdir must not
         # keep each other's heartbeat fresh (masked hangs)
         heartbeat_path = os.path.join(workdir, f".ds_elastic_heartbeat.{os.getpid()}")
+        try:
+            return self._run(heartbeat_path)
+        finally:
+            try:
+                os.unlink(heartbeat_path)
+            except OSError:
+                pass
+
+    def _run(self, heartbeat_path: str) -> int:
         while True:
             idx = min(self.restart_count, len(self.world_sizes) - 1)
             world = self.world_sizes[idx]
@@ -167,7 +176,10 @@ class DSElasticAgent:
                     phase = "startup" if mt <= armed_mtime else "heartbeat"
                     reason = f"{phase} silent {age:.1f}s (hung backend)"
                     self._kill(proc)
-                    rc = proc.returncode if proc.returncode is not None else -9
+                    # a graceful SIGTERM handler may exit 0 — the AGENT
+                    # declared this attempt dead; rc must reflect that or a
+                    # 5%-done job would be reported as finished
+                    rc = proc.returncode if proc.returncode not in (None, 0) else -9
                     break
                 time.sleep(self.poll_interval)
             self.history.append(dict(world_size=world, rc=rc, reason=reason,

@@ -523,9 +523,6 @@ class DeepSpeedEngine:
         ``cost_analysis()`` — the TPU replacement for the reference
         autotuner's experiment launches (``autotuning/autotuner.py:1052``)."""
         abstract = self.abstract_state(example_batch)
-        if self._offload_enabled:
-            raise NotImplementedError("lower_train_step covers the on-device step only "
-                                      "(offload_optimizer candidates cannot be costed abstractly)")
         gas = self.config.gradient_accumulation_steps
 
         def leaf(x):
@@ -535,6 +532,11 @@ class DeepSpeedEngine:
 
         abatch = jax.tree.map(leaf, example_batch)
         arng = jax.ShapeDtypeStruct(self._base_rng.shape, self._base_rng.dtype)
+        if self._offload_enabled:
+            # offload_optimizer: the device program is the grads-only pass
+            # (the update runs on host) — its memory_analysis IS the
+            # candidate's HBM footprint, which is what the autotuner prunes on
+            return self._grads_only_fn.lower(abstract.params, abatch, arng)
         if getattr(self, "_param_offload_enabled", False):
             # the offload step fn splits (params, rest) so the device-resident
             # rest can be donated; memory_analysis() of this lowering is the

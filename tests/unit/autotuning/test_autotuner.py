@@ -198,3 +198,58 @@ def test_model_factory_overrides(tmp_path):
     best = tuner.tune()
     assert best is not None
     assert {"zero_stage": 2} in seen
+
+
+def test_mesh_axis_search_picks_tensor_when_pure_dp_ooms(tmp_path):
+    """VERDICT r3 #9: with a memory budget pure-DP cannot meet at any
+    micro-batch, the tuner must explore the tensor axis and pick a
+    non-trivial (stage, mbs, tensor) candidate that fits."""
+    cfg = get_gpt2_config("test", n_layer=2, n_embd=128, n_head=4)
+
+    # calibrate: per-chip bytes of the pure-DP stage-0 candidate at mbs 1,
+    # then set the budget just below it so every tensor=1 candidate prunes
+    probe = Autotuner(model=GPT2LMHeadModel(cfg),
+                      config=_user_config(tmp_path, zero_stages=[0]),
+                      example_batch=_example_batch(cfg))
+    probe.tune()
+    dense_bytes = min(e.mem_bytes for e in probe.records if e.mem_bytes)
+
+    user = _user_config(tmp_path, zero_stages=[0, 3],
+                        tp_sizes=[1, 2], max_train_micro_batch_size_per_gpu=2,
+                        mem_budget_bytes=int(dense_bytes * 0.95))
+    tuner = Autotuner(model=GPT2LMHeadModel(cfg), config=user,
+                      example_batch=_example_batch(cfg))
+    best = tuner.tune()
+    assert best is not None, [e.record() for e in tuner.records]
+    assert best.tensor == 2 or best.zero_stage == 3, best.record()
+    # every pure-DP stage-0 candidate was pruned by the budget
+    dense_exps = [e for e in tuner.records if e.tensor == 1 and e.zero_stage == 0]
+    assert dense_exps and all(e.status in ("pruned", "failed") for e in dense_exps)
+    # the winner carries its mesh into the emitted optimal config
+    if best.tensor > 1:
+        assert best.config["mesh"]["tensor"] == 2
+
+
+def test_offload_candidates_compile_and_rank(tmp_path):
+    """tune_offload adds offload_optimizer and (stage 3) ZeRO-Infinity
+    candidates; their device-side programs compile and carry smaller HBM
+    footprints than the dense step."""
+    cfg = get_gpt2_config("test", n_layer=2)
+    user = _user_config(tmp_path, zero_stages=[3], tune_offload=True,
+                        max_train_micro_batch_size_per_gpu=1)
+    tuner = Autotuner(model=GPT2LMHeadModel(cfg), config=user,
+                      example_batch=_example_batch(cfg))
+    tuner.tune()
+    by_off = {e.offload: e for e in tuner.records if e.status == "compiled"}
+    assert "none" in by_off and "optimizer" in by_off and "infinity" in by_off, \
+        [(e.name, e.status, e.error[:80]) for e in tuner.records]
+    # offload variants keep optimizer state (and for infinity, params) off
+    # the device: the device-RESIDENT inputs (arg bytes) must shrink —
+    # total mem at toy scale is activation-dominated, so args are the
+    # discriminating signal
+    assert by_off["optimizer"].arg_bytes < by_off["none"].arg_bytes
+    # infinity additionally rests params in host space; XLA:CPU folds host
+    # args into argument_size (host_argument_size is TPU-only), so the
+    # CPU-checkable claim is "no worse than optimizer offload" — the
+    # param-side split is pinned by test_param_offload's S(5) entry check
+    assert by_off["infinity"].arg_bytes <= by_off["optimizer"].arg_bytes
