@@ -145,20 +145,29 @@ class Autotuner:
 
     # ------------------------------------------------------------------
     def _dp_world(self, tensor: int = 1, sequence: int = 1) -> int:
-        if (tensor, sequence) == (1, 1) and self.topology is not None:
-            return (self.topology.mesh.shape["data"] * self.topology.mesh.shape["fsdp"]
-                    * self.topology.mesh.shape["expert"])
+        if (tensor, sequence) == (1, 1):
+            if self.topology is not None:
+                return (self.topology.mesh.shape["data"] * self.topology.mesh.shape["fsdp"]
+                        * self.topology.mesh.shape["expert"])
+            import jax
+            return max(len(jax.devices()) // self.mp_size(), 1)
+        # tuned mesh: candidates resolve their topology from the config's
+        # mesh block (see _candidate_topology) — dp is what that resolution
+        # yields: everything not on the tensor/sequence axes
         import jax
-        return max(len(jax.devices()) // max(self.mp_size(), tensor * sequence), 1)
+        return max(len(jax.devices()) // (tensor * sequence), 1)
 
     def _candidate_topology(self, tensor: int, sequence: int):
-        """Mesh for a candidate: the user's topology when the mesh axes are
-        not being tuned, else a fresh tensor x sequence x (auto fsdp) carve —
-        same shape family as the dryrun/production meshes."""
+        """Mesh for a candidate. When the axes are NOT being tuned, the
+        user's topology passes through. When they are, return None so the
+        ENGINE resolves the mesh from the candidate config's mesh block —
+        the same resolve_topology_axes path production takes with the
+        emitted ds_config_optimal.json, including the stage-aware fsdp
+        carve (a hand-built MeshTopology(tensor=t) would leave fsdp=1 and
+        benchmark a mesh the shipped config never produces)."""
         if (tensor, sequence) == (1, 1):
             return self.topology
-        from deepspeed_tpu.parallel.topology import MeshTopology
-        return MeshTopology(tensor=tensor, sequence=sequence)
+        return None
 
     def _build_engine(self, overrides: Dict[str, Any], micro_batch_size: int = 1,
                       tensor: int = 1, sequence: int = 1, offload: str = "none"):
@@ -171,7 +180,6 @@ class Autotuner:
         stage = overrides.get("zero_stage",
                               (self.user_config.get("zero_optimization") or {}).get("stage", 0))
         cfg = self._candidate_config(stage, micro_batch_size, tensor, sequence, offload)
-        cfg.pop("mesh", None)  # expressed as the topology object below
         model = self.model_factory(overrides)
         engine, _, _, _ = deepspeed_tpu.initialize(
             model=model, config=cfg, topology=self._candidate_topology(tensor, sequence))
@@ -310,10 +318,9 @@ class Autotuner:
 
         import jax
         n_dev = len(jax.devices())
-        at_cfg = self.autotuning_config
         meshes = []
-        for t in sorted(set(int(x) for x in at_cfg.tp_sizes)):
-            for sq in sorted(set(int(x) for x in at_cfg.sp_sizes)):
+        for t in sorted(set(int(x) for x in at.tp_sizes)):
+            for sq in sorted(set(int(x) for x in at.sp_sizes)):
                 if t * sq <= n_dev and n_dev % (t * sq) == 0:
                     meshes.append((t, sq))
                 else:
@@ -321,11 +328,11 @@ class Autotuner:
                                    f"divide {n_dev} devices; skipped")
         if not meshes:
             raise ValueError(f"autotuning: no (tp, sp) pair from tp_sizes="
-                             f"{at_cfg.tp_sizes} x sp_sizes={at_cfg.sp_sizes} divides "
+                             f"{at.tp_sizes} x sp_sizes={at.sp_sizes} divides "
                              f"{n_dev} devices — include 1 in the lists for a baseline")
         for stage in self._stages_to_tune():
             offloads = ["none"]
-            if at_cfg.tune_offload:
+            if at.tune_offload:
                 offloads.append("optimizer")
                 if stage == 3:
                     offloads.append("infinity")
@@ -357,9 +364,10 @@ class Autotuner:
             # survivor is also measured so offload crowding the top_k can
             # never shadow a faster dense config.
             if any(e.offload != "none" for e in top):
-                dense = [e for e in survivors if e.offload == "none" and e not in top]
+                dense = [e for e in survivors if e.offload == "none" and e not in top
+                         and e.metric_val is not None]
                 if dense:
-                    top.append(max(dense, key=lambda e: e.metric_val or 0.0))
+                    top.append(max(dense, key=lambda e: e.metric_val))
             for exp in top:
                 self._measure_candidate(exp)
                 if exp.status == "measured":
@@ -387,7 +395,11 @@ class Autotuner:
         cfg["train_batch_size"] = mbs * gas * self._dp_world(tensor, sequence)
         cfg["train_micro_batch_size_per_gpu"] = mbs
         if tensor > 1 or sequence > 1:
-            cfg["mesh"] = {"tensor": tensor, "sequence": sequence}
+            # merge over any user mesh block: tuned axes override, the rest
+            # (pipe/expert/data) keep the user's intent
+            mesh = dict(cfg.get("mesh") or {})
+            mesh.update(tensor=tensor, sequence=sequence)
+            cfg["mesh"] = mesh
         return cfg
 
     # ------------------------------------------------------------------

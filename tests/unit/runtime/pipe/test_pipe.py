@@ -214,3 +214,115 @@ def test_pipeline_checkpoint_roundtrip(tmp_path):
     assert e2.global_steps == 2
     l1, l2 = float(e1.train_batch(batch)), float(e2.train_batch(batch))
     assert abs(l1 - l2) < 1e-6
+
+
+# ---------------------------------------------------------------------------
+# 4-stage pipeline (VERDICT r4 #10: nothing validated >2 stages before)
+# ---------------------------------------------------------------------------
+def test_pipeline_matches_dense_loss_4stage():
+    """4 pipeline stages x fsdp, tied embeddings: eval loss must equal the
+    dense model's on the same (re-assembled) weights."""
+    cfg = get_gpt2_config("test", n_layer=4)
+    topo = MeshTopology(pipe=4, data=1, fsdp=2)
+    pipe = PipelineModule(layers=gpt2_pipe_layers(cfg), topology=topo)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=pipe, config={"train_batch_size": 8,
+                            "gradient_accumulation_steps": 2,
+                            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+        topology=topo)
+    rng = np.random.default_rng(5)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (8, 32)).astype(np.int32)}
+    engine.initialize_state(batch)
+    pipe_loss = float(engine.eval_batch(batch))
+
+    set_topology(None)
+    dense_params = _dense_params_from_pipe(jax.device_get(engine.state.params), cfg.n_layer)
+    model = GPT2LMHeadModel(cfg)
+    logits = model.apply({"params": dense_params}, jnp.asarray(batch["input_ids"]),
+                         deterministic=True)
+    dense_loss = float(cross_entropy_loss(logits[:, :-1], jnp.asarray(batch["input_ids"])[:, 1:]))
+    np.testing.assert_allclose(pipe_loss, dense_loss, rtol=2e-5)
+
+
+def test_pipeline_trains_4stage_tied_grads():
+    """4-stage training decreases the loss, and the tied wte gradient (used
+    by stage 0's lookup and stage 3's head — 3 stages apart) matches the
+    dense ground truth."""
+    cfg = get_gpt2_config("test", n_layer=4)
+    topo = MeshTopology(pipe=4, data=1, fsdp=2)
+    pipe = PipelineModule(layers=gpt2_pipe_layers(cfg), topology=topo)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=pipe, config={"train_batch_size": 8,
+                            "gradient_accumulation_steps": 4,
+                            "optimizer": {"type": "AdamW", "params": {"lr": 2e-3}},
+                            "zero_optimization": {"stage": 1}},
+        topology=topo)
+    rng = np.random.default_rng(6)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (8, 32)).astype(np.int32)}
+    engine.initialize_state(batch)
+
+    # tied-grad parity at 4 stages
+    ids = jnp.asarray(batch["input_ids"])
+    pipe_params = jax.device_get(engine.state.params)
+    fn = engine._pipeline_loss_fn()
+    ids_mb = ids.reshape(4, 2, 32)  # [micro=4, mb, seq]
+
+    with engine.mesh:
+        g_pipe = jax.jit(jax.grad(lambda p: fn(p, ids_mb, ids_mb)))(pipe_params)[
+            "tied_embed"]["wte"]
+    set_topology(None)
+    dense_params = _dense_params_from_pipe(pipe_params, cfg.n_layer)
+    model = GPT2LMHeadModel(cfg)
+
+    def dense_loss(p):
+        losses = []
+        for i in range(4):
+            sub = ids[2 * i:2 * i + 2]
+            logits = model.apply({"params": p}, sub, deterministic=True)
+            losses.append(cross_entropy_loss(logits[:, :-1], sub[:, 1:]))
+        return jnp.mean(jnp.stack(losses))
+
+    g_dense = jax.grad(dense_loss)(dense_params)["wte"]
+    np.testing.assert_allclose(np.asarray(g_pipe, np.float32),
+                               np.asarray(g_dense, np.float32), atol=2e-5)
+
+    set_topology(engine.topology)
+    losses = [float(engine.train_batch(batch)) for _ in range(8)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], f"4-stage pipeline loss did not fall: {losses}"
+
+
+def test_scan_matches_train_schedule_parity_4stage():
+    """The scan engine's tick structure is the TrainSchedule's: per stage M
+    forwards + M backwards in 2(M+S-1) ticks, and the scan's forward span
+    (micro + stages - 1) equals the schedule's last ForwardPass tick + 1 —
+    at 4 stages."""
+    cfg = get_gpt2_config("test", n_layer=4)
+    topo = MeshTopology(pipe=4, data=1, fsdp=2)
+    pipe = PipelineModule(layers=gpt2_pipe_layers(cfg), topology=topo)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=pipe, config={"train_batch_size": 8,
+                            "gradient_accumulation_steps": 4,
+                            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+        topology=topo)
+    M, S = engine.micro_batches, engine.pipeline.num_stages
+    assert S == 4 and M == 4
+    scan_fwd_ticks = M + S - 1  # the engine's n_ticks (pipe/engine.py tick loop)
+    last_fwd_tick = -1
+    for stage in range(S):
+        steps = list(engine._reference_schedule(stage).steps())
+        assert len(steps) == 2 * (M + S - 1)
+        fwd_ticks = [i for i, cmds in enumerate(steps)
+                     for c in cmds if isinstance(c, sched.ForwardPass)]
+        assert len(fwd_ticks) == M
+        last_fwd_tick = max(last_fwd_tick, *fwd_ticks)
+        bwd = sum(1 for cmds in steps for c in cmds if isinstance(c, sched.BackwardPass))
+        assert bwd == M
+    # interleaving differs BY DESIGN: TrainSchedule is 1F1B (stage s runs
+    # fwd of micro m at tick s + 2m — each later micro waits out one bwd
+    # slot), while the scan engine is GPipe-ordered (fwd at tick s + m; the
+    # backward is the scan's transpose) with remat playing 1F1B's
+    # memory-bounding role. The schedules agree on the instruction
+    # multiset (asserted above) and the tick algebra maps one onto the
+    # other: reference_last_fwd = scan_last_fwd + (M - 1).
+    assert last_fwd_tick == (scan_fwd_ticks - 1) + (M - 1), (last_fwd_tick, scan_fwd_ticks)
