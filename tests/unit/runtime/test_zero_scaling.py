@@ -46,10 +46,13 @@ def test_zero3_per_chip_wire_bytes_flat_in_world_size():
     b2, b4, b8 = (_per_chip_payload(n) for n in (2, 4, 8))
     assert b2 > 0 and b4 > 0 and b8 > 0
     # collective RESULT bytes in SPMD HLO are per-chip global-shaped
-    # (all-gather result = full params regardless of N); weak scaling means
-    # doubling the mesh does not grow what each chip moves by more than the
-    # (N-1)/N ring factor — allow 35% headroom for compiler variation
-    assert b8 <= 1.35 * b4 <= 1.35 * 1.35 * b2, (b2, b4, b8)
+    # (all-gather result = full params regardless of N), so weak scaling
+    # means per-chip bytes may not grow past a doubling by more than a
+    # small compiler epsilon. Measured (r4): payload DROPS with N at this
+    # scale (0.89x/0.87x per doubling — more reduce-scatters, smaller
+    # per-chip shards); the 5% headroom is compiler variation only (the
+    # pre-r3 broken plan blew through any bound at 4x+)
+    assert b8 <= 1.05 * b4 <= 1.05 * 1.05 * b2, (b2, b4, b8)
 
 
 def _load_scaling_report(**pins):
@@ -85,8 +88,9 @@ def test_zero3_no_batch_replication_at_scale():
     p16, _ = scaling_report.run_mesh(16)
     p64, _ = scaling_report.run_mesh(64)
     assert p16 > 0 and p64 > 0
-    # flat within ring-factor + compiler headroom; the broken plan gave 4x
-    assert p64 <= 1.35 * p16, (p16, p64)
+    # measured flat at 1.000 (PERF.md r3, 991.8 MB/chip at 8..256); 5%
+    # epsilon is compiler variation — the broken plan gave 4x over 16->64
+    assert p64 <= 1.05 * p16, (p16, p64)
 
 
 def test_moe_ep_no_token_gather_at_scale():
@@ -100,6 +104,34 @@ def test_moe_ep_no_token_gather_at_scale():
     p8, _ = scaling_report.run_mesh(8)
     p16, _ = scaling_report.run_mesh(16)
     assert p8 > 0 and p16 > 0
-    # broken plan gave ~1.42x here; ring factor + gating-mask growth stay
-    # well under 1.25x
-    assert p16 <= 1.25 * p8, (p8, p16)
+    # measured 1.0154 for 8->16 (PERF.md r3: 634.9 -> 644.7 MB/chip); the
+    # inherent term is the [G,S,E] gating masks (E grows with the mesh) —
+    # budget 10%. The broken plan gave ~1.42x per doubling.
+    assert p16 <= 1.10 * p8, (p8, p16)
+
+
+def test_tp_mesh_per_chip_payload_flat():
+    """Mixed-mesh budget (the LLaMA + ZeRO++ ladder shape): tensor axis
+    fixed at 2 while fsdp grows 4x — per-chip payload must stay flat with
+    the TP collectives riding alongside the ZeRO-3 gathers (measured
+    763.97 MB/chip flat at 8/16/64, PERF.md r3)."""
+    scaling_report = _load_scaling_report(TP=2)
+
+    p8, _ = scaling_report.run_mesh(8)
+    p32, _ = scaling_report.run_mesh(32)
+    assert p8 > 0 and p32 > 0
+    assert p32 <= 1.05 * p8, (p8, p32)
+
+
+def test_zero3_flat_to_512_virtual_chips():
+    """The weak-scaling invariant holds at the 512-chip mark (BASELINE's
+    8->256 span, then double again): per-chip payload at 512 must not
+    exceed the 8-chip payload + epsilon. Test-size model — the invariant
+    is scale-free and XLA's 512-partition compile of a realistic model
+    runs >30 min (scaling_report docstring); measured ratio here: 0.68."""
+    scaling_report = _load_scaling_report(MODEL="test", SEQ=64, VOCAB=512 * 99)
+
+    p8, _ = scaling_report.run_mesh(8)
+    p512, _ = scaling_report.run_mesh(512)
+    assert p8 > 0 and p512 > 0
+    assert p512 <= 1.05 * p8, (p8, p512)

@@ -16,13 +16,21 @@ the parent prints one JSON line per N plus a verdict. Pure-CPU work — safe
 to run with the TPU tunnel down.
 
 Run: python tools/scaling_report.py          [MODEL=125m SEQ=128 MB_PER_CHIP=1]
+     Default meshes 8,16,64,256. MESHES=8,64,512 reaches 512 virtual
+     chips — supported, but XLA's 512-partition CPU compile of the 125m
+     step runs >30 min on a 14-core host (use MODEL=test SEQ=64 for a
+     tractable 512-way check; the invariant is scale-free).
 """
 import json
 import os
 import subprocess
 import sys
 
-MESHES = [int(n) for n in os.environ.get("MESHES", "8,16,64,256").split(",")]
+_DEFAULT_MESHES = "8,16,64" if int(os.environ.get("MOE", "0")) else "8,16,64,256"
+# MoE default stops at 64: the [G,S,E] gating-mask payload is inherent and
+# ~linear in total experts (E = k*N), so past the calibrated 8->64 span the
+# verdict would flag healthy plans; override MESHES to look further.
+MESHES = [int(n) for n in os.environ.get("MESHES", _DEFAULT_MESHES).split(",")]
 MODEL = os.environ.get("MODEL", "125m")
 SEQ = int(os.environ.get("SEQ", "128"))
 MB_PER_CHIP = int(os.environ.get("MB_PER_CHIP", "1"))
@@ -107,8 +115,13 @@ def main():
         return 2
     base_n = MESHES[0]
     worst = max(results[n] / results[base_n] for n in MESHES[1:])
-    flat = worst <= 1.35  # (N-1)/N ring factor + compiler headroom
-    print(json.dumps({"model": MODEL, "weak_scaling_flat": flat,
+    # fsdp/TP meshes measure flat at 1.000 (PERF.md r3) — 10% budget total.
+    # MoE carries the inherent [G,S,E] gating-mask term (E grows with the
+    # mesh): 35% over the calibrated 8->64 span (measured 1.315; the
+    # default MoE mesh list stops at 64 for exactly this reason).
+    bound = 1.35 if MOE else 1.10
+    flat = worst <= bound
+    print(json.dumps({"model": MODEL, "weak_scaling_flat": flat, "bound": bound,
                       "max_payload_growth_vs_first": round(worst, 3)}), flush=True)
     return 0 if flat else 1
 
