@@ -153,12 +153,13 @@ class Autotuner:
             return max(len(jax.devices()) // self.mp_size(), 1)
         # tuned mesh: candidates resolve their topology from the config's
         # mesh block (see _candidate_topology) — dp is what that resolution
-        # yields: everything not on the tensor/sequence axes, minus any
-        # pipe/expert axes the user's own mesh block pins (the merge in
-        # _candidate_config preserves them, so the batch triangle must too)
+        # yields: everything not on the tensor/sequence axes, minus a
+        # user-pinned pipe axis (preserved by the _candidate_config merge).
+        # expert stays OUT of the divisor: the expert axis carries batch
+        # (dp_world includes it everywhere else — topology.data_parallel_size)
         import jax
         um = self.user_config.get("mesh") or {}
-        fixed = tensor * sequence * int(um.get("pipe", 1)) * int(um.get("expert", 1))
+        fixed = tensor * sequence * int(um.get("pipe", 1))
         return max(len(jax.devices()) // max(fixed, 1), 1)
 
     def _candidate_topology(self, tensor: int, sequence: int):

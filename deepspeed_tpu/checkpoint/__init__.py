@@ -1,6 +1,9 @@
 """Checkpoint tools (reference ``deepspeed/checkpoint`` + ``utils/zero_to_fp32.py``):
 offline fp32/bf16 consolidation and the universal (HP-fragment) format."""
 
+from deepspeed_tpu.checkpoint.reshape_meg_2d import (get_mpu_ranks,
+                                                     meg_2d_parallel_map,
+                                                     reshape_meg_2d_parallel)
 from deepspeed_tpu.checkpoint.universal_checkpoint import (ds_to_universal,
                                                            load_universal_fragments,
                                                            load_universal_into_state,
@@ -12,4 +15,5 @@ from deepspeed_tpu.checkpoint.zero_to_fp32 import (convert_zero_checkpoint_to_fp
 __all__ = ["convert_zero_checkpoint_to_fp32_state_dict",
            "get_fp32_state_dict_from_zero_checkpoint", "load_state_dict_from_npz",
            "ds_to_universal", "load_universal_fragments", "load_universal_into_state",
-           "universal_metadata"]
+           "universal_metadata", "reshape_meg_2d_parallel", "meg_2d_parallel_map",
+           "get_mpu_ranks"]

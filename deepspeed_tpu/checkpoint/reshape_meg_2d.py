@@ -1,0 +1,146 @@
+"""Offline 2-D (pipeline x tensor) checkpoint regrouping maps
+(reference ``checkpoint/reshape_meg_2d.py:80`` ``reshape_meg_2d_parallel``
+and ``checkpoint/deepspeed_checkpoint.py:33``'s 2-D file maps).
+
+Pure index bookkeeping: given checkpoints written by a pp_old x tp_old
+job, decide which OLD shard files each NEW (pp, tp) rank must read. Both
+degrees may only change by integer factors (merge k:1 or split 1:k) — the
+same contract the reference enforces. The actual tensor surgery is done by
+``runtime/state_dict_factory.MegatronSDLoader`` (TP merge/split with
+Megatron key conventions); ``bin/ds_reshape_ckpt`` wires the two into the
+offline CLI.
+
+On TPU this tool matters for IMPORTING Megatron-partitioned checkpoints at
+a mesh shape other than the one that wrote them; checkpoints written by
+this framework itself are orbax and reshape on load (cross-topology
+restore), no offline pass needed.
+"""
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class meg_2d_parallel_map:
+    """(pp_index, tp_index) -> list of data items (reference
+    ``meg_2d_parallel_map``, ``reshape_meg_2d.py:9``)."""
+
+    def __init__(self, pp_degree: int, tp_degree: int):
+        self.pp_degree = int(pp_degree)
+        self.tp_degree = int(tp_degree)
+        self.map: Dict[Tuple[int, int], List[Any]] = {}
+
+    def simple_init(self):
+        """Identity layout: cell (p, t) holds the single global rank index
+        ``p * tp + t`` — the layout a fresh pp x tp job writes."""
+        for p in range(self.pp_degree):
+            for t in range(self.tp_degree):
+                self.map[(p, t)] = [p * self.tp_degree + t]
+        return self
+
+    def add_data(self, pp_index: int, tp_index: int, data: List[Any]):
+        self._validate(pp_index, tp_index)
+        self.map.setdefault((pp_index, tp_index), []).extend(list(data))
+
+    def get_data(self, pp_index: Optional[int] = None,
+                 tp_index: Optional[int] = None) -> List[Any]:
+        pps = range(self.pp_degree) if pp_index is None else [pp_index]
+        tps = range(self.tp_degree) if tp_index is None else [tp_index]
+        out: List[Any] = []
+        for p in pps:
+            for t in tps:
+                self._validate(p, t)
+                out.extend(self.map.get((p, t), []))
+        return out
+
+    def print_data(self, tag: str):
+        for (p, t), data in sorted(self.map.items()):
+            logger.info(f"{tag} [pp={p} tp={t}] -> {data}")
+
+    def _validate(self, pp_index: int, tp_index: int):
+        if not (0 <= pp_index < self.pp_degree and 0 <= tp_index < self.tp_degree):
+            raise ValueError(f"index (pp={pp_index}, tp={tp_index}) outside "
+                             f"{self.pp_degree} x {self.tp_degree} map")
+
+
+def _factor(old: int, new: int, axis: str) -> None:
+    if old % new != 0 and new % old != 0:
+        raise ValueError(f"{axis} degree may only change by an integer factor "
+                         f"(got {old} -> {new})")
+
+
+def _reshape_tp_dimension(old_map: meg_2d_parallel_map, new_tp: int) -> meg_2d_parallel_map:
+    """Regroup along tp only: merging (old_tp > new_tp) gives each new tp
+    cell the ``old_tp/new_tp`` consecutive old cells whose shards
+    concatenate into it; splitting (new_tp > old_tp) points the
+    ``new_tp/old_tp`` new cells at their one source cell (the tensor split
+    itself happens in the SD loader)."""
+    old_tp = old_map.tp_degree
+    _factor(old_tp, new_tp, "tp")
+    new_map = meg_2d_parallel_map(old_map.pp_degree, new_tp)
+    for p in range(old_map.pp_degree):
+        if new_tp <= old_tp:
+            ratio = old_tp // new_tp
+            for t_new in range(new_tp):
+                for t_old in range(t_new * ratio, (t_new + 1) * ratio):
+                    new_map.add_data(p, t_new, old_map.get_data(p, t_old))
+        else:
+            ratio = new_tp // old_tp
+            for t_new in range(new_tp):
+                new_map.add_data(p, t_new, old_map.get_data(p, t_new // ratio))
+    return new_map
+
+
+def _reshape_pp_dimension(old_map: meg_2d_parallel_map, new_pp: int) -> meg_2d_parallel_map:
+    """Regroup along pp only (layer ownership moves between stages)."""
+    old_pp = old_map.pp_degree
+    _factor(old_pp, new_pp, "pp")
+    new_map = meg_2d_parallel_map(new_pp, old_map.tp_degree)
+    for t in range(old_map.tp_degree):
+        if new_pp <= old_pp:
+            ratio = old_pp // new_pp
+            for p_new in range(new_pp):
+                for p_old in range(p_new * ratio, (p_new + 1) * ratio):
+                    new_map.add_data(p_new, t, old_map.get_data(p_old, t))
+        else:
+            ratio = new_pp // old_pp
+            for p_new in range(new_pp):
+                new_map.add_data(p_new, t, old_map.get_data(p_new // ratio, t))
+    return new_map
+
+
+def reshape_meg_2d_parallel(old_pp_degree: int, old_tp_degree: int,
+                            new_pp_degree: int, new_tp_degree: int,
+                            verbose: bool = False) -> meg_2d_parallel_map:
+    """Full 2-D regroup (reference ``reshape_meg_2d.py:80``): each NEW
+    (pp, tp) cell lists the OLD global rank indices whose shard files feed
+    it, tp reshaped first, then pp."""
+    old_map = meg_2d_parallel_map(old_pp_degree, old_tp_degree).simple_init()
+    if verbose:
+        old_map.print_data("before")
+    new_map = _reshape_tp_dimension(old_map, new_tp_degree)
+    new_map = _reshape_pp_dimension(new_map, new_pp_degree)
+    if verbose:
+        new_map.print_data("after")
+    return new_map
+
+
+def get_mpu_ranks(tp_size: int = 1, pp_size: int = 1, dp_size: int = 1):
+    """Enumerate the rank groups of a tp x pp x dp decomposition (reference
+    ``reshape_meg_2d.py:107``): returns (tp_groups, pp_groups, dp_groups)
+    as lists of global-rank lists, Megatron order (tp fastest, then dp,
+    then pp)."""
+    world = tp_size * pp_size * dp_size
+    tp_groups = [list(range(start, start + tp_size))
+                 for start in range(0, world, tp_size)]
+    dp_groups = []
+    for p in range(pp_size):
+        for t in range(tp_size):
+            dp_groups.append([p * tp_size * dp_size + d * tp_size + t
+                              for d in range(dp_size)])
+    pp_groups = []
+    for d in range(dp_size):
+        for t in range(tp_size):
+            pp_groups.append([p * tp_size * dp_size + d * tp_size + t
+                              for p in range(pp_size)])
+    return tp_groups, pp_groups, dp_groups

@@ -1,0 +1,6 @@
+"""Import-path parity with reference ``deepspeed/model_implementations``:
+the served-model wrappers (diffusers UNet/VAE; the transformer serving
+implementations live in ``deepspeed_tpu.inference``)."""
+from deepspeed_tpu.models.diffusion import DSUNet, DSVAE
+
+__all__ = ["DSUNet", "DSVAE"]

@@ -1,0 +1,4 @@
+from deepspeed_tpu.model_implementations.diffusers.unet import DSUNet
+from deepspeed_tpu.model_implementations.diffusers.vae import DSVAE
+
+__all__ = ["DSUNet", "DSVAE"]

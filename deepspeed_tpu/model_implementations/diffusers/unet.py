@@ -1,0 +1,5 @@
+"""Reference path shim: ``deepspeed.model_implementations.diffusers.unet``.
+The implementation lives with the model family (models/diffusion.py)."""
+from deepspeed_tpu.models.diffusion import DSUNet
+
+__all__ = ["DSUNet"]

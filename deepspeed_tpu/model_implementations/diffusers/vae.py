@@ -1,0 +1,4 @@
+"""Reference path shim: ``deepspeed.model_implementations.diffusers.vae``."""
+from deepspeed_tpu.models.diffusion import DSVAE
+
+__all__ = ["DSVAE"]

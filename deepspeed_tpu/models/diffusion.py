@@ -1,0 +1,331 @@
+"""Diffusion model family: SD-style conditional UNet + KL autoencoder
+(reference serving surface: ``model_implementations/diffusers/unet.py`` /
+``vae.py`` wrap HuggingFace diffusers modules; generic diffusers injection
+``module_inject/replace_module.py:187``).
+
+The reference WRAPS torch diffusers modules (cuda-graph capture + kernel
+injection); diffusers is not available here, so the family is implemented
+natively in flax, TPU-first:
+
+* NHWC layout end to end — convs tile the MXU in NHWC on TPU; the
+  ``ops/spatial`` nhwc bias/add fusions are the matching elementwise ops;
+* GroupNorm in fp32 accumulation, SiLU fused by XLA;
+* attention (self + cross) over flattened spatial tokens through the same
+  pluggable backend seam as the LM zoo (``ops/transformer/attention``);
+* every conv/dense kernel carries t5x-style logical axis names so the
+  ZeRO planner/TP rules place them like any other family.
+
+Serving wrappers :class:`DSUNet` / :class:`DSVAE` (reference
+``diffusers/unet.py:15`` / ``vae.py:13``) hold (module, params) and serve
+through a shape-keyed jit cache — the role the reference fills with CUDA
+graphs: first call traces/compiles, repeats replay.
+"""
+
+import dataclasses
+import math
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.common import dense_init as _init
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class UNetConfig:
+    """SD-1.x-shaped config, scaled by ``block_out_channels``."""
+    in_channels: int = 4
+    out_channels: int = 4
+    block_out_channels: Tuple[int, ...] = (32, 64)
+    layers_per_block: int = 1
+    attention_head_dim: int = 8
+    cross_attention_dim: int = 32
+    norm_num_groups: int = 8
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+
+@dataclasses.dataclass(frozen=True)
+class VAEConfig:
+    in_channels: int = 3
+    latent_channels: int = 4
+    block_out_channels: Tuple[int, ...] = (32, 64)
+    layers_per_block: int = 1
+    norm_num_groups: int = 8
+    scaling_factor: float = 0.18215  # SD latent scale
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+
+def timestep_embedding(t, dim: int, max_period: float = 10000.0):
+    """Sinusoidal timestep features (DDPM convention), fp32."""
+    t = jnp.asarray(t, jnp.float32).reshape(-1)
+    half = dim // 2
+    freqs = jnp.exp(-math.log(max_period) * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t[:, None] * freqs[None]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+class GroupNorm32(nn.Module):
+    """GroupNorm with fp32 statistics regardless of compute dtype."""
+    groups: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        orig = x.dtype
+        y = nn.GroupNorm(num_groups=self.groups, dtype=jnp.float32,
+                         param_dtype=jnp.float32)(x.astype(jnp.float32))
+        return y.astype(orig)
+
+
+def _conv(cfg, features, kernel=3, name=None, strides=1):
+    return nn.Conv(features, (kernel, kernel), strides=(strides, strides),
+                   padding="SAME", dtype=cfg.dtype,
+                   param_dtype=cfg.param_dtype,
+                   kernel_init=nn.with_logical_partitioning(
+                       nn.initializers.lecun_normal(), (None, None, "embed", "mlp")),
+                   bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("mlp",)),
+                   name=name)
+
+
+class ResnetBlock(nn.Module):
+    """GN → SiLU → conv ×2 with a timestep-embedding shift and a learned
+    skip when channels change (NHWC)."""
+    config: Any
+    out_ch: int
+
+    @nn.compact
+    def __call__(self, x, temb=None):
+        cfg = self.config
+        h = _conv(cfg, self.out_ch, name="conv1")(
+            nn.silu(GroupNorm32(cfg.norm_num_groups, cfg.dtype, name="norm1")(x)))
+        if temb is not None:
+            shift = nn.Dense(self.out_ch, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                             kernel_init=nn.with_logical_partitioning(
+                                 _init(), ("embed", "mlp")),
+                             name="time_emb_proj")(nn.silu(temb))
+            h = h + shift[:, None, None, :].astype(h.dtype)
+        h = _conv(cfg, self.out_ch, name="conv2")(
+            nn.silu(GroupNorm32(cfg.norm_num_groups, cfg.dtype, name="norm2")(h)))
+        if x.shape[-1] != self.out_ch:
+            x = nn.Conv(self.out_ch, (1, 1), dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                        kernel_init=nn.with_logical_partitioning(
+                            nn.initializers.lecun_normal(), (None, None, "embed", "mlp")),
+                        name="conv_shortcut")(x)
+        return x + h
+
+
+class SpatialTransformer(nn.Module):
+    """Self-attention + cross-attention + GEGLU FF over flattened HxW
+    tokens (the SD transformer block; width follows the input tensor),
+    NHWC in/out."""
+    config: UNetConfig
+
+    @nn.compact
+    def __call__(self, x, context=None):
+        cfg = self.config
+        b, hgt, wid, c = x.shape
+        heads = max(c // cfg.attention_head_dim, 1)
+        resid = x
+        h = GroupNorm32(cfg.norm_num_groups, cfg.dtype, name="norm")(x).reshape(b, hgt * wid, c)
+
+        def attn(q_src, kv_src, name):
+            from deepspeed_tpu.ops.transformer.attention import dot_product_attention
+            head_dim = c // heads
+            dg = dict(dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+            q = nn.DenseGeneral((heads, head_dim), axis=-1,
+                                kernel_init=nn.with_logical_partitioning(
+                                    _init(), ("embed", "heads", "kv")),
+                                use_bias=False, name=f"{name}_q", **dg)(q_src)
+            k = nn.DenseGeneral((heads, head_dim), axis=-1,
+                                kernel_init=nn.with_logical_partitioning(
+                                    _init(), ("embed", "heads", "kv")),
+                                use_bias=False, name=f"{name}_k", **dg)(kv_src)
+            v = nn.DenseGeneral((heads, head_dim), axis=-1,
+                                kernel_init=nn.with_logical_partitioning(
+                                    _init(), ("embed", "heads", "kv")),
+                                use_bias=False, name=f"{name}_v", **dg)(kv_src)
+            o = dot_product_attention(q, k, v, backend="xla", causal=False)
+            return nn.DenseGeneral(c, axis=(-2, -1),
+                                   kernel_init=nn.with_logical_partitioning(
+                                       _init(), ("heads", "kv", "embed")),
+                                   name=f"{name}_out", **dg)(o)
+
+        h1 = nn.LayerNorm(dtype=cfg.dtype, name="ln1")(h)
+        h = h + attn(h1, h1, "self_attn")
+        ctx = h if context is None else context.astype(h.dtype)
+        h = h + attn(nn.LayerNorm(dtype=cfg.dtype, name="ln2")(h), ctx, "cross_attn")
+        # GEGLU feed-forward
+        ff_in = nn.LayerNorm(dtype=cfg.dtype, name="ln3")(h)
+        gate = nn.Dense(c * 8, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                        kernel_init=nn.with_logical_partitioning(_init(), ("embed", "mlp")),
+                        name="ff_in")(ff_in)
+        a, g = jnp.split(gate, 2, axis=-1)
+        h = h + nn.Dense(c, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         kernel_init=nn.with_logical_partitioning(_init(), ("mlp", "embed")),
+                         name="ff_out")(a * nn.gelu(g))
+        return resid + h.reshape(b, hgt, wid, c)
+
+
+class UNet2DConditionModel(nn.Module):
+    """Conditional denoising UNet (reference serving target
+    ``diffusers/unet.py``; forward contract (sample, timestep,
+    encoder_hidden_states) -> eps prediction, NHWC)."""
+    config: UNetConfig
+
+    @nn.compact
+    def __call__(self, sample, timesteps, encoder_hidden_states=None):
+        cfg = self.config
+        ch0 = cfg.block_out_channels[0]
+        temb = timestep_embedding(timesteps, ch0)
+        temb = nn.Dense(ch0 * 4, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                        kernel_init=nn.with_logical_partitioning(_init(), ("embed", "mlp")),
+                        name="time_dense1")(temb.astype(cfg.dtype))
+        temb = nn.Dense(ch0 * 4, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                        kernel_init=nn.with_logical_partitioning(_init(), ("mlp", "embed")),
+                        name="time_dense2")(nn.silu(temb))
+
+        h = _conv(cfg, ch0, name="conv_in")(sample.astype(cfg.dtype))
+        skips = [h]
+        # down path: resnets (+ attention except at the last level) then stride-2 conv
+        for i, ch in enumerate(cfg.block_out_channels):
+            for j in range(cfg.layers_per_block):
+                h = ResnetBlock(cfg, ch, name=f"down_{i}_res_{j}")(h, temb)
+                if i < len(cfg.block_out_channels) - 1:
+                    h = SpatialTransformer(cfg, name=f"down_{i}_attn_{j}")(
+                        h, encoder_hidden_states)
+                skips.append(h)
+            if i < len(cfg.block_out_channels) - 1:
+                h = _conv(cfg, ch, name=f"down_{i}_downsample", strides=2)(h)
+                skips.append(h)
+        mid_ch = cfg.block_out_channels[-1]
+        h = ResnetBlock(cfg, mid_ch, name="mid_res_1")(h, temb)
+        h = SpatialTransformer(cfg, name="mid_attn")(h, encoder_hidden_states)
+        h = ResnetBlock(cfg, mid_ch, name="mid_res_2")(h, temb)
+        # up path: consume skips in reverse, nearest-neighbor upsample
+        for i, ch in reversed(list(enumerate(cfg.block_out_channels))):
+            for j in range(cfg.layers_per_block + 1):
+                h = jnp.concatenate([h, skips.pop()], axis=-1)
+                h = ResnetBlock(cfg, ch, name=f"up_{i}_res_{j}")(h, temb)
+                if i < len(cfg.block_out_channels) - 1:
+                    h = SpatialTransformer(cfg, name=f"up_{i}_attn_{j}")(
+                        h, encoder_hidden_states)
+            if i > 0:
+                b, hh, ww, c = h.shape
+                h = jax.image.resize(h, (b, hh * 2, ww * 2, c), "nearest")
+                h = _conv(cfg, c, name=f"up_{i}_upsample")(h)
+        h = nn.silu(GroupNorm32(cfg.norm_num_groups, cfg.dtype, name="norm_out")(h))
+        return _conv(cfg, cfg.out_channels, name="conv_out")(h)
+
+
+class _VAEBlockStack(nn.Module):
+    config: VAEConfig
+    channels: Tuple[int, ...]
+    downsample: bool
+
+    @nn.compact
+    def __call__(self, h):
+        cfg = self.config
+        n = len(self.channels)
+        for i, ch in enumerate(self.channels):
+            for j in range(cfg.layers_per_block):
+                h = ResnetBlock(cfg, ch, name=f"res_{i}_{j}")(h)
+            resize = i < n - 1
+            if self.downsample and resize:
+                h = _conv(cfg, ch, name=f"down_{i}", strides=2)(h)
+            elif not self.downsample and resize:
+                b, hh, ww, c = h.shape
+                h = jax.image.resize(h, (b, hh * 2, ww * 2, c), "nearest")
+                h = _conv(cfg, c, name=f"up_{i}")(h)
+        return h
+
+
+class AutoencoderKL(nn.Module):
+    """KL autoencoder (reference serving target ``diffusers/vae.py``):
+    ``encode`` -> latent moments (mean, logvar), ``decode`` -> image,
+    ``__call__`` = roundtrip reconstruction. NHWC."""
+    config: VAEConfig
+
+    def setup(self):
+        cfg = self.config
+        self.encoder = _VAEBlockStack(cfg, cfg.block_out_channels, True, name="encoder")
+        self.decoder = _VAEBlockStack(cfg, tuple(reversed(cfg.block_out_channels)), False,
+                                      name="decoder")
+        self.conv_in = _conv(cfg, cfg.block_out_channels[0], name="conv_in")
+        self.quant_conv = _conv(cfg, 2 * cfg.latent_channels, kernel=1, name="quant_conv")
+        self.post_quant_conv = _conv(cfg, cfg.block_out_channels[-1], kernel=1,
+                                     name="post_quant_conv")
+        self.conv_out = _conv(cfg, cfg.in_channels, name="conv_out")
+        self.norm_out = GroupNorm32(cfg.norm_num_groups, cfg.dtype, name="norm_out")
+
+    def encode(self, x):
+        h = self.encoder(self.conv_in(x.astype(self.config.dtype)))
+        moments = self.quant_conv(h)
+        mean, logvar = jnp.split(moments, 2, axis=-1)
+        return mean, jnp.clip(logvar, -30.0, 20.0)
+
+    def decode(self, z):
+        h = self.decoder(self.post_quant_conv(z.astype(self.config.dtype)))
+        return self.conv_out(nn.silu(self.norm_out(h)))
+
+    def __call__(self, x, rng=None):
+        mean, logvar = self.encode(x)
+        z = mean if rng is None else mean + jnp.exp(0.5 * logvar) * \
+            jax.random.normal(rng, mean.shape, mean.dtype)
+        return self.decode(z)
+
+
+class _JitServed:
+    """Shape-keyed jit cache around (module, params) — the reference wraps
+    these modules in CUDA graphs (``diffusers/unet.py:27`` enable_cuda_graph);
+    on TPU the compiled XLA executable IS the captured graph: first call
+    per shape traces, repeats replay."""
+
+    def __init__(self, module, params, dtype=None):
+        import flax.linen as fnn
+        self.module = module
+        self.params = fnn.meta.unbox(params)
+        if dtype is not None:
+            self.params = jax.tree.map(
+                lambda p: p.astype(dtype) if jnp.issubdtype(
+                    jnp.asarray(p).dtype, jnp.floating) else p, self.params)
+        self._fns = {}
+
+    def _jitted(self, method: Optional[str], shapes):
+        key = (method, shapes)
+        if key not in self._fns:
+            def fn(params, *args):
+                if method is None:
+                    return self.module.apply({"params": params}, *args)
+                return self.module.apply({"params": params}, *args, method=method)
+            self._fns[key] = jax.jit(fn, static_argnums=())
+        return self._fns[key]
+
+    def _shapes(self, args):
+        return tuple((tuple(jnp.shape(a)), jnp.asarray(a).dtype.name) for a in args)
+
+
+class DSUNet(_JitServed):
+    """Reference ``model_implementations/diffusers/unet.py`` ``DSUNet``."""
+
+    def __call__(self, sample, timesteps, encoder_hidden_states=None):
+        args = (sample, timesteps) + (() if encoder_hidden_states is None
+                                      else (encoder_hidden_states,))
+        return self._jitted(None, self._shapes(args))(self.params, *args)
+
+
+class DSVAE(_JitServed):
+    """Reference ``model_implementations/diffusers/vae.py`` ``DSVAE``."""
+
+    def encode(self, x):
+        return self._jitted("encode", self._shapes((x,)))(self.params, x)
+
+    def decode(self, z):
+        return self._jitted("decode", self._shapes((z,)))(self.params, z)
+
+    def __call__(self, x):
+        return self._jitted(None, self._shapes((x,)))(self.params, x)

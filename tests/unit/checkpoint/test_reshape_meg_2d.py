@@ -1,0 +1,133 @@
+"""Offline 2-D checkpoint regrouping (reference
+``checkpoint/reshape_meg_2d.py:80``, ``deepspeed_checkpoint.py:33``):
+index-map math + the ds_reshape_ckpt CLI end-to-end on synthetic
+Megatron-style shards."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.checkpoint.reshape_meg_2d import (get_mpu_ranks,
+                                                     meg_2d_parallel_map,
+                                                     reshape_meg_2d_parallel)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+
+def test_reshape_map_tp_merge():
+    # pp=2 x tp=4 -> pp=2 x tp=2: each new tp cell holds 2 consecutive old ranks
+    m = reshape_meg_2d_parallel(2, 4, 2, 2)
+    assert m.get_data(0, 0) == [0, 1]
+    assert m.get_data(0, 1) == [2, 3]
+    assert m.get_data(1, 0) == [4, 5]
+    assert m.get_data(1, 1) == [6, 7]
+
+
+def test_reshape_map_pp_merge_and_tp_split():
+    # pp=2 x tp=2 -> pp=1 x tp=4: pp merges (stage files grouped), tp splits
+    # (both new tp cells of a pair point at the same source rank)
+    m = reshape_meg_2d_parallel(2, 2, 1, 4)
+    assert m.get_data(0, 0) == [0, 2]  # tp split of old rank 0 + pp-merged rank 2
+    assert m.get_data(0, 1) == [0, 2]
+    assert m.get_data(0, 2) == [1, 3]
+    assert m.get_data(0, 3) == [1, 3]
+
+
+def test_reshape_map_rejects_non_factor():
+    with pytest.raises(ValueError, match="integer factor"):
+        reshape_meg_2d_parallel(1, 4, 1, 3)
+
+
+def test_map_bounds_checked():
+    m = meg_2d_parallel_map(2, 2).simple_init()
+    with pytest.raises(ValueError):
+        m.get_data(2, 0)
+
+
+def test_get_mpu_ranks_groups():
+    tp, pp, dp = get_mpu_ranks(tp_size=2, pp_size=2, dp_size=2)
+    world = {r for g in tp for r in g}
+    assert world == set(range(8))
+    assert all(len(g) == 2 for g in tp + pp + dp)
+    # tp groups are consecutive ranks; each rank appears once per group kind
+    assert [0, 1] in tp
+    for groups in (tp, pp, dp):
+        seen = [r for g in groups for r in g]
+        assert sorted(seen) == list(range(8))
+
+
+def _write_shards(tmp_path, tp, rows=8, cols=4):
+    """Synthetic Megatron-style shards: one column-parallel weight (cat on
+    axis 0) + one shared (replicated) bias."""
+    full = np.arange(rows * cols, dtype=np.float32).reshape(rows, cols)
+    files = []
+    for t in range(tp):
+        shard = full[t * (rows // tp):(t + 1) * (rows // tp)]
+        path = tmp_path / f"rank{t}.npz"
+        np.savez(path, **{"model.embed.word_embeddings.weight": shard,
+                          "model.final_norm.bias": np.ones(cols, np.float32)})
+        files.append(str(path))
+    return files, full
+
+
+def _run_cli(args):
+    script = os.path.join(REPO, "bin", "ds_reshape_ckpt")
+    return subprocess.run([sys.executable, script, *args],
+                          capture_output=True, text=True, timeout=300)
+
+
+def test_cli_tp_merge_end_to_end(tmp_path):
+    files, full = _write_shards(tmp_path, tp=4)
+    out = tmp_path / "out"
+    r = _run_cli(["--inputs", *files, "--old-tp", "4",
+                  "--new-tp", "2", "--output", str(out)])
+    assert r.returncode == 0, r.stderr[-800:]
+    manifest = json.loads((out / "reshape_manifest.json").read_text())
+    assert manifest["new"] == {"tp": 2, "pp": 1}
+    with np.load(out / manifest["files"]["pp0_tp0"]) as z:
+        got0 = z["model.embed.word_embeddings.weight"]
+    with np.load(out / manifest["files"]["pp0_tp1"]) as z:
+        got1 = z["model.embed.word_embeddings.weight"]
+    np.testing.assert_array_equal(np.concatenate([got0, got1], axis=0), full)
+    # each new shard is the merge of its two old shards
+    np.testing.assert_array_equal(got0, full[:4])
+
+
+def test_cli_rejects_two_dim_change(tmp_path):
+    files, _ = _write_shards(tmp_path, tp=2)
+    r = _run_cli(["--inputs", *files, "--old-tp", "2", "--old-pp", "1",
+                  "--new-tp", "1", "--new-pp", "2", "--output", str(tmp_path / "o")])
+    assert r.returncode != 0 and "ONE dimension" in r.stderr
+
+
+def test_cli_pp_merge_unions_stage_keys(tmp_path):
+    """pp=2 x tp=1 -> pp=1 x tp=1: stage files hold DISJOINT layer sets;
+    the merged rank must hold their union with tensors intact (the broken
+    version TP-concatenated different stages' tensors)."""
+    s0 = tmp_path / "pp0.npz"
+    s1 = tmp_path / "pp1.npz"
+    w0 = np.arange(8, dtype=np.float32).reshape(2, 4)
+    w1 = np.arange(8, 16, dtype=np.float32).reshape(2, 4)
+    np.savez(s0, **{"model.layers.0.weight": w0})
+    np.savez(s1, **{"model.layers.1.weight": w1})
+    out = tmp_path / "out"
+    r = _run_cli(["--inputs", str(s0), str(s1), "--old-tp", "1", "--old-pp", "2",
+                  "--new-tp", "1", "--new-pp", "1", "--output", str(out)])
+    assert r.returncode == 0, r.stderr[-800:]
+    manifest = json.loads((out / "reshape_manifest.json").read_text())
+    with np.load(out / manifest["files"]["pp0_tp0"]) as z:
+        assert set(z.files) == {"model.layers.0.weight", "model.layers.1.weight"}
+        np.testing.assert_array_equal(z["model.layers.0.weight"], w0)
+        np.testing.assert_array_equal(z["model.layers.1.weight"], w1)
+
+
+def test_cli_rejects_pp_split(tmp_path):
+    s0 = tmp_path / "pp0.npz"
+    np.savez(s0, **{"model.layers.0.weight": np.zeros((2, 2), np.float32)})
+    r = _run_cli(["--inputs", str(s0), "--old-tp", "1", "--old-pp", "1",
+                  "--new-tp", "1", "--new-pp", "2", "--output", str(tmp_path / "o")])
+    assert r.returncode != 0 and "pp SPLIT" in r.stderr
