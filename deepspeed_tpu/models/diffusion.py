@@ -70,9 +70,9 @@ def timestep_embedding(t, dim: int, max_period: float = 10000.0):
 
 
 class GroupNorm32(nn.Module):
-    """GroupNorm with fp32 statistics regardless of compute dtype."""
+    """GroupNorm with fp32 statistics regardless of compute dtype (output
+    follows the input dtype)."""
     groups: int
-    dtype: Any
 
     @nn.compact
     def __call__(self, x):
@@ -102,7 +102,7 @@ class ResnetBlock(nn.Module):
     def __call__(self, x, temb=None):
         cfg = self.config
         h = _conv(cfg, self.out_ch, name="conv1")(
-            nn.silu(GroupNorm32(cfg.norm_num_groups, cfg.dtype, name="norm1")(x)))
+            nn.silu(GroupNorm32(cfg.norm_num_groups, name="norm1")(x)))
         if temb is not None:
             shift = nn.Dense(self.out_ch, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                              kernel_init=nn.with_logical_partitioning(
@@ -110,7 +110,7 @@ class ResnetBlock(nn.Module):
                              name="time_emb_proj")(nn.silu(temb))
             h = h + shift[:, None, None, :].astype(h.dtype)
         h = _conv(cfg, self.out_ch, name="conv2")(
-            nn.silu(GroupNorm32(cfg.norm_num_groups, cfg.dtype, name="norm2")(h)))
+            nn.silu(GroupNorm32(cfg.norm_num_groups, name="norm2")(h)))
         if x.shape[-1] != self.out_ch:
             x = nn.Conv(self.out_ch, (1, 1), dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                         kernel_init=nn.with_logical_partitioning(
@@ -131,7 +131,7 @@ class SpatialTransformer(nn.Module):
         b, hgt, wid, c = x.shape
         heads = max(c // cfg.attention_head_dim, 1)
         resid = x
-        h = GroupNorm32(cfg.norm_num_groups, cfg.dtype, name="norm")(x).reshape(b, hgt * wid, c)
+        h = GroupNorm32(cfg.norm_num_groups, name="norm")(x).reshape(b, hgt * wid, c)
 
         def attn(q_src, kv_src, name):
             from deepspeed_tpu.ops.transformer.attention import dot_product_attention
@@ -218,7 +218,7 @@ class UNet2DConditionModel(nn.Module):
                 b, hh, ww, c = h.shape
                 h = jax.image.resize(h, (b, hh * 2, ww * 2, c), "nearest")
                 h = _conv(cfg, c, name=f"up_{i}_upsample")(h)
-        h = nn.silu(GroupNorm32(cfg.norm_num_groups, cfg.dtype, name="norm_out")(h))
+        h = nn.silu(GroupNorm32(cfg.norm_num_groups, name="norm_out")(h))
         return _conv(cfg, cfg.out_channels, name="conv_out")(h)
 
 
@@ -260,7 +260,7 @@ class AutoencoderKL(nn.Module):
         self.post_quant_conv = _conv(cfg, cfg.block_out_channels[-1], kernel=1,
                                      name="post_quant_conv")
         self.conv_out = _conv(cfg, cfg.in_channels, name="conv_out")
-        self.norm_out = GroupNorm32(cfg.norm_num_groups, cfg.dtype, name="norm_out")
+        self.norm_out = GroupNorm32(cfg.norm_num_groups, name="norm_out")
 
     def encode(self, x):
         h = self.encoder(self.conv_in(x.astype(self.config.dtype)))
@@ -306,7 +306,11 @@ class _JitServed:
         return self._fns[key]
 
     def _shapes(self, args):
-        return tuple((tuple(jnp.shape(a)), jnp.asarray(a).dtype.name) for a in args)
+        # no jnp.asarray here: it would device_put full inputs just to read
+        # a dtype on the per-step serving hot path
+        return tuple((tuple(jnp.shape(a)),
+                      a.dtype.name if hasattr(a, "dtype") else jnp.result_type(a).name)
+                     for a in args)
 
 
 class DSUNet(_JitServed):
