@@ -93,13 +93,21 @@ def run_child():
     model = GPT2LMHeadModel(cfg_model)
 
     zero_stage = int(os.environ.get("BENCH_ZERO", "1" if n_dev > 1 else "0"))
+    zero_cfg = {"stage": zero_stage}
+    # BENCH_OFFLOAD=1: the ZeRO-Infinity recipe (stage 3 + host-resting
+    # streamed params + host C++ Adam) — the quick on-chip A/B for the
+    # offload path's overhead vs the dense step
+    if os.environ.get("BENCH_OFFLOAD", "0") == "1":
+        zero_cfg = {"stage": 3,
+                    "offload_param": {"device": "cpu", "pin_memory": True},
+                    "offload_optimizer": {"device": "cpu", "pin_memory": True}}
     ds_config = {
         "train_batch_size": micro_bs * n_dev,
         "gradient_accumulation_steps": 1,
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.01}},
         "bf16": {"enabled": True},
         "gradient_clipping": 1.0,
-        "zero_optimization": {"stage": zero_stage},
+        "zero_optimization": zero_cfg,
         "steps_per_print": 10**9,
     }
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds_config)
