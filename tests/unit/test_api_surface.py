@@ -37,3 +37,25 @@ def test_zero_namespace():
     assert ds.zero.ZeroParamStatus.AVAILABLE.value == 1  # reference enum parity
     assert ds.zero.ZeroParamStatus.NOT_AVAILABLE.value == 2
     assert ds.zero.ZeroParamStatus.INFLIGHT.value == 3
+
+
+def test_round4_surfaces_resolve():
+    """Round-4 additions under their reference import paths."""
+    from deepspeed_tpu.checkpoint import (get_mpu_ranks, meg_2d_parallel_map,
+                                          reshape_meg_2d_parallel)
+    from deepspeed_tpu.compression.compress import (init_compression,
+                                                    student_initialization)
+    from deepspeed_tpu.compression.scheduler import compression_scheduler
+    from deepspeed_tpu.elasticity import DSElasticAgent, touch_heartbeat
+    from deepspeed_tpu.model_implementations import DSUNet, DSVAE
+    from deepspeed_tpu.model_implementations.diffusers.unet import DSUNet as U2
+    from deepspeed_tpu.model_implementations.diffusers.vae import DSVAE as V2
+    from deepspeed_tpu.runtime.zero.param_offload import (PartitionedParamSwapper,
+                                                          stream_in)
+    from deepspeed_tpu.runtime.swap_tensor.optimizer_swapper import NVMeAdam
+    assert U2 is DSUNet and V2 is DSVAE
+    for obj in (reshape_meg_2d_parallel, meg_2d_parallel_map, get_mpu_ranks,
+                init_compression, student_initialization, compression_scheduler,
+                DSElasticAgent, touch_heartbeat, PartitionedParamSwapper,
+                stream_in, NVMeAdam):
+        assert obj is not None
