@@ -28,6 +28,7 @@ from typing import Any, Optional, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from deepspeed_tpu.models.common import dense_init as _init
 
@@ -305,11 +306,24 @@ class _JitServed:
             self._fns[key] = jax.jit(fn, static_argnums=())
         return self._fns[key]
 
+    @staticmethod
+    def _norm(a):
+        # jit cannot consume foreign tensor types: torch CPU tensors (the
+        # diffusers-parity calling convention) normalize to numpy views
+        # host-side; jax/numpy arrays pass through untouched
+        if isinstance(a, jax.Array) or isinstance(a, np.ndarray):
+            return a
+        return np.asarray(a)
+
     def _shapes(self, args):
-        # no jnp.asarray here: it would device_put full inputs just to read
-        # a dtype on the per-step serving hot path
+        # no jnp.asarray here: it would device_put full inputs just to
+        # read a dtype on the per-step serving hot path. jax/numpy arrays
+        # answer via .dtype.name; anything else (torch CPU tensors through
+        # __array__, python scalars) normalizes host-side via np.asarray —
+        # a view/scalar op, never a device transfer
         return tuple((tuple(jnp.shape(a)),
-                      a.dtype.name if hasattr(a, "dtype") else jnp.result_type(a).name)
+                      getattr(getattr(a, "dtype", None), "name", None)
+                      or np.asarray(a).dtype.name)
                      for a in args)
 
 
@@ -319,6 +333,7 @@ class DSUNet(_JitServed):
     def __call__(self, sample, timesteps, encoder_hidden_states=None):
         args = (sample, timesteps) + (() if encoder_hidden_states is None
                                       else (encoder_hidden_states,))
+        args = tuple(self._norm(a) for a in args)
         return self._jitted(None, self._shapes(args))(self.params, *args)
 
 
@@ -326,10 +341,13 @@ class DSVAE(_JitServed):
     """Reference ``model_implementations/diffusers/vae.py`` ``DSVAE``."""
 
     def encode(self, x):
+        x = self._norm(x)
         return self._jitted("encode", self._shapes((x,)))(self.params, x)
 
     def decode(self, z):
+        z = self._norm(z)
         return self._jitted("decode", self._shapes((z,)))(self.params, z)
 
     def __call__(self, x):
+        x = self._norm(x)
         return self._jitted(None, self._shapes((x,)))(self.params, x)

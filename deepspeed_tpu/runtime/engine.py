@@ -296,6 +296,11 @@ class DeepSpeedEngine:
                 f"(reference 1-bit/0-1 cupy backend scope: replicated state on a "
                 f"pure-DP mesh); {fix}")
 
+        # single-device runs stay quiet on EVERY branch: there is no
+        # collective to compress, so nothing the config promised is lost
+        # (dev/test runs of a prod config must not crash)
+        if self.mesh.size == 1:
+            return False
         pure_dp = all(self.mesh.shape[a] == 1 for a in ("pipe", "tensor", "sequence", "expert"))
         if not pure_dp:
             mp_axes = {a: int(self.mesh.shape[a]) for a in
@@ -311,9 +316,7 @@ class DeepSpeedEngine:
         if self.config.zero_optimization_stage != 0:
             conflict(f"ZeRO stage {self.config.zero_optimization_stage}",
                      "compressed collectives need replicated state (stage 0)")
-        # dp_world == 1 stays quiet: there is no collective to compress, so
-        # nothing the config promised is being silently lost (dev/test runs)
-        return self.mesh.shape["data"] * self.mesh.shape["fsdp"] > 1
+        return True
 
     def _configure_optimizer(self) -> optax.GradientTransformation:
         """Reference ``_configure_basic_optimizer`` (``engine.py:1225``):

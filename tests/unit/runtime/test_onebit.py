@@ -168,8 +168,6 @@ def test_onebit_raises_on_model_parallel_mesh():
     """VERDICT r3 weak #8: a TP mesh must fail LOUDLY — silently training
     with dense collectives while the config promises 1-bit wire compression
     is the worst outcome."""
-    import pytest
-    from deepspeed_tpu.parallel.topology import MeshTopology
     cfg = get_gpt2_config("test", n_layer=1)
     with pytest.raises(ValueError, match="pure-DP mesh"):
         engine, _, _, _ = deepspeed_tpu.initialize(
@@ -184,8 +182,6 @@ def test_onebit_raises_on_model_parallel_mesh():
 def test_onebit_raises_on_conflicting_features():
     """stage>0 / offload / MoE conflicts also fail loudly — every branch
     of the eligibility check, not just the mesh one."""
-    import pytest
-    from deepspeed_tpu.parallel.topology import MeshTopology
     cfg = get_gpt2_config("test", n_layer=1)
     with pytest.raises(ValueError, match="ZeRO stage 1"):
         engine, _, _, _ = deepspeed_tpu.initialize(
