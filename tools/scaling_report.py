@@ -45,6 +45,11 @@ TP = int(os.environ.get("TP", "1"))
 # experts per chip (total experts = k * N). Flatness here means the a2a
 # dispatch + replicated-dense allreduce per chip don't grow with N.
 MOE = int(os.environ.get("MOE", "0"))
+# OFFLOAD=1 switches the fsdp sweep to the ZeRO-Infinity step (stage 3 +
+# offload_param cpu): params rest host-side and stream per layer — the
+# per-chip ICI payload must stay as flat as the dense stage-3 step's
+# (streaming changes WHERE params rest, not what chips exchange)
+OFFLOAD = int(os.environ.get("OFFLOAD", "0"))
 
 CHILD = r"""
 import os, sys, time
@@ -60,17 +65,20 @@ from unit.runtime.test_qcomm import collective_payload_bytes
 n = {n}
 tp = {tp}
 moe = {moe}
+offload = {offload}
 t0 = time.time()
 extra = dict(moe_num_experts=moe * n, moe_layer_freq=2, moe_k=1) if moe else {{}}
 cfg = get_gpt2_config({model!r}, n_positions={seq}, vocab_size={vocab}, **extra)
 topo = MeshTopology(expert=n) if moe else MeshTopology(fsdp=n // tp, tensor=tp)
+zero_cfg = {{"stage": 1 if moe else 3, "stage3_param_persistence_threshold": 0}}
+if offload:
+    zero_cfg["offload_param"] = {{"device": "cpu"}}
 engine, _, _, _ = deepspeed_tpu.initialize(
     model=GPT2LMHeadModel(cfg), topology=topo,
     config={{"train_batch_size": {mb} * (n if moe else n // tp),
             "optimizer": {{"type": "AdamW", "params": {{"lr": 1e-3}}}},
             "bf16": {{"enabled": True}},
-            "zero_optimization": {{"stage": 1 if moe else 3,
-                                  "stage3_param_persistence_threshold": 0}}}})
+            "zero_optimization": zero_cfg}})
 rng = np.random.default_rng(0)
 batch = {{"input_ids": rng.integers(0, cfg.vocab_size,
                                     ({mb} * (n if moe else n // tp), {seq})).astype(np.int32)}}
@@ -86,7 +94,7 @@ def run_mesh(n):
     env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n}"
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     code = CHILD.format(repo=repo, n=n, model=MODEL, seq=SEQ, vocab=VOCAB,
-                        mb=MB_PER_CHIP, tp=TP, moe=MOE)
+                        mb=MB_PER_CHIP, tp=TP, moe=MOE, offload=OFFLOAD)
     r = subprocess.run([sys.executable, "-c", code], env=env,
                        capture_output=True, text=True, timeout=1800)
     for line in r.stdout.splitlines():
@@ -101,11 +109,16 @@ def main():
         print(json.dumps({"error": "MOE mode scales the expert axis; combine "
                           "with TP via the config-ladder tests instead"}), flush=True)
         return 2
+    if MOE and OFFLOAD:
+        print(json.dumps({"error": "MOE mode runs stage 1 (replicated dense + "
+                          "expert a2a); offload_param is a stage-3 feature — "
+                          "measure them separately"}), flush=True)
+        return 2
     results = {}
     for n in MESHES:
         payload, secs = run_mesh(n)
         results[n] = payload
-        print(json.dumps({"mesh": n, "tp": TP, "moe": MOE,
+        print(json.dumps({"mesh": n, "tp": TP, "moe": MOE, "offload": OFFLOAD,
                           "per_chip_collective_bytes": payload,
                           "compile_s": secs}), flush=True)
     if len(MESHES) < 2:
