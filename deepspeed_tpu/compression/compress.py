@@ -229,8 +229,9 @@ def init_compression(model_or_engine, deepspeed_config=None, teacher_model=None,
                         params=jax.device_put(new, engine.state_shardings.params))
                     engine._pending_student_init = None
             if cfg[KNOWLEDGE_DISTILLATION]["enabled"]:
+                t_placed = _place_teacher(t_module, t_params, engine)
                 engine._kd_config = dict(cfg[KNOWLEDGE_DISTILLATION],
-                                         module=t_module, params=t_params)
+                                         module=t_module, params=t_placed)
                 log_dist(f"knowledge distillation active: kd_coef="
                          f"{engine._kd_config['kd_coef']} T={engine._kd_config['temperature']} "
                          f"layerwise={engine._kd_config['layerwise_coef']} "
@@ -245,6 +246,36 @@ def init_compression(model_or_engine, deepspeed_config=None, teacher_model=None,
         return engine
     raise TypeError("init_compression expects a DeepSpeedEngine; for raw flax params use "
                     "build_compression_transform(params, ds_config)")
+
+
+def _place_teacher(t_module, t_params, engine):
+    """Shard the teacher over the engine's mesh with the planner's own
+    rules (the teacher module carries the same logical-axis metadata as
+    every zoo model), so the KD forward's teacher weights rest 1/fsdp per
+    chip instead of riding the trace as replicated constants — the HBM
+    difference between a viable and an impossible big-teacher distillation.
+    Falls back to the host tree (closure constants) when the teacher's
+    structure defeats the plan (exotic custom modules)."""
+    from deepspeed_tpu.models.common import is_seq2seq_module
+    from deepspeed_tpu.runtime.zero.planner import build_plan
+    try:
+        ids = jnp.zeros((1, 8), jnp.int32)
+        kwargs = {"decoder_input_ids": ids} if is_seq2seq_module(t_module) else {}
+        aboxed = jax.eval_shape(
+            lambda: t_module.init(jax.random.PRNGKey(0), ids,
+                                  deterministic=True, **kwargs))
+        # the teacher carries no optimizer state, so fsdp-sharding it is
+        # safe at ANY student stage — force the stage-3 carve rather than
+        # inheriting a stage-0/1/2 plan that would leave it replicated
+        zc = engine.config.zero_config.model_copy(update={"stage": 3})
+        plan = build_plan(aboxed["params"], zc, engine.topology)
+        placed = jax.device_put(t_params, plan.param_shardings())
+        log_dist("KD teacher placed fsdp-sharded over the mesh (stage-3 carve)")
+        return placed
+    except Exception as e:  # noqa: BLE001 — placement is an optimization
+        logger.warning(f"KD teacher placement fell back to host constants "
+                       f"({type(e).__name__}: {str(e)[:120]})")
+        return t_params
 
 
 def _resolve_teacher(teacher_model, engine):

@@ -1161,10 +1161,11 @@ class DeepSpeedEngine:
         teacher's i-th block): loss = (1-a)·CE + a·KL + gate·lw·MSE with
         a = kd_coef·gate.
 
-        Teacher placement: the teacher tree rides the trace as closed-over
-        device constants — one replicated copy per device. Fine for the
-        compress-a-model use case; a teacher near HBM capacity would need
-        sharded threading through the step signature (not implemented)."""
+        Teacher placement: init_compression shards the teacher over the
+        engine's mesh with the planner's rules (compress._place_teacher),
+        so its weights rest 1/fsdp per chip and ride the trace as sharded
+        constants; exotic teacher structures fall back to host constants
+        (replicated)."""
         kd = self._kd_config
         t_module, t_params = kd["module"], kd["params"]
         step = mb.get("_kd_step") if isinstance(mb, dict) else None
