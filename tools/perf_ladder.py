@@ -64,6 +64,10 @@ RUNGS = {
     # [B,L,V] logits + cotangent buffers (~2x0.77G bf16 + f32 temps)
     "760m_mb8_fx": dict(model_name="760m", mb=8, fused_xent=True),
     "760m_mb4_fx": dict(model_name="760m", mb=4, fused_xent=True),
+    # offload A/B at the bench operating point: quantifies the ZeRO-Infinity
+    # streaming overhead against the dense 70-TFLOPS configuration
+    "350m_offload_mb8": dict(model_name="350m", mb=8, offload=True, steps=3,
+                             fused_xent=True),
     "xl_offload_mb1": dict(model_name="xl", mb=1, offload=True, steps=2),
     "xl_offload_mb4": dict(model_name="xl", mb=4, offload=True, steps=2),
     # long-context rungs: the gridded flash kernel streams K/V blocks, so
