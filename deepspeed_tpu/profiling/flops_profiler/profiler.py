@@ -138,6 +138,12 @@ def profile_engine_step(engine, device_batch, rng, step_latency_s=None,
     # offload and 1-bit compression paths run different programs than the
     # fused dense step
     try:
+        # after an nvme-tier step state.params is None (journaled to the
+        # swapper) — rematerialize before ANY branch lowers with them, or
+        # .lower(None, ...) fails opaquely (both the _host_opt and
+        # offload_param branches read params; so does the eval lowering)
+        if hasattr(engine, "_ensure_params_resident"):
+            engine._ensure_params_resident()
         if getattr(engine, "_host_opt", None) is not None:
             train_compiled = engine._grads_only_fn.lower(
                 engine.state.params, device_batch, rng).compile()

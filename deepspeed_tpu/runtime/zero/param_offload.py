@@ -47,6 +47,7 @@ reference pays the same class of cost via per-submodule hooks.
 
 import contextlib
 import functools
+import re
 import threading
 from pathlib import Path
 from typing import Dict, List, Optional
@@ -133,17 +134,22 @@ def _stream_leaf(x):
 def stream_tree(tree, skip_prefixes=()):
     """Stream every floating leaf of ``tree`` to device memory (and cast to
     the context's compute dtype), leaving subtrees whose dict key — at any
-    nesting level — starts with one of ``skip_prefixes`` untouched (those
-    blocks self-stream inside their remat region via
-    :func:`stream_block_params`)."""
+    nesting level — is a numbered block name (``<prefix><digits>``, e.g.
+    ``h_3`` for prefix ``h_``) untouched: those blocks self-stream inside
+    their remat region via :func:`stream_block_params`. The match is
+    prefix+digits fullmatch (same rule as ``engine._kd_block_filter``) so
+    a non-block key merely sharing the prefix (``layer_norm`` vs
+    ``layer_``) is still streamed here rather than silently left
+    host-resident."""
     if not streaming_active():
         return tree
     if not isinstance(tree, dict) or not skip_prefixes:
         return jax.tree.map(_stream_leaf, tree)
+    pats = [re.compile(re.escape(str(p)) + r"\d+") for p in skip_prefixes]
 
     def rec(node):
         if isinstance(node, dict):
-            return {k: (v if any(str(k).startswith(p) for p in skip_prefixes) else rec(v))
+            return {k: (v if any(p.fullmatch(str(k)) for p in pats) else rec(v))
                     for k, v in node.items()}
         return jax.tree.map(_stream_leaf, node)
 

@@ -1,0 +1,59 @@
+"""Pin the bench FLOP accounting (tools/bench_core.model_flops_per_token).
+
+The reference's published TFLOPS numbers use the standard parameter-matmul
+estimate; the bench adds the attention-score term that estimate omits
+(PaLM-appendix accounting) so long-context rungs report true model FLOPs
+(r4 verdict: the bare 6N model understated seq-8k MFU by ~36%).
+"""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", "..", "tools"))
+
+from bench_core import flops_per_token_from_cfg, model_flops_per_token
+
+
+def test_no_attention_term_degenerates_to_6n():
+    assert model_flops_per_token(1_000_000) == 6e6
+    assert model_flops_per_token(1_000_000, 0, 0, 0) == 6e6
+
+
+def test_causal_attention_term_exact():
+    # per layer fwd: QK^T + AV = 4*s*h FLOPs/token; x3 fwd+bwd; /2 causal
+    n, L, h, s = 354_800_000, 24, 1024, 8192
+    expected_attn = 12.0 * L * h * s / 2.0
+    assert model_flops_per_token(n, L, h, s, causal=True) == 6.0 * n + expected_attn
+    # at 350M/seq-8k the attention term is ~36% of the total — the
+    # magnitude the 6N model was missing
+    frac = expected_attn / model_flops_per_token(n, L, h, s, causal=True)
+    assert 0.30 < frac < 0.42
+
+
+def test_bidirectional_is_twice_causal_attention():
+    n, L, h, s = 100, 2, 64, 128
+    c = model_flops_per_token(n, L, h, s, causal=True) - 6.0 * n
+    b = model_flops_per_token(n, L, h, s, causal=False) - 6.0 * n
+    assert b == 2 * c
+
+
+def test_cfg_dispatch_gpt2_and_bert():
+    from deepspeed_tpu.models import get_bert_config, get_gpt2_config
+
+    g = get_gpt2_config("test")
+    got = flops_per_token_from_cfg(1000, g, 128)
+    assert got == model_flops_per_token(1000, g.n_layer, g.n_embd, 128, causal=True)
+
+    b = get_bert_config("test")
+    got = flops_per_token_from_cfg(1000, b, 128)
+    assert got == model_flops_per_token(1000, b.num_hidden_layers, b.hidden_size,
+                                        128, causal=False)
+
+
+def test_unknown_cfg_falls_back_to_6n():
+    class Odd:
+        pass
+
+    assert flops_per_token_from_cfg(500, Odd(), 4096) == 3000.0

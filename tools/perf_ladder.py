@@ -46,7 +46,7 @@ def run_rung(tag, model_name, mb, offload=False, steps=None, seq=None,
         overrides = {"vocab_size": 50304, "embed_onehot_grad": True}
         if fused_xent:
             overrides["fused_head_loss_chunk"] = 1024
-    engine, batch, n_params = build_engine(
+    engine, batch, n_params, cfg = build_engine(
         model_name, mb, seq or SEQ, ds_overrides=ds_overrides, **overrides)
     if offload:
         # host-driven schedule: per-step dispatch is the real path here
@@ -54,7 +54,7 @@ def run_rung(tag, model_name, mb, offload=False, steps=None, seq=None,
     else:
         fused = int(os.environ.get("LADDER_FUSED", "10"))
         n_steps, dt, compile_s = time_fused(engine, batch, fused=fused)
-    report(tag, mb, seq or SEQ, n_params, n_steps, dt, compile_s)
+    report(tag, mb, seq or SEQ, n_params, n_steps, dt, compile_s, cfg=cfg)
 
 
 RUNGS = {

@@ -29,12 +29,14 @@ SEQ = int(os.environ.get("BENCH_SEQ", "1024"))
 FUSED = int(os.environ.get("BENCH_FUSED_STEPS", "10"))
 
 
-def report(tag, steps, dt, n_params):
+def report(tag, steps, dt, n_params, cfg=None):
+    from bench_core import flops_per_token_from_cfg, model_flops_per_token
     tok = MB * SEQ * steps / dt
-    tflops = 6.0 * n_params * tok / 1e12
+    fpt = (flops_per_token_from_cfg(n_params, cfg, SEQ) if cfg is not None
+           else model_flops_per_token(n_params))
     print(json.dumps({"tag": tag, "step_ms": round(dt / steps * 1e3, 1),
                       "tokens_per_s": round(tok, 1),
-                      "tflops": round(tflops, 2)}), flush=True)
+                      "tflops": round(fpt * tok / 1e12, 2)}), flush=True)
 
 
 def main():
@@ -64,7 +66,7 @@ def main():
     for _ in range(10):
         engine.train_batch(batch)
     jax.block_until_ready(engine.state.params)
-    report("per_dispatch", 10, time.time() - t0, n_params)
+    report("per_dispatch", 10, time.time() - t0, n_params, cfg)
 
     # 2) fused scan: FUSED steps per dispatch
     stack = {"input_ids": np.broadcast_to(batch["input_ids"],
@@ -74,14 +76,14 @@ def main():
     t0 = time.time()
     engine.train_batches(stack)
     jax.block_until_ready(engine.state.params)
-    report(f"fused_{FUSED}", FUSED, time.time() - t0, n_params)
+    report(f"fused_{FUSED}", FUSED, time.time() - t0, n_params, cfg)
 
     # run the fused dispatch twice more for variance
     t0 = time.time()
     engine.train_batches(stack)
     engine.train_batches(stack)
     jax.block_until_ready(engine.state.params)
-    report(f"fused_{FUSED}_x2", 2 * FUSED, time.time() - t0, n_params)
+    report(f"fused_{FUSED}_x2", 2 * FUSED, time.time() - t0, n_params, cfg)
 
     print("# DONE", flush=True)
 

@@ -29,9 +29,9 @@ def run_config(tag, mb, vocab=None, onehot=False, xent_chunk=0):
         overrides["embed_onehot_grad"] = True
     if xent_chunk:
         overrides["fused_head_loss_chunk"] = xent_chunk
-    engine, batch, n_params = build_engine(MODEL, mb, SEQ, **overrides)
+    engine, batch, n_params, cfg = build_engine(MODEL, mb, SEQ, **overrides)
     n_steps, dt, compile_s = time_fused(engine, batch, fused=FUSED)
-    report(tag, mb, SEQ, n_params, n_steps, dt, compile_s)
+    report(tag, mb, SEQ, n_params, n_steps, dt, compile_s, cfg=cfg)
 
 
 def main():
