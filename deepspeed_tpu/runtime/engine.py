@@ -480,9 +480,12 @@ class DeepSpeedEngine:
         opt_shardings = self.state_shardings.opt_state
 
         if self._initial_params is not None:
-            # device_put handles host memory kinds directly (offload_param:
-            # param_shardings rest in pinned_host)
-            params = jax.device_put(nn.meta.unbox(self._initial_params), param_shardings)
+            # migrate places host memory kinds (offload_param: param_shardings
+            # rest in pinned_host) — shard-wise on multi-process meshes, where
+            # a plain device_put reshards through a jitted identity the
+            # XLA:CPU partitioner rejects (param_offload.migrate)
+            from deepspeed_tpu.runtime.zero.param_offload import migrate
+            params = migrate(nn.meta.unbox(self._initial_params), param_shardings)
         elif self._param_offload_enabled:
             # jit out_shardings cannot carry host memory kinds through the
             # SPMD partitioner (see param_offload.py): init shard-by-shard
@@ -513,7 +516,8 @@ class DeepSpeedEngine:
             opt_state = jax.jit(self.optimizer.init, out_shardings=opt_shardings)(params)
 
         if self._param_offload_enabled and self._initial_params is None:
-            params_dev, params = params, jax.device_put(params, param_shardings)
+            from deepspeed_tpu.runtime.zero.param_offload import migrate
+            params_dev, params = params, migrate(params, param_shardings)
             jax.block_until_ready(params)
             del params_dev
 
@@ -902,18 +906,24 @@ class DeepSpeedEngine:
         poff = self.config.zero_config.offload_param
         device = poff.device if isinstance(poff.device, str) else str(poff.device)
         if device == "nvme":
-            if jax.process_count() > 1:
-                raise NotImplementedError("offload_param device=nvme is single-host "
-                                          "(per-process swap files need a shared layout "
-                                          "contract); use device=cpu on multi-host meshes")
-            from deepspeed_tpu.runtime.zero.param_offload import PartitionedParamSwapper
+            from deepspeed_tpu.runtime.zero.param_offload import (
+                PartitionedParamSwapper, local_shard_arrays)
             nvme_path = getattr(poff, "nvme_path", None) or "/tmp/ds_tpu_nvme"
+            # per-host swap dir + host-local shard ownership: each process
+            # journals only the unique addressable shards of every leaf —
+            # the reference's per-rank swapper model
+            # (partitioned_param_swapper.py:403). The proc suffix keeps
+            # per-host files distinct even when nvme_path is a shared mount.
+            swap_dir = (os.path.join(str(nvme_path), f"params_proc{jax.process_index()}")
+                        if jax.process_count() > 1
+                        else os.path.join(str(nvme_path), "params"))
             self._param_swapper = PartitionedParamSwapper(
-                os.path.join(str(nvme_path), "params"),
+                swap_dir,
                 window_bytes=int(getattr(poff, "max_in_cpu", 1e9)),
                 n_threads=max(int(getattr(poff, "buffer_count", 5)), 1))
-            leaves = [np.asarray(jax.device_get(l)) for l in jax.tree.leaves(self.state.params)]
-            self._param_swapper.initialize(leaves)
+            param_leaves = jax.tree.leaves(self.state.params)
+            self._param_leaf_meta = [(tuple(l.shape), l.dtype) for l in param_leaves]
+            self._param_swapper.initialize(local_shard_arrays(param_leaves))
         n_bytes = sum(int(np.prod(jnp.shape(l))) * jnp.asarray(l).dtype.itemsize
                       for l in jax.tree.leaves(self.state.params))
         log_dist(f"parameter offload enabled: device={device} "
@@ -927,7 +937,8 @@ class DeepSpeedEngine:
         rest = (self.state.step, self.state.opt_state, self.state.loss_scale)
         new_params_dev, new_rest, metrics = self._train_step_fn(
             self.state.params, rest, device_batch, rng)
-        params_host = jax.device_put(new_params_dev, self.state_shardings.params)
+        from deepspeed_tpu.runtime.zero.param_offload import migrate
+        params_host = migrate(new_params_dev, self.state_shardings.params)
         self.state = TrainState(step=new_rest[0], params=params_host,
                                 opt_state=new_rest[1], loss_scale=new_rest[2])
         self._journal_params_to_nvme()
@@ -941,8 +952,10 @@ class DeepSpeedEngine:
         rematerializes via :meth:`_ensure_params_resident`."""
         if self._param_swapper is None:
             return
-        leaves = [np.asarray(jax.device_get(l)) for l in jax.tree.leaves(self.state.params)]
-        self._param_swapper.write_back(leaves)
+        from deepspeed_tpu.runtime.zero.param_offload import local_shard_arrays
+        leaves = jax.tree.leaves(self.state.params)
+        self._param_leaf_meta = [(tuple(l.shape), l.dtype) for l in leaves]
+        self._param_swapper.write_back(local_shard_arrays(leaves))
         self._params_treedef = jax.tree.structure(self.state.params)
         self._params_released = True
         self.state = self.state._replace(params=None)
@@ -952,10 +965,15 @@ class DeepSpeedEngine:
         step released them (pipelined disk reads, window leaves from RAM)."""
         if not getattr(self, "_params_released", False):
             return
-        leaves = self._param_swapper.fetch_all()
-        tree = jax.tree.unflatten(self._params_treedef, leaves)
+        from deepspeed_tpu.runtime.zero.param_offload import assemble_from_local_shards
+        datas = self._param_swapper.fetch_all()
+        leaves = assemble_from_local_shards(
+            self._param_leaf_meta,
+            jax.tree.leaves(self.state_shardings.params,
+                            is_leaf=lambda x: isinstance(x, jax.sharding.Sharding)),
+            datas)
         self.state = self.state._replace(
-            params=jax.device_put(tree, self.state_shardings.params))
+            params=jax.tree.unflatten(self._params_treedef, leaves))
         self._params_released = False
 
     def _example_ids(self, batch):

@@ -198,7 +198,53 @@ def host_shardings(shardings):
 def put_to_host(tree, shardings):
     """Move a (device) pytree to its pinned-host resting placement —
     the outside-the-graph half of the streaming loop."""
-    return jax.device_put(tree, host_shardings(shardings))
+    return migrate(tree, host_shardings(shardings))
+
+
+def migrate(tree, shardings):
+    """``jax.device_put(tree, shardings)`` that also works on multi-process
+    meshes when the target carries a host memory kind: the direct path
+    routes non-trivial reshards through a jitted identity
+    (``_different_device_order_reshard``) whose ``annotate_device_placement``
+    the XLA:CPU SPMD partitioner rejects ("Side-effect ops cannot be
+    replicated"). Multi-process therefore migrates shard-wise: pull each
+    leaf's unique local shards to host numpy (or slice numpy leaves by
+    shard index) and rebuild the global array from per-device single-device
+    puts — no SPMD program involved."""
+    if jax.process_count() == 1:
+        return jax.device_put(tree, shardings)
+    is_sh = lambda x: isinstance(x, jax.sharding.Sharding)  # noqa: E731
+    sh_leaves = jax.tree.leaves(shardings, is_leaf=is_sh)
+    leaves = jax.tree.leaves(tree)
+    assert len(sh_leaves) == len(leaves), (len(sh_leaves), len(leaves))
+    metas, datas = [], []
+    for leaf, sh in zip(leaves, sh_leaves):
+        shape = tuple(np.shape(leaf))
+        metas.append((shape, leaf.dtype if hasattr(leaf, "dtype") else np.asarray(leaf).dtype))
+        entries = local_shard_entries(sh, shape)
+        if hasattr(leaf, "addressable_shards"):
+            shards = {_index_key(s.index): np.asarray(s.data)
+                      for s in leaf.addressable_shards}
+            if all(k in shards for k, _idx, _devs in entries):
+                datas.extend(shards[k] for k, _idx, _devs in entries)
+            elif getattr(leaf, "is_fully_addressable", True):
+                # source layout differs from the target (e.g. replicated
+                # init output migrating onto an fsdp partition): slice the
+                # full host value by the target's indices instead
+                arr = np.asarray(leaf)
+                datas.extend(np.ascontiguousarray(arr[idx])
+                             for _k, idx, _devs in entries)
+            else:
+                raise ValueError(
+                    f"migrate: source shard layout {sorted(shards)} does not "
+                    f"cover the target's {[k for k, _, _ in entries]} and the "
+                    f"source is not fully addressable — reshard on device "
+                    f"(same memory kind) before migrating across memory kinds")
+        else:  # host (numpy) leaf: every process holds the full value
+            arr = np.asarray(leaf)
+            datas.extend(np.ascontiguousarray(arr[idx]) for _k, idx, _devs in entries)
+    out = assemble_from_local_shards(metas, sh_leaves, datas)
+    return jax.tree.unflatten(jax.tree.structure(tree), out)
 
 
 class PartitionedParamSwapper:
@@ -301,3 +347,68 @@ class PartitionedParamSwapper:
     def close(self):
         self.read_handle.close()
         self.write_handle.close()
+
+
+# -- multi-host shard ownership ---------------------------------------------
+# The reference's swapper runs per-rank: every rank journals only its own
+# partition (``partitioned_param_swapper.py:403``). The jax analog: each
+# PROCESS journals the unique addressable shards of every leaf (its
+# host-local slice of the global array) into a per-host swap dir, and
+# rematerializes global arrays from those shards via
+# ``jax.make_array_from_single_device_arrays``. Single-host is the 1-process
+# special case of the same code path (all shards addressable).
+
+def _index_key(index) -> str:
+    """Deterministic hashable key for a shard's global-index tuple."""
+    return repr(tuple((s.start, s.stop, s.step) for s in index))
+
+
+def local_shard_entries(sharding, shape):
+    """This process's unique addressable shards of an array with ``shape``
+    under ``sharding``: sorted ``[(key, index, devices)]`` — replicated
+    copies collapse to one entry carrying every device that holds it."""
+    imap = sharding.addressable_devices_indices_map(tuple(shape))
+    by_key: Dict[str, tuple] = {}
+    for d, idx in imap.items():
+        key = _index_key(idx)
+        by_key.setdefault(key, (idx, []))[1].append(d)
+    return [(k, idx, sorted(devs, key=lambda d: d.id))
+            for k, (idx, devs) in sorted(by_key.items())]
+
+
+def local_shard_arrays(leaves) -> List[np.ndarray]:
+    """Flatten the process-local unique shard data of every leaf, in the
+    deterministic (leaf-order x sorted-index) journal order."""
+    out = []
+    for leaf in leaves:
+        shards = {_index_key(s.index): s for s in leaf.addressable_shards}
+        for key, _idx, _devs in local_shard_entries(leaf.sharding, leaf.shape):
+            out.append(np.asarray(shards[key].data))
+    return out
+
+
+def assemble_from_local_shards(leaf_meta, sharding_leaves, datas):
+    """Inverse of :func:`local_shard_arrays`: rebuild each global (possibly
+    non-fully-addressable) array from this process's shard data. Every
+    process calls this with its own ``datas``; jax stitches the global view.
+
+    ``leaf_meta`` is ``[(shape, dtype)]`` per leaf (saved before release —
+    the leaves themselves are gone by fetch time)."""
+    from jax.sharding import SingleDeviceSharding
+
+    leaves, i = [], 0
+    for (shape, dtype), sh in zip(leaf_meta, sharding_leaves):
+        entries = local_shard_entries(sh, shape)
+        kind = getattr(sh, "memory_kind", None)
+        arrs = []
+        for key, _idx, devs in entries:
+            data = np.ascontiguousarray(datas[i]).astype(dtype, copy=False)
+            i += 1
+            for d in devs:
+                dev_sh = (SingleDeviceSharding(d, memory_kind=kind)
+                          if kind else SingleDeviceSharding(d))
+                arrs.append(jax.device_put(data, dev_sh))
+        leaves.append(jax.make_array_from_single_device_arrays(
+            tuple(shape), sh, arrs))
+    assert i == len(datas), f"shard count mismatch: consumed {i} of {len(datas)}"
+    return leaves

@@ -1,0 +1,203 @@
+"""Per-rank worker payloads for the multi-process harness (common.py).
+
+Invoked as ``python _worker.py <payload> <json-kwargs>`` in an env prepared
+by ``launch_procs`` (CPU-pinned, N virtual devices, DSTPU_* coordinator
+vars when multi-process). Each payload prints ONE JSON line.
+
+Payloads mirror the reference's multi-process unit coverage
+(``tests/unit/common.py``-launched tests): a ZeRO-3 train step whose loss
+must match single-process execution, an orbax save that a different
+process topology restores, and per-process (host-local) data feeding.
+"""
+import json
+import os
+import struct
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                                "..", "..", "..")))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # sitecustomize pins "axon,cpu"
+
+import numpy as np
+
+
+def _bootstrap():
+    import deepspeed_tpu
+
+    deepspeed_tpu.comm.init_distributed()  # no-op when DSTPU_* env absent
+    return deepspeed_tpu
+
+
+def _f32_bits(x) -> str:
+    return struct.pack(">f", np.float32(x)).hex()
+
+
+def _build_engine(ds_overrides=None, seq=32, global_bs=8):
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+
+    cfg = get_gpt2_config("test", n_positions=seq, remat=False,
+                          attention_backend="xla", dtype=jnp.float32,
+                          param_dtype=jnp.float32)
+    ds = {
+        "train_batch_size": global_bs,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "gradient_clipping": 1.0,
+        "zero_optimization": {"stage": 3},
+        "steps_per_print": 10**9,
+    }
+    ds.update(ds_overrides or {})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2LMHeadModel(cfg),
+                                               config=ds)
+    return engine, cfg
+
+
+def _local_batch(cfg, rank, world, seq=32, global_bs=8, step=0):
+    """Every rank derives the SAME global batch from the seed, then feeds
+    only its contiguous host-local slice — the per-process data model
+    (reference: each rank's loader yields its own shard)."""
+    rng = np.random.default_rng(1234 + step)
+    ids = rng.integers(0, cfg.vocab_size, (global_bs, seq)).astype(np.int32)
+    per = global_bs // world
+    return {"input_ids": ids[rank * per:(rank + 1) * per]}
+
+
+def _global_param_norms(engine):
+    """Replicated global param L2^2 and sum — identical on every rank by
+    construction (computed in-graph over the sharded tree)."""
+    import jax.numpy as jnp
+
+    def _norms(params):
+        leaves = jax.tree.leaves(params)
+        sq = sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+        s = sum(jnp.sum(l.astype(jnp.float32)) for l in leaves)
+        return sq, s
+
+    sq, s = jax.jit(_norms)(engine.state.params)
+    return _f32_bits(jax.device_get(sq)), _f32_bits(jax.device_get(s))
+
+
+def payload_zero3_train(steps=3, save_dir=None, ds_overrides=None):
+    ds = _bootstrap()
+    rank, world = ds.comm.get_rank(), ds.comm.get_world_size()
+    engine, cfg = _build_engine(ds_overrides=ds_overrides)
+    engine.initialize_state(_local_batch(cfg, rank, world))
+    losses = []
+    for step in range(int(steps)):
+        loss = engine.train_batch(_local_batch(cfg, rank, world, step=step))
+        losses.append(_f32_bits(jax.device_get(loss)))
+    sq, s = _global_param_norms(engine)
+    out = {"rank": rank, "world": world, "ndev": jax.device_count(),
+           "losses": losses, "param_sq": sq, "param_sum": s,
+           "global_steps": engine.global_steps}
+    if save_dir:
+        engine.save_checkpoint(save_dir, tag="mp_tag")
+        ds.comm.barrier()
+    print(json.dumps(out), flush=True)
+
+
+def payload_zero3_nvme(steps=2, nvme_path=None):
+    """ZeRO-Infinity nvme param offload under real multi-process execution:
+    each process journals only its host-local shards into its own swap dir
+    (engine appends ``params_proc<i>``) — the reference's per-rank swapper
+    model (``partitioned_param_swapper.py:403``)."""
+    ds = _bootstrap()
+    rank, world = ds.comm.get_rank(), ds.comm.get_world_size()
+    overrides = {"zero_optimization": {
+        "stage": 3, "stage3_param_persistence_threshold": 0,
+        "offload_param": {"device": "nvme", "nvme_path": nvme_path,
+                          "max_in_cpu": 50000}}}
+    engine, cfg = _build_engine(ds_overrides=overrides)
+    engine.initialize_state(_local_batch(cfg, rank, world))
+    losses = []
+    for step in range(int(steps)):
+        loss = engine.train_batch(_local_batch(cfg, rank, world, step=step))
+        losses.append(_f32_bits(jax.device_get(loss)))
+    released = engine.state.params is None
+    engine._ensure_params_resident()
+    sq, s = _global_param_norms(engine)
+    swap_dir = os.path.join(nvme_path, f"params_proc{rank}" if world > 1 else "params")
+    n_files = len(os.listdir(swap_dir)) if os.path.isdir(swap_dir) else 0
+    print(json.dumps({"rank": rank, "world": world, "losses": losses,
+                      "param_sq": sq, "param_sum": s,
+                      "released_between_steps": released,
+                      "swap_dir": swap_dir, "n_swap_files": n_files}),
+          flush=True)
+
+
+def payload_restore_check(load_dir=None, steps=1):
+    """Restore the 2-process run's checkpoint in THIS topology (typically
+    single-process), verify the params match the saver's global norms, then
+    train on to prove the restored state is usable."""
+    ds = _bootstrap()
+    rank, world = ds.comm.get_rank(), ds.comm.get_world_size()
+    engine, cfg = _build_engine()
+    engine.initialize_state(_local_batch(cfg, rank, world))
+    engine.load_checkpoint(load_dir, tag="mp_tag")
+    sq, s = _global_param_norms(engine)
+    losses = []
+    for step in range(int(steps)):
+        loss = engine.train_batch(_local_batch(cfg, rank, world, step=100 + step))
+        losses.append(_f32_bits(jax.device_get(loss)))
+    print(json.dumps({"rank": rank, "world": world, "param_sq": sq,
+                      "param_sum": s, "global_steps": engine.global_steps,
+                      "post_losses": losses}), flush=True)
+
+
+def payload_comm_surface():
+    """The process-level comm API on a real 2-process job: ranks, world,
+    barrier, and a cross-process collective through the public comm ops."""
+    ds = _bootstrap()
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental import multihost_utils
+    from jax.experimental.shard_map import shard_map
+
+    rank, world = ds.comm.get_rank(), ds.comm.get_world_size()
+    ds.comm.barrier()
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    local = np.full((jax.local_device_count(),), float(rank + 1), np.float32)
+    glob = multihost_utils.host_local_array_to_global_array(local, mesh, P("data"))
+    f = shard_map(lambda x: ds.comm.all_reduce(x, group="data"),
+                  mesh=mesh, in_specs=P("data"), out_specs=P("data"))
+    with mesh:
+        out = jax.jit(f)(glob)
+    # SUM over 8 shards: 4 shards of 1.0 (rank 0) + 4 of 2.0 (rank 1) = 12
+    val = float(jax.device_get(multihost_utils.process_allgather(out, tiled=True))[0])
+    print(json.dumps({"rank": rank, "world": world,
+                      "ndev": jax.device_count(),
+                      "local_ndev": jax.local_device_count(),
+                      "allreduce": val}), flush=True)
+
+
+def payload_data_sampler(total=64, micro=4):
+    """Per-process data sharding through the production sampler: each rank's
+    index stream must be disjoint and jointly covering."""
+    ds = _bootstrap()
+    rank, world = ds.comm.get_rank(), ds.comm.get_world_size()
+    from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_sampler import (
+        DeepSpeedDataSampler)
+
+    sampler = DeepSpeedDataSampler(
+        data_efficiency_config={}, one_epoch_total_samples=int(total),
+        micro_batch_size=int(micro), data_parallel_rank=rank,
+        data_parallel_size=world, gradient_accumulation_steps=1)
+    idx = [int(i) for batch in list(iter(sampler))[:4] for i in np.asarray(batch).ravel()]
+    print(json.dumps({"rank": rank, "world": world, "indices": idx}), flush=True)
+
+
+def main():
+    payload, kwargs = sys.argv[1], json.loads(sys.argv[2] if len(sys.argv) > 2 else "{}")
+    fn = globals().get(f"payload_{payload}")
+    if fn is None:
+        raise SystemExit(f"unknown payload {payload!r}")
+    fn(**kwargs)
+
+
+if __name__ == "__main__":
+    main()
