@@ -48,9 +48,31 @@ class PipelineEngine(DeepSpeedEngine):
         self.micro_batches = self.config.gradient_accumulation_steps
         if pipeline.loss_fn is not None:
             self.loss_fn = pipeline.loss_fn
+        # memory-bounded schedule: run the pipeline in waves of
+        # ``chunk_microbatches`` with gradient accumulation across waves.
+        # The GPipe-ordered scan's autodiff residuals hold one boundary
+        # activation per tick — O(M+S) liveness; 1F1B bounds it at S
+        # (reference schedule.py:189). Chunking at C bounds it at C+S-1
+        # per wave (C=S → <2x the 1F1B bound, constant in M) at the cost
+        # of one extra pipeline fill/drain bubble per wave.
+        pipe_cfg = self.config.raw_dict.get("pipeline", {})
+        chunk_raw = pipe_cfg.get("chunk_microbatches", 0) or 0
+        chunk = int(chunk_raw)
+        if chunk != chunk_raw or chunk < 0:
+            raise ValueError(f"pipeline.chunk_microbatches must be a non-negative "
+                             f"integer, got {chunk_raw!r}")
+        if chunk:
+            if self.micro_batches % chunk != 0:
+                raise ValueError(
+                    f"pipeline.chunk_microbatches={chunk} must divide "
+                    f"gradient_accumulation_steps={self.micro_batches}")
+            if chunk == self.micro_batches:
+                chunk = 0  # one wave == the plain schedule
+        self.pipe_chunk = chunk
         log_dist(f"PipelineEngine: stages={pipeline.num_stages} "
                  f"micro_batches={self.micro_batches} "
-                 f"(schedule parity: {2 * (self.micro_batches + pipeline.num_stages - 1)} ticks "
+                 + (f"chunk={chunk} " if chunk else "")
+                 + f"(schedule parity: {2 * (self.micro_batches + pipeline.num_stages - 1)} ticks "
                  f"of reference TrainSchedule)")
 
     # ------------------------------------------------------------------
@@ -76,14 +98,16 @@ class PipelineEngine(DeepSpeedEngine):
 
         return jax.tree.map(spec_of, tree_specs, is_leaf=lambda x: isinstance(x, P))
 
-    def _pipeline_loss_fn(self):
+    def _pipeline_loss_fn(self, micro=None):
         """Build ``loss(params, ids_mb, labels_mb) -> mean loss`` running the
-        streaming pipeline under shard_map(manual={'pipe'})."""
+        streaming pipeline under shard_map(manual={'pipe'}). ``micro``
+        overrides the microbatch count per invocation (the chunked schedule
+        runs waves of ``pipe_chunk`` microbatches)."""
         pipeline = self.pipeline
         mesh = self.mesh
         n_stages = pipeline.num_stages
         layers_per_stage = pipeline.layers_per_stage
-        micro = self.micro_batches
+        micro = micro or self.micro_batches
         loss_fn = self.loss_fn
         param_specs = self.plan.param_specs
 
@@ -163,21 +187,53 @@ class PipelineEngine(DeepSpeedEngine):
         fp16 = self._fp16_mode
         grad_shardings = self.plan.grad_shardings()
         mesh = self.mesh
-        pipe_loss = self._pipeline_loss_fn()
+        chunk = self.pipe_chunk
+        n_chunks = (self.micro_batches // chunk) if chunk else 1
+        pipe_loss = self._pipeline_loss_fn(micro=chunk if chunk else None)
         compute_dtype = self.compute_dtype
 
-        def loss_of(params, batch, scale):
-            # dtype cast happens inside the shard_map region (see spmd)
+        def _split(batch):
             ids = batch["input_ids"] if isinstance(batch, dict) else batch
             labels = batch.get("labels", ids) if isinstance(batch, dict) else ids
+            return ids, labels
+
+        def chunk_loss_of(params, ids, labels, scale):
+            # dtype cast happens inside the shard_map region (see spmd)
             loss = pipe_loss(params, ids, labels)
             return (loss * scale).astype(jnp.float32), loss
 
+        def loss_of(params, batch, scale):
+            return chunk_loss_of(params, *_split(batch), scale)
+
+        def _grads_full(params, batch, scale):
+            (_, loss), grads = jax.value_and_grad(loss_of, has_aux=True)(params, batch, scale)
+            grads = _cast_floating(grads, jnp.float32)
+            return loss, jax.tree.map(lambda g: g / scale, grads)
+
+        def _grads_chunked(params, batch, scale):
+            # wave-wise accumulation: value_and_grad completes INSIDE each
+            # scan iteration, so autodiff residuals (one boundary activation
+            # per tick) live only for one chunk+fill — the memory-bounded
+            # schedule standing in for 1F1B's interleave
+            ids, labels = _split(batch)
+            ids = ids.reshape((n_chunks, chunk) + ids.shape[1:])
+            labels = labels.reshape((n_chunks, chunk) + labels.shape[1:])
+
+            def wave(acc, xs):
+                i_c, l_c = xs
+                (_, loss_c), g = jax.value_and_grad(chunk_loss_of, has_aux=True)(
+                    params, i_c, l_c, scale)
+                g = _cast_floating(g, jnp.float32)
+                return jax.tree.map(jnp.add, acc, g), loss_c
+
+            zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            grads, losses = jax.lax.scan(wave, zeros, (ids, labels))
+            return jnp.mean(losses), jax.tree.map(lambda g: g / (n_chunks * scale), grads)
+
         def train_step(state: TrainState, batch, rng):
             scale = state.loss_scale.loss_scale if fp16 else jnp.float32(1.0)
-            (_, loss), grads = jax.value_and_grad(loss_of, has_aux=True)(state.params, batch, scale)
-            grads = _cast_floating(grads, jnp.float32)
-            grads = jax.tree.map(lambda g: g / scale, grads)
+            loss, grads = (_grads_chunked if chunk else _grads_full)(
+                state.params, batch, scale)
             grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
 
             gnorm = _global_norm(grads)
@@ -203,9 +259,13 @@ class PipelineEngine(DeepSpeedEngine):
             donate_argnums=(0,),
         )
 
+        # eval is forward-only (no autodiff residuals), so it always runs the
+        # full-micro program even when training is chunked
+        eval_pipe_loss = self._pipeline_loss_fn() if chunk else pipe_loss
+
         def eval_step(params, batch):
-            _, loss = loss_of(params, batch, jnp.float32(1.0))
-            return loss
+            ids, labels = _split(batch)
+            return eval_pipe_loss(params, ids, labels)
 
         self._eval_step_fn = jax.jit(eval_step,
                                      in_shardings=(self.state_shardings.params, None),
