@@ -1992,6 +1992,11 @@ class DeepSpeedEngine:
         # step cannot be enqueued behind a host sync). Device arrays are
         # stashed and resolved lazily — in accessors, at steps_per_print
         # boundaries, or when the pending-overflow window fills.
+        # liveness signal for DSElasticAgent supervision: a cheap utime when
+        # DS_ELASTIC_HEARTBEAT_FILE is set, a no-op otherwise — no device
+        # sync involved, so it does not serialize dispatch
+        from deepspeed_tpu.elasticity.elastic_agent import touch_heartbeat
+        touch_heartbeat()
         if self.progressive_layer_drop is not None:
             # host mirror of the in-graph schedule (reference update_state)
             self.progressive_layer_drop.update_state(self.global_steps)

@@ -98,8 +98,12 @@ def build_engine(model_name, mb, seq, ds_overrides=None, **cfg_overrides):
 
 def time_fused(engine, batch, fused=10, timed_dispatches=2):
     """Compile+warm one fused-scan program, then time ``timed_dispatches``
-    back-to-back dispatches. Returns (n_steps, seconds, compile_seconds)."""
+    back-to-back dispatches. Returns (n_steps, seconds, compile_seconds).
+    Heartbeats (DSElasticAgent supervision) fire inside train_batches'
+    _post_step after every dispatch completes."""
+    from deepspeed_tpu.elasticity import touch_heartbeat
     t_start = time.time()
+    touch_heartbeat()
     stack = jax.tree.map(lambda x: np.broadcast_to(x, (fused,) + np.shape(x)), batch)
     engine.train_batches(stack)
     jax.block_until_ready(engine.state.params)

@@ -1,0 +1,125 @@
+"""Supervised chip-window runner (r4 verdict task #1): execute PERF.md's
+"Chip-window run order" with every stage under ``DSElasticAgent``
+supervision, so a wedged tunnel costs one restart + detection latency —
+never the session — and no human types ``timeout`` near a claim-holder.
+
+Per stage: the agent spawns the command in its own process group, arms a
+startup budget (backend init + cold compile: tunnel compiles have exceeded
+25 min, PERF.md wedge #3) and then a steady-state heartbeat budget fed by
+the engine's ``_post_step`` / the perf tools' per-rung touches. Heartbeat
+silence ⇒ the child is declared hung, killed (the claim is already lost at
+that point — elastic_agent._kill docstring), and retried once.
+
+After each stage a quick subprocess probe checks the chip; if the backend
+no longer answers, remaining stages are skipped (their numbers would be
+CPU fallbacks) and the report says so.
+
+Everything (per-stage rc, agent restart history, probe results) lands in
+``CHIP_WINDOW.json`` — the supervision evidence the verdict asked for.
+
+Run:  python tools/chip_window.py          (background it; poll stdout)
+Env:  CHIP_WINDOW_STAGES=bench,bert,760m,offload,xl,serve  (subset/order)
+      CHIP_WINDOW_STARTUP=3600  CHIP_WINDOW_HEARTBEAT=2400  (seconds)
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+PY = sys.executable
+
+STAGES = {
+    # bench.py FIRST: banks the judged number (+ parity report) and warms
+    # the repo-local .jax_cache for the driver's round-end run
+    "bench": {"cmd": [PY, "bench.py"], "env": {}},
+    # the reference's 64-TFLOPS BERT-large headline, apples-to-apples
+    "bert": {"cmd": [PY, "tools/perf_ladder.py"],
+             "env": {"LADDER_FUSED": "2",
+                     "LADDER": "bert_large_mb128,bert_large_mb64,"
+                               "bert_large_seq512_mb32"}},
+    "760m": {"cmd": [PY, "tools/perf_ladder.py"],
+             "env": {"LADDER_FUSED": "2", "LADDER": "760m_mb8_fx,760m_mb4_fx"}},
+    # ZeRO-Infinity evidence: streaming-overhead A/B at the bench operating
+    # point, then GPT-2-XL 1.5B with param+optimizer offload on one chip
+    "offload": {"cmd": [PY, "tools/perf_ladder.py"],
+                "env": {"LADDER": "350m_offload_mb8"}},
+    "xl": {"cmd": [PY, "tools/perf_ladder.py"],
+           "env": {"LADDER": "xl_offload_mb1", "LADDER_DEADLINE": "5400"}},
+    "bert256": {"cmd": [PY, "tools/perf_ladder.py"],
+                "env": {"LADDER_FUSED": "2", "LADDER": "bert_large_mb256"}},
+    "serve": {"cmd": [PY, "tools/serve_bench.py"], "env": {}},
+}
+DEFAULT_ORDER = ["bench", "bert", "760m", "offload", "xl", "serve"]
+
+
+def probe_alive(timeout=90) -> bool:
+    """Tiny-matmul probe in a subprocess. Killing a probe stuck in backend
+    INIT is safe (it never acquired the claim); a live backend answers in
+    seconds."""
+    code = ("import jax, jax.numpy as jnp;"
+            "x = jnp.ones((128,128), jnp.bfloat16);"
+            "(x @ x).block_until_ready();"
+            "print('ALIVE', jax.devices()[0].platform)")
+    try:
+        p = subprocess.run([PY, "-c", code], capture_output=True, text=True,
+                           timeout=timeout, cwd=REPO)
+        return p.returncode == 0 and "ALIVE" in p.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main():
+    from deepspeed_tpu.elasticity import DSElasticAgent
+
+    order = [s for s in os.environ.get("CHIP_WINDOW_STAGES",
+                                       ",".join(DEFAULT_ORDER)).split(",") if s]
+    startup = float(os.environ.get("CHIP_WINDOW_STARTUP", "3600"))
+    heartbeat = float(os.environ.get("CHIP_WINDOW_HEARTBEAT", "2400"))
+    report = {"started": time.strftime("%Y-%m-%d %H:%M:%S"), "stages": []}
+
+    def save():
+        with open(os.path.join(REPO, "CHIP_WINDOW.json"), "w") as f:
+            json.dump(report, f, indent=1)
+
+    if not probe_alive():
+        report["aborted"] = "chip probe dead before stage 1 — window not open"
+        print(f"# {report['aborted']}", flush=True)
+        save()
+        return 1
+
+    for name in order:
+        stage = STAGES[name]
+        env = dict(stage["env"])
+        print(f"# stage {name}: {' '.join(stage['cmd'])} {env}", flush=True)
+        agent = DSElasticAgent(stage["cmd"], world_sizes=[1],
+                               heartbeat_timeout=heartbeat,
+                               startup_timeout=startup,
+                               max_restarts=1, env=env)
+        t0 = time.time()
+        rc = agent.run(workdir=REPO)
+        entry = {"stage": name, "rc": rc, "duration_s": round(time.time() - t0, 1),
+                 "attempts": agent.history}
+        alive = probe_alive()
+        entry["chip_alive_after"] = alive
+        report["stages"].append(entry)
+        save()
+        print(f"# stage {name} rc={rc} alive_after={alive} "
+              f"attempts={len(agent.history)}", flush=True)
+        if not alive:
+            report["aborted"] = (f"chip died during/after stage {name}; remaining "
+                                 f"stages skipped (would be CPU fallbacks)")
+            print(f"# {report['aborted']}", flush=True)
+            save()
+            return 2
+    report["finished"] = time.strftime("%Y-%m-%d %H:%M:%S")
+    save()
+    print("# CHIP WINDOW COMPLETE", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

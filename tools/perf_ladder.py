@@ -101,6 +101,8 @@ def main():
             print(f"# deadline reached, skipping {tag} onward", flush=True)
             break
         try:
+            from deepspeed_tpu.elasticity import touch_heartbeat
+            touch_heartbeat()  # supervised runs: fresh clock before each rung
             run_rung(tag, **RUNGS[tag.strip()])
         except Exception as e:  # noqa: BLE001 — keep laddering past OOMs
             print(json.dumps({"tag": tag, "error": f"{type(e).__name__}: {str(e)[:300]}"}),
