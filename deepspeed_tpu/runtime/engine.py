@@ -813,15 +813,6 @@ class DeepSpeedEngine:
         if off is None or getattr(off, "device", "none") in (None, "none"):
             return
         device = off.device if isinstance(off.device, str) else str(off.device)
-        # guard BEFORE any host-optimizer construction (NVMeAdam creates
-        # swap dirs + aio thread pools in __init__): each process would
-        # otherwise hold masters for the whole model — see the note below
-        if jax.process_count() > 1:
-            raise NotImplementedError(
-                "offload_optimizer on multi-host meshes needs per-process host-master "
-                "partitioning (each host updating only its addressable shards); "
-                "run offload single-host or use device optimizer states (stage 1-3 "
-                "shard them over fsdp without host round-trips)")
         params = dict(self.config.optimizer_params or {})
         lr = params.get("lr", 1e-3)
         betas = tuple(params.get("betas", (0.9, 0.999)))
@@ -835,18 +826,39 @@ class DeepSpeedEngine:
         elif device == "nvme":
             from deepspeed_tpu.runtime.swap_tensor.optimizer_swapper import NVMeAdam
             nvme_path = getattr(off, "nvme_path", None) or "/tmp/ds_tpu_nvme"
-            self._host_opt = NVMeAdam(swap_dir=os.path.join(str(nvme_path), "optimizer"),
+            # per-process swap dir: moment files are per-master-shard; two
+            # processes sharing optimizer/ would overwrite each other's
+            # exp_avg_*.bin (same reason as the params_proc<i> dirs)
+            opt_dir = (f"optimizer_proc{jax.process_index()}"
+                       if jax.process_count() > 1 else "optimizer")
+            self._host_opt = NVMeAdam(swap_dir=os.path.join(str(nvme_path), opt_dir),
                                       lr=lr, betas=betas, eps=eps, weight_decay=wd, adamw_mode=adamw)
         else:
             raise ValueError(f"unknown offload_optimizer.device {device!r}")
         # fp32 host masters (reference: fp32 flat master partitions in host
-        # RAM, per rank — stage_1_and_2.py:1086). Each PROCESS holds the
-        # masters for the whole model; on one host that is exactly the
-        # reference's per-node footprint (multi-host is guarded above).
-        self._host_masters = [np.ascontiguousarray(np.asarray(jax.device_get(p), np.float32))
-                              for p in jax.tree.leaves(self.state.params)]
+        # RAM, per rank — stage_1_and_2.py:1086). Single-host: one master
+        # per leaf (the reference's per-node footprint). Multi-host: SHARD
+        # granularity — each process holds masters only for its unique
+        # addressable shards and updates only those, exactly the
+        # reference's per-rank partition model. Replicated leaves update
+        # identically on every process (the host Adam is deterministic),
+        # so no cross-host sync is needed.
+        self._host_shard_mode = jax.process_count() > 1
+        self._host_masters = self._build_host_masters()
         log_dist(f"optimizer offload enabled: device={device} "
-                 f"({sum(m.size for m in self._host_masters) / 1e6:.1f}M host master elems)")
+                 f"({sum(m.size for m in self._host_masters) / 1e6:.1f}M host master elems"
+                 + (", per-process shard partition" if self._host_shard_mode else "") + ")")
+
+    def _build_host_masters(self):
+        """fp32 host masters from the current params: whole leaves on a
+        single process, this process's unique shards (param-sharding
+        partition) in multi-host shard mode."""
+        if getattr(self, "_host_shard_mode", False):
+            from deepspeed_tpu.runtime.zero.param_offload import local_shard_arrays
+            return [np.ascontiguousarray(np.asarray(a, np.float32))
+                    for a in local_shard_arrays(jax.tree.leaves(self.state.params))]
+        return [np.ascontiguousarray(np.asarray(jax.device_get(p), np.float32))
+                for p in jax.tree.leaves(self.state.params)]
 
     def _offload_train_batch(self, device_batch, rng):
         """fwd+bwd on device (jitted), optimizer update on host via the C++
@@ -862,6 +874,9 @@ class DeepSpeedEngine:
         leaves, treedef = jax.tree.flatten(self.state.params)
         shard_leaves = jax.tree.leaves(self.state_shardings.params)
         grad_dev = jax.tree.leaves(grads)
+        if getattr(self, "_host_shard_mode", False):
+            return self._offload_step_sharded(loss, gnorm, leaves, treedef,
+                                              shard_leaves, grad_dev)
         new_leaves = [None] * len(leaves)
         if hasattr(self._host_opt, "step_single"):
             # pipelined: d2h of leaf i+1 overlaps the AVX update of leaf i
@@ -885,6 +900,60 @@ class DeepSpeedEngine:
             self._host_opt.step(self._host_masters, grad_leaves, lr=self.get_lr()[0])
             new_leaves = [jax.device_put(m.reshape(old.shape).astype(old.dtype), s)
                           for m, old, s in zip(self._host_masters, leaves, shard_leaves)]
+        new_params = jax.tree.unflatten(treedef, new_leaves)
+        new_ls = self._ls_update(self.state.loss_scale, jnp.asarray(False))
+        self.state = TrainState(step=self.state.step + 1, params=new_params,
+                                opt_state=self.state.opt_state, loss_scale=new_ls)
+        self._journal_params_to_nvme()
+        return loss, {"loss": loss, "grad_norm": gnorm, "overflow": jnp.asarray(False),
+                      "loss_scale": new_ls.loss_scale}
+
+    def _offload_step_sharded(self, loss, gnorm, leaves, treedef, shard_leaves,
+                              grad_dev):
+        """Multi-host host-optimizer step at SHARD granularity: fetch only
+        this process's unique grad shards, step the matching shard masters
+        (same flat leaf-order x sorted-index order as ``local_shard_arrays``),
+        rebuild the global params via per-device puts. The reference runs
+        one swapper/optimizer per rank on its own partition
+        (``stage_1_and_2.py:1086``); this is the jax.Array analog."""
+        from deepspeed_tpu.runtime.zero.param_offload import (
+            assemble_from_local_shards, local_shard_entries, _index_key)
+
+        grad_shards = []
+        for g, sh in zip(grad_dev, shard_leaves):
+            by_key = {_index_key(s.index): s for s in g.addressable_shards}
+            # enumerate by the PARAM sharding: masters were partitioned by
+            # it, and _build_step_fns constrained the grads-only program's
+            # outputs to the same layout
+            for key, _idx, _devs in local_shard_entries(sh, g.shape):
+                if key not in by_key:
+                    raise RuntimeError(
+                        f"grad shard layout {sorted(by_key)} does not cover the "
+                        f"param shard partition key {key} — the grads-only "
+                        f"program must emit grads in the params' layout "
+                        f"(engine._build_step_fns shard-mode branch)")
+                grad_shards.append(by_key[key])
+        assert len(grad_shards) == len(self._host_masters), (
+            len(grad_shards), len(self._host_masters))
+        fetch = lambda i: np.asarray(grad_shards[i].data, np.float32)  # noqa: E731
+        if hasattr(self._host_opt, "step_single"):
+            if not hasattr(self, "_offload_pool"):
+                import concurrent.futures
+                self._offload_pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+            self._host_opt.begin_step(lr=self.get_lr()[0])
+            fut = self._offload_pool.submit(fetch, 0)
+            for i, m in enumerate(self._host_masters):
+                g = fut.result()
+                if i + 1 < len(self._host_masters):
+                    fut = self._offload_pool.submit(fetch, i + 1)
+                self._host_opt.step_single(i, m, g)
+        else:
+            self._host_opt.step(self._host_masters,
+                                [fetch(i) for i in range(len(self._host_masters))],
+                                lr=self.get_lr()[0])
+        metas = [(tuple(l.shape), l.dtype) for l in leaves]
+        new_leaves = assemble_from_local_shards(metas, shard_leaves,
+                                                self._host_masters)
         new_params = jax.tree.unflatten(treedef, new_leaves)
         new_ls = self._ls_update(self.state.loss_scale, jnp.asarray(False))
         self.state = TrainState(step=self.state.step + 1, params=new_params,
@@ -1475,7 +1544,21 @@ class DeepSpeedEngine:
                                  "host-resident leaves); disable one of the two")
 
         if getattr(self, "_offload_enabled", False):
-            self._build_offload_step_fns(grad_shardings)
+            if getattr(self, "_host_shard_mode", False):
+                # shard-granular host masters pair 1:1 with PARAM shards
+                # (_offload_step_sharded): grads must leave the device
+                # program in the params' layout, not the fsdp-everything
+                # grad layout (a replicated-under-persistence-threshold
+                # param would otherwise meet an fsdp-sharded grad and the
+                # shard pairing would break)
+                dev_param_shardings = jax.tree.map(
+                    lambda s: NamedSharding(s.mesh, s.spec)
+                    if isinstance(s, NamedSharding) else s,
+                    self.state_shardings.params,
+                    is_leaf=lambda x: isinstance(x, NamedSharding))
+                self._build_offload_step_fns(dev_param_shardings)
+            else:
+                self._build_offload_step_fns(grad_shardings)
 
         def grads_of_micro(params, mb, key, scale):
             (scaled_loss, loss), grads = jax.value_and_grad(self._loss_for, has_aux=True)(params, mb, key, scale)
@@ -2179,11 +2262,18 @@ class DeepSpeedEngine:
             if dist.get_rank() == 0:
                 np.save(os.path.join(save_dir, tag, "zeroone_state.npy"),
                         zo_state, allow_pickle=True)
-        if getattr(self, "_host_opt", None) is not None and dist.get_rank() == 0:
-            # offloaded optimizer state (host masters + moments bookkeeping)
-            np.save(os.path.join(save_dir, tag, "host_optimizer.npy"),
-                    {"opt": self._host_opt.state_dict(),
-                     "masters": self._host_masters}, allow_pickle=True)
+        if getattr(self, "_host_opt", None) is not None:
+            # offloaded optimizer state (host masters + moments bookkeeping).
+            # Shard mode (multi-host): every process owns a disjoint master
+            # partition, so every process writes its own file — the
+            # reference's per-rank optimizer checkpoint model.
+            fname = (f"host_optimizer_proc{dist.get_rank()}.npy"
+                     if getattr(self, "_host_shard_mode", False)
+                     else "host_optimizer.npy")
+            if getattr(self, "_host_shard_mode", False) or dist.get_rank() == 0:
+                np.save(os.path.join(save_dir, tag, fname),
+                        {"opt": self._host_opt.state_dict(),
+                         "masters": self._host_masters}, allow_pickle=True)
         if use_async:
             # Nebula-style deferral: training continues while orbax
             # finalizes in the background; 'latest' (the durability marker)
@@ -2298,19 +2388,38 @@ class DeepSpeedEngine:
                 self._zeroone_runner.load_state_dict(
                     np.load(zo_path, allow_pickle=True).item())
         if getattr(self, "_host_opt", None) is not None:
-            host_path = os.path.join(load_dir, tag, "host_optimizer.npy")
-            if os.path.exists(host_path):
-                blob = np.load(host_path, allow_pickle=True).item()
-                self._host_opt.load_state_dict(blob["opt"])
-                self._host_masters = [np.ascontiguousarray(m, np.float32) for m in blob["masters"]]
-            else:
-                # checkpoint has no host-optimizer state (saved without
-                # offload): rebuild masters from the restored params so the
-                # next step doesn't clobber them with init-time values
-                logger.warning(f"no host_optimizer state in {load_dir}/{tag}; rebuilding fp32 "
-                               f"masters from restored params, optimizer moments reset")
-                self._host_masters = [np.ascontiguousarray(np.asarray(jax.device_get(p), np.float32))
-                                      for p in jax.tree.leaves(self.state.params)]
+            shard_mode = getattr(self, "_host_shard_mode", False)
+            fname = (f"host_optimizer_proc{dist.get_rank()}.npy" if shard_mode
+                     else "host_optimizer.npy")
+            host_path = os.path.join(load_dir, tag, fname)
+            blob = (np.load(host_path, allow_pickle=True).item()
+                    if os.path.exists(host_path) else None)
+            if blob is not None:
+                loaded = [np.ascontiguousarray(m, np.float32) for m in blob["masters"]]
+                # same process COUNT does not imply the same shard layout
+                # (mesh reshape, devices-per-proc change): validate against
+                # this topology's partition before trusting per-rank files
+                expect = self._build_host_masters()
+                if (len(loaded) != len(expect)
+                        or any(a.shape != b.shape for a, b in zip(loaded, expect))):
+                    logger.warning(
+                        f"host_optimizer state at {host_path} was saved under a "
+                        f"different shard partition ({len(loaded)} masters vs "
+                        f"{len(expect)} expected); rebuilding masters from "
+                        f"restored params, optimizer moments reset")
+                    blob = None
+                else:
+                    self._host_opt.load_state_dict(blob["opt"])
+                    self._host_masters = loaded
+            if blob is None:
+                # no state for this process (saved without offload, or an
+                # incompatible topology): rebuild masters from the restored
+                # params so the next step doesn't clobber them with
+                # init-time values
+                logger.warning(f"no usable host_optimizer state at {host_path}; "
+                               f"rebuilding fp32 masters from restored params, "
+                               f"optimizer moments reset")
+                self._host_masters = self._build_host_masters()
                 self._host_opt.reset_state()
         self.global_steps = meta.get("global_steps", 0)
         self.global_samples = meta.get("global_samples", 0)

@@ -130,6 +130,39 @@ def payload_zero3_nvme(steps=2, nvme_path=None):
           flush=True)
 
 
+def payload_zero3_infinity(steps=2, nvme_path=None, persistence_threshold=0):
+    """The full ZeRO-Infinity recipe under real multi-process execution:
+    stage 3 + offload_param (cpu tier) + offload_optimizer (host C++ Adam
+    at SHARD granularity — each process steps only the masters of its
+    unique addressable shards, engine._offload_step_sharded).
+    ``persistence_threshold=None`` keeps the config default (small params
+    stay replicated while their grads would default to fsdp — the layout
+    split engine._build_step_fns' shard-mode branch must reconcile)."""
+    ds = _bootstrap()
+    rank, world = ds.comm.get_rank(), ds.comm.get_world_size()
+    zero = {"stage": 3,
+            "offload_param": {"device": "cpu"},
+            "offload_optimizer": {"device": "cpu"}}
+    if persistence_threshold is not None:
+        zero["stage3_param_persistence_threshold"] = persistence_threshold
+    overrides = {"zero_optimization": zero}
+    engine, cfg = _build_engine(ds_overrides=overrides)
+    engine.initialize_state(_local_batch(cfg, rank, world))
+    losses = []
+    for step in range(int(steps)):
+        loss = engine.train_batch(_local_batch(cfg, rank, world, step=step))
+        losses.append(_f32_bits(jax.device_get(loss)))
+    sq, s = _global_param_norms(engine)
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree.leaves(engine.state.params))
+    master_elems = sum(int(m.size) for m in engine._host_masters)
+    print(json.dumps({"rank": rank, "world": world, "losses": losses,
+                      "param_sq": sq, "param_sum": s, "n_params": n_params,
+                      "master_elems": master_elems,
+                      "shard_mode": bool(getattr(engine, "_host_shard_mode",
+                                                 False))}), flush=True)
+
+
 def payload_restore_check(load_dir=None, steps=1):
     """Restore the 2-process run's checkpoint in THIS topology (typically
     single-process), verify the params match the saver's global norms, then
