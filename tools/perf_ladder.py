@@ -42,6 +42,8 @@ def run_rung(tag, model_name, mb, offload=False, steps=None, seq=None,
         # lane-aligned vocab (30522 → 30592, x128); BERT has no causal LM
         # head so the GPT-2 fused-xent/onehot knobs don't apply
         overrides = {"vocab_size": 30592}
+    elif model_name == "test":  # smoke rungs: keep the tiny 256 vocab
+        overrides = {}
     else:
         overrides = {"vocab_size": 50304, "embed_onehot_grad": True}
         if fused_xent:
@@ -58,6 +60,11 @@ def run_rung(tag, model_name, mb, offload=False, steps=None, seq=None,
 
 
 RUNGS = {
+    # harness smoke rungs (tiny model): validate the fused and offload
+    # measurement paths in seconds on any backend before burning a chip
+    # window on the real rungs
+    "smoke": dict(model_name="test", mb=2, seq=64),
+    "smoke_offload": dict(model_name="test", mb=2, seq=64, offload=True, steps=2),
     "760m_mb4": dict(model_name="760m", mb=4),
     "760m_mb8": dict(model_name="760m", mb=8),
     # plain 760m_mb8 OOMs by 2.6G; the chunked fused head removes the
