@@ -68,8 +68,21 @@ def init_distributed(dist_backend: Optional[str] = None,
     if _INITIALIZED:
         return
     coordinator = os.environ.get("DSTPU_COORDINATOR_ADDRESS") or os.environ.get("COORDINATOR_ADDRESS")
-    n_procs = world_size if world_size > 0 else int(os.environ.get("DSTPU_NUM_PROCESSES", "0") or 0)
-    proc_id = rank if rank >= 0 else int(os.environ.get("DSTPU_PROCESS_ID", "-1"))
+    # env contract: DSTPU_* (harness/tests) or JAX_* (launcher/launch.py
+    # build_child_env) — reading only one family made launcher-spawned
+    # multi-node jobs silently fall through to N disjoint single-host jobs
+    n_procs = world_size if world_size > 0 else int(
+        os.environ.get("DSTPU_NUM_PROCESSES")
+        or os.environ.get("JAX_NUM_PROCESSES", "0") or 0)
+    proc_id = rank if rank >= 0 else int(
+        os.environ.get("DSTPU_PROCESS_ID")
+        or os.environ.get("JAX_PROCESS_ID", "-1") or -1)
+    if coordinator and n_procs == 0:
+        logger.warning(
+            f"coordinator address {coordinator} is set but no process count "
+            f"(DSTPU_NUM_PROCESSES / JAX_NUM_PROCESSES / world_size=) — "
+            f"treating as single-process; multi-host jobs MUST set the count "
+            f"or every host trains alone")
     if coordinator and n_procs > 1:
         # Explicit multi-host config: failures here must be fatal, otherwise
         # N hosts silently train as N disjoint single-host jobs.

@@ -92,6 +92,11 @@ def build_child_env(node_rank: int, nnodes: int, master_addr: str, master_port: 
     env["NODE_RANK"] = str(node_rank)
     env["JAX_PROCESS_ID"] = str(node_rank)
     env["JAX_NUM_PROCESSES"] = str(nnodes)
+    # the comm bootstrap's primary env family (comm.init_distributed) —
+    # set both so user scripts and the test harness see one contract
+    env["DSTPU_COORDINATOR_ADDRESS"] = env["COORDINATOR_ADDRESS"]
+    env["DSTPU_PROCESS_ID"] = str(node_rank)
+    env["DSTPU_NUM_PROCESSES"] = str(nnodes)
     # reference-compatible names so user scripts keep working
     env["RANK"] = str(node_rank)
     env["LOCAL_RANK"] = "0"
