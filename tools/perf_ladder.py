@@ -38,7 +38,9 @@ def run_rung(tag, model_name, mb, offload=False, steps=None, seq=None,
             "offload_param": {"device": "cpu", "pin_memory": True},
             "offload_optimizer": {"device": "cpu", "pin_memory": True},
         }
-    if model_name.startswith("bert_"):
+    if model_name == "bert_test":  # smoke rung: keep the tiny test vocab
+        overrides = {}
+    elif model_name.startswith("bert_"):
         # lane-aligned vocab (30522 → 30592, x128); BERT has no causal LM
         # head so the GPT-2 fused-xent/onehot knobs don't apply
         overrides = {"vocab_size": 30592}
@@ -65,6 +67,7 @@ RUNGS = {
     # window on the real rungs
     "smoke": dict(model_name="test", mb=2, seq=64),
     "smoke_offload": dict(model_name="test", mb=2, seq=64, offload=True, steps=2),
+    "smoke_bert": dict(model_name="bert_test", mb=2, seq=64),
     "760m_mb4": dict(model_name="760m", mb=4),
     "760m_mb8": dict(model_name="760m", mb=8),
     # plain 760m_mb8 OOMs by 2.6G; the chunked fused head removes the
