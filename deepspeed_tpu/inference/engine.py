@@ -274,14 +274,16 @@ class InferenceEngine:
             "gen_loop": jax.jit(gen_loop, donate_argnums=(1,)),
         }
 
-    def _build_beam_loop(self, batch, beams, eos_token_id, cap, length_penalty):
-        """Beam-search decode (reference relies on HF ``generate`` over the
-        injected kernels; here the whole search is one jitted while_loop).
+    def _make_beam_fns(self, batch, beams, eos_token_id, cap, length_penalty,
+                       decode_fn):
+        """Generic beam-search machinery shared by decoder-only and
+        encoder-decoder serving. ``decode_fn(params, cache, tok_2d, extra)
+        -> (logits [batch*beams, V], new_cache)`` is the one-step decoder;
+        ``extra`` is any per-call operand the step cross-references (the
+        replicated encoder output for seq2seq; ``()`` for decoder-only).
         Each live hypothesis is one row of a [batch*beams] decode batch; the
         KV cache reindexes by the winning beams' source indices every step."""
         eos = -1 if eos_token_id is None else int(eos_token_id)
-
-        apply_decode = self._apply_decode
 
         def replicate(cache):
             # leaves with a leading batch dim fan out to [batch*beams, ...];
@@ -302,7 +304,7 @@ class InferenceEngine:
                 return x
             return jax.tree.map(gather, cache)
 
-        def beam_loop(params, cache, last_logits, max_new):
+        def beam_loop(params, cache, extra, last_logits, max_new):
             # cache arrives ALREADY replicated to [batch*beams, ...] (the
             # caller runs the jitted replicate first) so the donated input
             # aliases the loop-carried cache — inside-loop replication would
@@ -324,8 +326,9 @@ class InferenceEngine:
 
             def body(state):
                 t, done, tok, scores, lens, cache, out = state
-                logits, upd = apply_decode(params, cache, tok.reshape(batch * beams, 1))
-                lp = jax.nn.log_softmax(logits[:, 0].astype(jnp.float32), axis=-1)
+                logits, new_cache = decode_fn(params, cache,
+                                              tok.reshape(batch * beams, 1), extra)
+                lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
                 lp = lp.reshape(batch, beams, vocab)
                 lp = jnp.where(done[:, :, None], done_lp[None, None, :], lp)
                 total = scores[:, :, None] + lp  # [B, beams, V]
@@ -339,7 +342,7 @@ class InferenceEngine:
                 out = jnp.take_along_axis(out, beam_src[:, :, None], axis=1)
                 # a finished beam keeps emitting eos (or 0) — already its token
                 out = out.at[:, :, t].set(jnp.where(prev_done, max(eos, 0), new_tok))
-                cache = reindex(upd["cache"], beam_src)
+                cache = reindex(new_cache, beam_src)
                 return t + 1, new_done, new_tok, new_scores, new_lens, cache, out
 
             t, done, tok, scores, lens, cache, out = jax.lax.while_loop(
@@ -355,23 +358,72 @@ class InferenceEngine:
         return {"replicate": jax.jit(replicate),
                 "loop": jax.jit(beam_loop, donate_argnums=(1,))}
 
+    def _build_beam_loop(self, batch, beams, eos_token_id, cap, length_penalty):
+        """Decoder-only beam search (reference relies on HF ``generate``
+        over the injected kernels; here the whole search is one jitted
+        while_loop over :meth:`_make_beam_fns`)."""
+        apply_decode = self._apply_decode
+
+        def decode_fn(params, cache, tok, extra):
+            del extra
+            logits, upd = apply_decode(params, cache, tok)
+            return logits[:, 0], upd["cache"]
+
+        return self._make_beam_fns(batch, beams, eos_token_id, cap,
+                                   length_penalty, decode_fn)
+
+    def _build_seq2seq_beam(self, batch, beams, eos_token_id, cap,
+                            length_penalty):
+        """Encoder-decoder beam search: encode once, replicate the decoder
+        self-attention cache AND the encoder output to [batch*beams], then
+        run the shared beam while_loop with a cross-attending step."""
+        step = self._seq2seq_step
+        encode = self._seq2seq_encode
+
+        def first(params, cache, enc_out, start_tok):
+            # the start-token step runs on the UNREPLICATED batch (every
+            # beam of a row would compute the same thing); its logits seed
+            # the beam fan-out exactly like decoder-only prefill logits
+            logits, cache = step(params, cache, enc_out, start_tok)
+            return logits[:, -1], cache
+
+        def decode_fn(params, cache, tok, enc_rep):
+            logits, cache = step(params, cache, enc_rep, tok)
+            return logits[:, 0], cache
+
+        fns = self._make_beam_fns(batch, beams, eos_token_id, cap,
+                                  length_penalty, decode_fn)
+        fns["first"] = jax.jit(first, donate_argnums=(1,))
+        # the encoder output fans out to [batch*beams] by the SAME rule as
+        # the cache (one shared jitted repeat — the row alignment between
+        # the two replications is load-bearing for cross-attention)
+        fns["rep_enc"] = fns["replicate"]
+        fns["encode"] = jax.jit(encode)
+        return fns
+
+    def _seq2seq_step(self, params, cache, enc_out, tok):
+        """One decoder step of an encoder-decoder model: self-attend the
+        cache, cross-attend the encoder output (shared by the greedy and
+        beam builders so the two paths cannot drift)."""
+        model = self.module
+        logits, upd = model.apply({"params": self._mparams(params), "cache": cache},
+                                  decoder_input_ids=tok, encoder_outputs=enc_out,
+                                  decode=True, mutable=["cache"])
+        return _unwrap_logits(logits), upd["cache"]
+
+    def _seq2seq_encode(self, params, enc_ids):
+        model = self.module
+        return model.apply({"params": self._mparams(params)}, enc_ids,
+                           method=type(model).encode)
+
     def _build_seq2seq_serving(self, batch, do_sample, temperature, top_k, top_p,
                                eos_token_id, cap):
         """Encoder-decoder serving (T5-style): encode once, then a jitted
         decoder while_loop against the self-attention cache, cross-attending
         the encoder output every step."""
-        model = self.module
         eos = -1 if eos_token_id is None else int(eos_token_id)
-
-        def encode(params, enc_ids):
-            return model.apply({"params": self._mparams(params)}, enc_ids,
-                               method=type(model).encode)
-
-        def step(params, cache, enc_out, tok):
-            logits, upd = model.apply({"params": self._mparams(params), "cache": cache},
-                                      decoder_input_ids=tok, encoder_outputs=enc_out,
-                                      decode=True, mutable=["cache"])
-            return _unwrap_logits(logits), upd["cache"]
+        step = self._seq2seq_step
+        encode = self._seq2seq_encode
 
         def gen_loop(params, cache, enc_out, start_tok, rng, max_new):
             logits, cache = step(params, cache, enc_out, start_tok)
@@ -405,7 +457,8 @@ class InferenceEngine:
 
     def _generate_seq2seq(self, ids_np, real_batch, batch, max_new, do_sample,
                           temperature, top_k, top_p, eos_token_id, rng,
-                          decoder_start_token_id):
+                          decoder_start_token_id, num_beams=1,
+                          length_penalty=1.0):
         mcap = getattr(self.mcfg, "max_cache_length", None) or self._max_len
         # cache slots consumed = max_new (the start token plus the max_new-1
         # fed-back tokens; the final sample is never fed back)
@@ -417,13 +470,21 @@ class InferenceEngine:
                              f"budget max_tokens={self.config.max_tokens}; raise it in "
                              f"the inference config (silently truncating would hide the miss)")
         cap = int(min(mcap, self.config.max_tokens or mcap))
-        key = ("seq2seq", batch, do_sample, float(temperature), int(top_k),
-               float(top_p), eos_token_id)
+        if num_beams > 1:
+            key = ("seq2seq_beam", batch, num_beams, eos_token_id,
+                   float(length_penalty))
+        else:
+            key = ("seq2seq", batch, do_sample, float(temperature), int(top_k),
+                   float(top_p), eos_token_id)
         if not hasattr(self, "_gen_cache"):
             self._gen_cache = {}
         if key not in self._gen_cache:
-            self._gen_cache[key] = self._build_seq2seq_serving(
-                batch, do_sample, temperature, top_k, top_p, eos_token_id, cap)
+            self._gen_cache[key] = (
+                self._build_seq2seq_beam(batch, num_beams, eos_token_id, cap,
+                                         float(length_penalty))
+                if num_beams > 1 else
+                self._build_seq2seq_serving(batch, do_sample, temperature,
+                                            top_k, top_p, eos_token_id, cap))
         fns = self._gen_cache[key]
         start = jnp.full((batch, 1), int(decoder_start_token_id), jnp.int32)
         if max_new <= 0:  # parity with the decoder-only path's no-op return
@@ -439,8 +500,15 @@ class InferenceEngine:
         enc_out = self._enc_cache[batch](self.params, self._place_batch(jnp.asarray(ids_np)))
         cache = jax.device_put(init_cache(self.module, batch),
                                NamedSharding(self.mesh, P()))
-        out, n, _ = fns["gen_loop"](self.params, cache, enc_out, start, rng,
+        if num_beams > 1:
+            last_logits, cache = fns["first"](self.params, cache, enc_out, start)
+            cache = fns["replicate"](cache)
+            enc_rep = fns["rep_enc"](enc_out)
+            out, n, _ = fns["loop"](self.params, cache, enc_rep, last_logits,
                                     jnp.int32(min(max_new, cap)))
+        else:
+            out, n, _ = fns["gen_loop"](self.params, cache, enc_out, start, rng,
+                                        jnp.int32(min(max_new, cap)))
         n = int(n)
         full = jnp.concatenate([start, out[:, :n]], axis=1)
         return full[:real_batch]
@@ -481,9 +549,6 @@ class InferenceEngine:
             return ids_np, batch, rng
 
         if self._is_seq2seq:
-            if num_beams > 1:
-                raise NotImplementedError("beam search for encoder-decoder serving "
-                                          "is not implemented; use greedy/sampling")
             start_id = kwargs.get("decoder_start_token_id",
                                   getattr(self.mcfg, "decoder_start_token_id", None))
             if start_id is None:
@@ -493,7 +558,8 @@ class InferenceEngine:
             ids_np, batch, rng = bucket_pad_and_rng(ids_np, rng)
             return self._generate_seq2seq(
                 ids_np, real_batch, batch, max_new, do_sample, temperature, top_k,
-                top_p, eos_token_id, rng, int(start_id))
+                top_p, eos_token_id, rng, int(start_id),
+                num_beams=num_beams, length_penalty=length_penalty)
         if prompt_len + max_new > self._max_len:
             raise ValueError(f"prompt ({prompt_len}) + max_new_tokens ({max_new}) exceeds the model "
                              f"context/cache length {self._max_len} "
@@ -542,7 +608,7 @@ class InferenceEngine:
                     batch, num_beams, eos_token_id, cap, float(length_penalty))
             bfns = self._beam_cache[bkey]
             cache = bfns["replicate"](cache)
-            out, n, _ = bfns["loop"](self.params, cache, last_logits,
+            out, n, _ = bfns["loop"](self.params, cache, (), last_logits,
                                      jnp.int32(min(max_new, cap)))
         else:
             out, n, _ = fns["gen_loop"](self.params, cache, last_logits, rng,
