@@ -52,8 +52,12 @@ STAGES = {
     "bert256": {"cmd": [PY, "tools/perf_ladder.py"],
                 "env": {"LADDER_FUSED": "2", "LADDER": "bert_large_mb256"}},
     "serve": {"cmd": [PY, "tools/serve_bench.py"], "env": {}},
+    # autotuner measured mode against real chip timings (r4 weak #6): the
+    # tuner's ranking should reproduce the hand-found optimum (mb=8)
+    "tune": {"cmd": [PY, "tools/tune_bench.py"],
+             "env": {"TUNE_STAGES": "0", "TUNE_MAX_MBS": "16"}},
 }
-DEFAULT_ORDER = ["bench", "bert", "760m", "offload", "xl", "serve"]
+DEFAULT_ORDER = ["bench", "bert", "760m", "offload", "xl", "serve", "tune"]
 
 
 def probe_alive(timeout=90) -> bool:
