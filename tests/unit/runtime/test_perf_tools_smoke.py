@@ -54,6 +54,20 @@ def test_tune_bench_runs_end_to_end():
     assert measured, row
 
 
+def test_rlhf_bench_runs_end_to_end():
+    lines = _run_cpu(
+        "import sys; sys.path.insert(0, 'tools');"
+        "import jax; jax.config.update('jax_platforms', 'cpu');"
+        "import rlhf_bench; rlhf_bench.main()",
+        env_extra={"RLHF_MODEL": "test", "RLHF_BATCH": "2",
+                   "RLHF_PROMPT": "16", "RLHF_NEW": "8", "RLHF_ITERS": "2"})
+    row = lines[-1]
+    assert row["gen_tokens_per_s"] > 0
+    assert row["rlhf_iters_per_s"] > 0
+    # the hybrid engine actually alternated layouts
+    assert row["hybrid_stats"].get("iters", 0) >= 2
+
+
 def test_serve_bench_runs_end_to_end():
     lines = _run_cpu(
         "import sys; sys.path.insert(0, 'tools');"

@@ -56,8 +56,12 @@ STAGES = {
     # tuner's ranking should reproduce the hand-found optimum (mb=8)
     "tune": {"cmd": [PY, "tools/tune_bench.py"],
              "env": {"TUNE_STAGES": "0", "TUNE_MAX_MBS": "16"}},
+    # hybrid-engine RLHF phases (the DeepSpeed-Chat evidence class):
+    # rollout generation + layout switch + policy update per iteration
+    "rlhf": {"cmd": [PY, "tools/rlhf_bench.py"], "env": {}},
 }
-DEFAULT_ORDER = ["bench", "bert", "760m", "offload", "xl", "serve", "tune"]
+DEFAULT_ORDER = ["bench", "bert", "760m", "offload", "xl", "serve", "tune",
+                 "rlhf"]
 
 
 def probe_alive(timeout=90) -> bool:

@@ -1,0 +1,121 @@
+"""RLHF hybrid-engine throughput bench — the evidence class behind the
+reference's DeepSpeed-Chat claims (``blogs/deepspeed-chat/README.md:30``
+"15x faster"; per-model train-time tables ``:38``). Their cost is split
+across exactly the phases measured here:
+
+1. **rollout generation** (serving layout; the hybrid engine reshards the
+   LIVE training params into inference TP and runs the jitted decode loop),
+2. **train<->serve switch latency** (reference: gather/scatter of ZeRO
+   shards per swap, ``hybrid_engine.py``; here: the param-layout reshard +
+   program swap, amortized by the jit cache),
+3. **policy update step** (REINFORCE surrogate loss through the production
+   ZeRO train step).
+
+One JSON line: per-phase times + end-to-end RLHF iterations/s.
+
+Run: python tools/rlhf_bench.py     (background; clean-exit; NEVER
+     timeout-wrap on the tunnel)
+Env: RLHF_MODEL=350m RLHF_BATCH=8 RLHF_PROMPT=128 RLHF_NEW=128
+     RLHF_ITERS=3 RLHF_ZERO=0
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+MODEL = os.environ.get("RLHF_MODEL", "350m")
+BATCH = int(os.environ.get("RLHF_BATCH", "8"))
+PROMPT = int(os.environ.get("RLHF_PROMPT", "128"))
+NEW = int(os.environ.get("RLHF_NEW", "128"))
+ITERS = int(os.environ.get("RLHF_ITERS", "3"))
+ZERO = int(os.environ.get("RLHF_ZERO", "0"))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from bench_core import enable_compile_cache
+
+    enable_compile_cache()
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+
+    cfg = get_gpt2_config(MODEL, n_positions=PROMPT + NEW, dtype=jnp.bfloat16,
+                          remat=True,
+                          attention_backend="flash"
+                          if jax.default_backend() in ("tpu", "axon") else "xla")
+    model = GPT2LMHeadModel(cfg)
+
+    def loss_fn(logits, batch):
+        tok = batch["rollouts"]
+        adv = batch["advantage"]
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+        tgt = jnp.take_along_axis(logp, tok[:, 1:, None], axis=-1)[..., 0]
+        mask = jnp.arange(tok.shape[1] - 1)[None, :] >= (PROMPT - 1)
+        return -jnp.mean(adv[:, None] * tgt * mask)
+
+    ds = {"train_batch_size": BATCH,
+          "optimizer": {"type": "AdamW", "params": {"lr": 1e-5}},
+          "bf16": {"enabled": True},
+          "gradient_clipping": 1.0,
+          "zero_optimization": {"stage": ZERO},
+          "hybrid_engine": {"enabled": True},
+          "steps_per_print": 10**9}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds,
+                                               loss_fn=loss_fn)
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, cfg.vocab_size, (BATCH, PROMPT)).astype(np.int32)
+    # state must exist before the first generate(): the hybrid engine
+    # reshards the LIVE training params into the serving layout
+    example = {"input_ids": np.zeros((BATCH, PROMPT + NEW), np.int32),
+               "rollouts": np.zeros((BATCH, PROMPT + NEW), np.int32),
+               "advantage": np.zeros((BATCH,), np.float32)}
+    engine.initialize_state(example)
+
+    def one_iter():
+        t0 = time.time()
+        rollouts = np.asarray(engine.generate(prompts, max_new_tokens=NEW))
+        t_gen = time.time() - t0
+        reward = (rollouts[:, PROMPT:] % 2 == 0).mean(axis=1).astype(np.float32)
+        adv = reward - reward.mean()
+        t0 = time.time()
+        batch = {"input_ids": rollouts[:, : PROMPT + NEW],
+                 "rollouts": rollouts[:, : PROMPT + NEW],
+                 "advantage": adv}
+        loss = engine.train_batch(batch)
+        jax.block_until_ready(engine.state.params)
+        t_train = time.time() - t0
+        return t_gen, t_train, float(jnp.asarray(loss))
+
+    # warmup: compiles the serve programs, the reshard, and the train step
+    t0 = time.time()
+    one_iter()
+    warm_s = time.time() - t0
+    gens, trains = [], []
+    t_all = time.time()
+    for _ in range(ITERS):
+        t_gen, t_train, loss = one_iter()
+        gens.append(t_gen)
+        trains.append(t_train)
+    dt = time.time() - t_all
+    stats = engine.hybrid_stats() if hasattr(engine, "hybrid_stats") else {}
+    print(json.dumps({
+        "backend": jax.default_backend(),
+        "model": MODEL, "batch": BATCH, "prompt": PROMPT, "new": NEW,
+        "warmup_s": round(warm_s, 2),
+        "gen_s_per_iter": round(float(np.mean(gens)), 3),
+        "gen_tokens_per_s": round(BATCH * NEW / float(np.mean(gens)), 1),
+        "train_s_per_iter": round(float(np.mean(trains)), 3),
+        "rlhf_iters_per_s": round(ITERS / dt, 4),
+        "hybrid_stats": {k: round(float(v), 4) for k, v in stats.items()},
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
