@@ -267,6 +267,82 @@ def payload_scaling_compile(model="125m", seq=256, mb=1):
                       "per_op": per_op}), flush=True)
 
 
+def payload_pipe_train(steps=2):
+    """Pipeline engine with the PIPE AXIS SPANNING PROCESSES: every
+    activation hop (lax.ppermute) and tied-grad psum crosses the process
+    boundary over gloo — the multi-node pipeline the reference runs over
+    NCCL p2p (pipe/engine.py:795)."""
+    ds = _bootstrap()
+    rank, world = ds.comm.get_rank(), ds.comm.get_world_size()
+    import deepspeed_tpu
+    from deepspeed_tpu.models import get_gpt2_config
+    from deepspeed_tpu.models.gpt2 import gpt2_pipe_layers
+    from deepspeed_tpu.parallel.topology import MeshTopology
+    from deepspeed_tpu.runtime.pipe.module import PipelineModule
+
+    n = jax.device_count()
+    # mesh device order is process-major, so pipe=2 as the OUTER axis puts
+    # stage 0 on process 0 and stage 1 on process 1
+    topo = MeshTopology(pipe=2, fsdp=n // 2, devices=jax.devices())
+    cfg = get_gpt2_config("test", n_layer=2, n_embd=32, n_head=2,
+                          n_positions=32)
+    pipe = PipelineModule(layers=gpt2_pipe_layers(cfg), topology=topo)
+    assert topo.pipe_parallel_size == 2
+    fsdp = n // 2
+    tbs = 4 * fsdp
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=pipe, topology=topo,
+        config={"train_batch_size": tbs, "gradient_accumulation_steps": 4,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                "zero_optimization": {"stage": 1},
+                "gradient_clipping": 1.0, "steps_per_print": 10**9})
+    rng = np.random.default_rng(5)
+    losses = []
+    for step in range(int(steps)):
+        ids = rng.integers(0, cfg.vocab_size, (tbs, 32)).astype(np.int32)
+        # the pipe axis is NOT a batch axis: the batch is replicated across
+        # pipe stages and sharded over each stage's LOCAL fsdp devices, so
+        # every process feeds the FULL global batch (its host-local view of
+        # a pipe-replicated array is the whole thing)
+        loss = engine.train_batch({"input_ids": ids})
+        losses.append(_f32_bits(jax.device_get(loss)))
+    print(json.dumps({"rank": rank, "world": world, "losses": losses}),
+          flush=True)
+
+
+def payload_moe_train(steps=2):
+    """MoE engine with the EXPERT AXIS SPANNING PROCESSES: the dispatch/
+    combine all-to-alls cross the process boundary — the reference's
+    inter-node expert parallelism."""
+    ds = _bootstrap()
+    rank, world = ds.comm.get_rank(), ds.comm.get_world_size()
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+    from deepspeed_tpu.parallel.topology import MeshTopology
+
+    n = jax.device_count()
+    topo = MeshTopology(expert=2, fsdp=n // 2, devices=jax.devices())
+    cfg = get_gpt2_config("test", n_layer=2, n_embd=32, n_head=2,
+                          n_positions=32, moe_num_experts=2, moe_layer_freq=2)
+    tbs = 2 * (n // 2)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(cfg), topology=topo,
+        config={"train_batch_size": tbs,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                "zero_optimization": {"stage": 2},
+                "steps_per_print": 10**9})
+    rng = np.random.default_rng(6)
+    losses = []
+    for step in range(int(steps)):
+        ids = rng.integers(0, cfg.vocab_size, (tbs, 32)).astype(np.int32)
+        local = ids[rank * (tbs // world):(rank + 1) * (tbs // world)] \
+            if world > 1 else ids
+        loss = engine.train_batch({"input_ids": local})
+        losses.append(_f32_bits(jax.device_get(loss)))
+    print(json.dumps({"rank": rank, "world": world, "losses": losses}),
+          flush=True)
+
+
 def payload_data_sampler(total=64, micro=4):
     """Per-process data sharding through the production sampler: each rank's
     index stream must be disjoint and jointly covering."""

@@ -146,6 +146,28 @@ def test_zero_infinity_multihost_default_threshold():
     assert mp[0]["master_elems"] == mp[0]["n_params"]  # all replicated
 
 
+def test_pipeline_spans_processes():
+    """Pipe axis across 2 processes: every ppermute activation hop and
+    tied-grad psum rides gloo. Loss parity vs the same mesh single-process
+    (documented ULP envelope for cross-process reduction order)."""
+    mp = launch_procs("pipe_train", n_procs=2, devices_per_proc=4, steps=2)
+    sp = launch_procs("pipe_train", n_procs=1, devices_per_proc=8, steps=2)
+    assert mp[0]["losses"] == mp[1]["losses"]
+    for a, b in zip(mp[0]["losses"], sp[0]["losses"]):
+        assert _ulp_diff(a, b) <= 8, (a, b)
+    assert all(np.isfinite(_bits_to_f32(h)) for h in mp[0]["losses"])
+
+
+def test_moe_expert_axis_spans_processes():
+    """Expert axis across 2 processes: dispatch/combine all-to-alls cross
+    the process boundary."""
+    mp = launch_procs("moe_train", n_procs=2, devices_per_proc=4, steps=2)
+    sp = launch_procs("moe_train", n_procs=1, devices_per_proc=8, steps=2)
+    assert mp[0]["losses"] == mp[1]["losses"]
+    for a, b in zip(mp[0]["losses"], sp[0]["losses"]):
+        assert _ulp_diff(a, b) <= 8, (a, b)
+
+
 def test_gspmd_strategy_stable_across_process_split(tmp_path):
     """r4 verdict Weak #7: the weak-scaling collective-payload invariants
     were only ever checked single-process. Same 8-device global mesh,
