@@ -343,6 +343,37 @@ def payload_moe_train(steps=2):
           flush=True)
 
 
+def payload_elastic_train(total_steps=4, ckpt=None, losses_path=None,
+                          crash_at=-1):
+    """Elastic-recovery training payload: deterministic per-step data,
+    checkpoint + heartbeat every step, optional injected crash (one rank
+    dying kills the gang — the multi-host failure the agent must convert
+    into a restart at the surviving topology)."""
+    ds = _bootstrap()
+    rank, world = ds.comm.get_rank(), ds.comm.get_world_size()
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.elasticity.elastic_agent import touch_heartbeat
+
+    engine, cfg = _build_engine(ds_overrides={"zero_optimization": {"stage": 1}})
+    engine.initialize_state(_local_batch(cfg, rank, world))
+    engine.load_checkpoint(ckpt)  # no-op on the first launch
+    while engine.global_steps < int(total_steps):
+        step = engine.global_steps
+        loss = float(jnp.asarray(engine.train_batch(
+            _local_batch(cfg, rank, world, step=step))))
+        if rank == 0 and losses_path:
+            with open(losses_path, "a") as f:
+                f.write(json.dumps({"step": step, "world_procs": world,
+                                    "loss": loss}) + "\n")
+        engine.save_checkpoint(ckpt)
+        touch_heartbeat()
+        if rank == max(world - 1, 0) and step + 1 == int(crash_at):
+            os._exit(1)  # one rank dies -> the gang dies
+    print(json.dumps({"rank": rank, "world": world,
+                      "global_steps": engine.global_steps}), flush=True)
+
+
 def payload_data_sampler(total=64, micro=4):
     """Per-process data sharding through the production sampler: each rank's
     index stream must be disjoint and jointly covering."""
