@@ -52,6 +52,21 @@ def test_cfg_dispatch_gpt2_and_bert():
                                         128, causal=False)
 
 
+def test_moe_cfg_counts_active_params_only():
+    from deepspeed_tpu.models import get_gpt2_config
+
+    g = get_gpt2_config("test", moe_num_experts=4, moe_layer_freq=2, moe_k=1)
+    # MoE blocks at i % freq == freq-1 (models/gpt2.py:289)
+    moe_layers = sum(1 for i in range(g.n_layer) if i % 2 == 1)
+    ffn_p = 8 * g.n_embd * g.n_embd + 5 * g.n_embd
+    n_total = 10_000_000
+    n_active = n_total - moe_layers * (4 - 1) * ffn_p
+    got = flops_per_token_from_cfg(n_total, g, 128)
+    assert got == model_flops_per_token(n_active, g.n_layer, g.n_embd, 128,
+                                        causal=True)
+    assert got < flops_per_token_from_cfg(n_total, get_gpt2_config("test"), 128)
+
+
 def test_unknown_cfg_falls_back_to_6n():
     class Odd:
         pass

@@ -28,10 +28,10 @@ def test_perf_ladder_smoke_rungs_fused_and_offload():
         "import sys; sys.path.insert(0, 'tools');"
         "import jax; jax.config.update('jax_platforms', 'cpu');"
         "import perf_ladder; perf_ladder.main()",
-        env_extra={"LADDER": "smoke,smoke_offload,smoke_bert",
+        env_extra={"LADDER": "smoke,smoke_offload,smoke_bert,smoke_moe",
                    "LADDER_FUSED": "2"})
     tags = {l["tag"]: l for l in lines}
-    assert {"smoke", "smoke_offload", "smoke_bert"} <= set(tags), tags
+    assert {"smoke", "smoke_offload", "smoke_bert", "smoke_moe"} <= set(tags), tags
     for tag, row in tags.items():
         assert "error" not in row, row
         assert row["tokens_per_s"] > 0

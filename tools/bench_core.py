@@ -32,10 +32,29 @@ def model_flops_per_token(n_params, n_layers=0, hidden=0, seq=0, causal=True):
     return 6.0 * n_params + attn
 
 
+def active_params_from_cfg(n_params, cfg):
+    """Parameters that compute per token. MoE models route each token
+    through k of E experts, so the (E - k) unused expert FFNs per MoE
+    layer contribute params but no FLOPs — deriving TFLOPS from total
+    params would overstate MoE rungs by the sparsity factor (2.6x at
+    125m-base x 8E)."""
+    n_experts = (getattr(cfg, "moe_num_experts", 0) or 0) if cfg is not None else 0
+    if not n_experts or not hasattr(cfg, "n_layer"):
+        return n_params
+    # MoE blocks sit at i % freq == freq-1 (models/gpt2.py:289);
+    # per-expert GPT-2 FFN = c_fc + c_proj params
+    freq = cfg.moe_layer_freq
+    moe_layers = sum(1 for i in range(cfg.n_layer) if i % freq == freq - 1)
+    ffn_p = 8 * cfg.n_embd * cfg.n_embd + 5 * cfg.n_embd
+    return n_params - moe_layers * (n_experts - cfg.moe_k) * ffn_p
+
+
 def flops_per_token_from_cfg(n_params, cfg, seq):
-    """Pull (layers, hidden, causal) out of a GPT2Config or BertConfig."""
+    """Pull (layers, hidden, causal) out of a GPT2Config or BertConfig;
+    MoE counts active params only (``active_params_from_cfg``)."""
     if hasattr(cfg, "n_layer"):  # GPT-2 family: causal
-        return model_flops_per_token(n_params, cfg.n_layer, cfg.n_embd, seq,
+        return model_flops_per_token(active_params_from_cfg(n_params, cfg),
+                                     cfg.n_layer, cfg.n_embd, seq,
                                      causal=True)
     if hasattr(cfg, "num_hidden_layers"):  # BERT family: bidirectional
         return model_flops_per_token(n_params, cfg.num_hidden_layers,
@@ -132,12 +151,15 @@ def report(tag, mb, seq, n_params, n_steps, seconds, compile_s=None, cfg=None,
     tok = mb * seq * n_steps / seconds
     fpt = (flops_per_token_from_cfg(n_params, cfg, seq) if cfg is not None
            else model_flops_per_token(n_params))
+    n_active = active_params_from_cfg(n_params, cfg)
     tflops = fpt * tok / 1e12
     line = {"tag": tag, "params_m": round(n_params / 1e6, 1), "mb": mb,
             "step_ms": round(seconds / n_steps * 1e3, 1),
             "tokens_per_s": round(tok, 1), "tflops": round(tflops, 2),
             "vs_baseline": round(tflops / BASELINE_TFLOPS, 3),
-            "attn_flops_frac": round(1.0 - 6.0 * n_params / fpt, 3)}
+            "attn_flops_frac": round(1.0 - 6.0 * n_active / fpt, 3)}
+    if n_active != n_params:
+        line["params_active_m"] = round(n_active / 1e6, 1)
     if compile_s is not None:
         line["compile_s"] = round(compile_s, 1)
     line.update(extra)

@@ -27,7 +27,7 @@ SEQ = 1024
 
 
 def run_rung(tag, model_name, mb, offload=False, steps=None, seq=None,
-             fused_xent=False, ds=None):
+             fused_xent=False, ds=None, cfg_overrides=None):
     ds_overrides = dict(ds or {})
     if offload:
         # full ZeRO-Infinity single-chip recipe: params rest pinned-host and
@@ -50,6 +50,7 @@ def run_rung(tag, model_name, mb, offload=False, steps=None, seq=None,
         overrides = {"vocab_size": 50304, "embed_onehot_grad": True}
         if fused_xent:
             overrides["fused_head_loss_chunk"] = 1024
+    overrides.update(cfg_overrides or {})  # rung-specific model-config knobs (MoE, ...)
     engine, batch, n_params, cfg = build_engine(
         model_name, mb, seq or SEQ, ds_overrides=ds_overrides, **overrides)
     if offload:
@@ -68,6 +69,9 @@ RUNGS = {
     "smoke": dict(model_name="test", mb=2, seq=64),
     "smoke_offload": dict(model_name="test", mb=2, seq=64, offload=True, steps=2),
     "smoke_bert": dict(model_name="bert_test", mb=2, seq=64),
+    "smoke_moe": dict(model_name="test", mb=2, seq=64,
+                      cfg_overrides=dict(moe_num_experts=2, moe_layer_freq=2,
+                                         moe_k=1)),
     "760m_mb4": dict(model_name="760m", mb=4),
     "760m_mb8": dict(model_name="760m", mb=8),
     # plain 760m_mb8 OOMs by 2.6G; the chunked fused head removes the
@@ -80,6 +84,14 @@ RUNGS = {
                              fused_xent=True),
     "xl_offload_mb1": dict(model_name="xl", mb=1, offload=True, steps=2),
     "xl_offload_mb4": dict(model_name="xl", mb=4, offload=True, steps=2),
+    # single-chip GPT-MoE rung + its dense base A/B (measured r5 on chip:
+    # 2.6x params at 1.30x step cost; larger MoE geometries OOM one chip
+    # dense — EP weak-scaling evidence covers those). TFLOPS uses active
+    # params (flops_per_token_from_cfg MoE accounting).
+    "125m_mb8": dict(model_name="125m", mb=8, fused_xent=True),
+    "125m_moe8_mb8": dict(model_name="125m", mb=8, fused_xent=True,
+                          cfg_overrides=dict(moe_num_experts=8,
+                                             moe_layer_freq=2, moe_k=1)),
     # long-context rungs: the gridded flash kernel streams K/V blocks, so
     # VMEM no longer caps sequence length; fused xent keeps the logits
     # buffers off the OOM line at long L
