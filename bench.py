@@ -62,7 +62,7 @@ def run_child():
     # OOMs) — r3 sweep, tools/perf_sweep2.py
     micro_bs = int(os.environ.get("BENCH_MICRO_BS", "8"))
     seq = int(os.environ.get("BENCH_SEQ", "1024"))
-    steps = int(os.environ.get("BENCH_STEPS", "30"))
+    steps = int(os.environ.get("BENCH_STEPS", "60"))
     # remat measured slightly faster at this size on v5e (415.7 vs 425.3 ms
     # per step, r3 sweep) — the step is memory-bound, so trading HBM traffic
     # for recompute wins
@@ -123,7 +123,12 @@ def run_child():
     # one jit call) — amortizes host→device dispatch latency, the idiomatic
     # TPU training-loop shape. Falls back to the per-dispatch loop if the
     # scanned program fails to build (keeps the driver's bench robust).
-    fused = int(os.environ.get("BENCH_FUSED_STEPS", "10"))
+    # Depth 30: the tunnel pays ~200ms RTT per dispatch, so depth-10
+    # inflated the measured step by ~21ms (225.7 -> 212.3 ms at depth 30;
+    # PERF.md round-5 ladder erratum has the same decomposition for the
+    # BERT rungs).
+    fused = int(os.environ.get("BENCH_FUSED_STEPS", "30"))
+    fused = max(1, min(fused, steps))  # BENCH_STEPS=10 means 10 steps, not 30
     if fused > 1:
         try:
             stack = {"input_ids": np.broadcast_to(batch["input_ids"],
@@ -256,8 +261,9 @@ def _last_json_line(text):
 
 
 def main():
-    # run budget sized for a COLD compile cache: the fused-10-step 350M
-    # program can take >8 min to compile on the tunnel, and killing the
+    # run budget sized for a COLD compile cache: the fused-scan 350M
+    # program (depth 30; scan length doesn't change program size) can take
+    # >8 min to compile on the tunnel, and killing the
     # claim-holding child mid-compile wedges the tunnel for hours (wedge #4,
     # PERF.md). The repo-local .jax_cache (survives reboots, unlike /tmp)
     # makes warm runs finish in ~2-3 min.
@@ -319,7 +325,7 @@ def main():
     env["BENCH_SEQ"] = os.environ.get("BENCH_CPU_SEQ", "256")
     env["BENCH_STEPS"] = os.environ.get("BENCH_CPU_STEPS", "3")
     env["BENCH_ATTN"] = "xla"
-    env["BENCH_FUSED_STEPS"] = "1"  # a 10-step scan would blow the CPU budget
+    env["BENCH_FUSED_STEPS"] = "1"  # a deep scan would blow the CPU budget
     rc, out, err = _run("child", env, cpu_timeout)
     result = _last_json_line(out)
     if rc == 0 and result is not None:
