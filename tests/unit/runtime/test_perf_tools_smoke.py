@@ -39,14 +39,17 @@ def test_perf_ladder_smoke_rungs_fused_and_offload():
     assert "compile_s" in tags["smoke"]  # fused path reports compile time
 
 
-def test_tune_bench_runs_end_to_end():
+def test_tune_bench_runs_end_to_end(tmp_path):
     lines = _run_cpu(
         "import sys; sys.path.insert(0, 'tools');"
         "import jax; jax.config.update('jax_platforms', 'cpu');"
         "import tune_bench; tune_bench.main()",
         env_extra={"TUNE_MODEL": "test", "TUNE_SEQ": "64",
                    "TUNE_MAX_MBS": "2", "TUNE_STAGES": "0",
-                   "TUNE_STEPS": "2"})
+                   "TUNE_STEPS": "2",
+                   # keep the committed chip-measured artifacts out of reach
+                   "TUNE_RESULTS_DIR": str(tmp_path / "results"),
+                   "TUNE_EXPS_DIR": str(tmp_path / "exps")})
     row = lines[-1]
     assert row["winner"] is not None
     assert row["winner_measured_step_ms"] and row["winner_measured_step_ms"] > 0

@@ -55,7 +55,14 @@ def main():
         "autotuning": {"enabled": True, "measure": True, "top_k": 3,
                        "zero_stages": STAGES,
                        "start_profile_step": 1, "end_profile_step": 1 + STEPS,
-                       "max_train_micro_batch_size_per_gpu": MAX_MBS},
+                       "max_train_micro_batch_size_per_gpu": MAX_MBS,
+                       # default repo-relative dirs are the committed chip
+                       # evidence — CI smoke runs redirect to a tmp dir so
+                       # they never churn the banked artifacts
+                       "results_dir": os.environ.get(
+                           "TUNE_RESULTS_DIR", "autotuning_results"),
+                       "exps_dir": os.environ.get(
+                           "TUNE_EXPS_DIR", "autotuning_exps")},
     }
     rng = np.random.default_rng(0)
     example = {"input_ids": rng.integers(0, cfg.vocab_size,
