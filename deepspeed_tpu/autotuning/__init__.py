@@ -1,7 +1,10 @@
 """Autotuning: compile-and-cost candidate DeepSpeed configs on the live
 mesh and pick the fastest runnable one (reference ``deepspeed/autotuning``)."""
 
+from deepspeed_tpu.autotuning.attention_tuner import (AttentionBlockTuner,
+                                                      tune_attention_blocks)
 from deepspeed_tpu.autotuning.autotuner import Autotuner, Experiment
 from deepspeed_tpu.autotuning.config import DeepSpeedAutotuningConfig, get_autotuning_config
 
-__all__ = ["Autotuner", "Experiment", "DeepSpeedAutotuningConfig", "get_autotuning_config"]
+__all__ = ["Autotuner", "Experiment", "DeepSpeedAutotuningConfig", "get_autotuning_config",
+           "AttentionBlockTuner", "tune_attention_blocks"]

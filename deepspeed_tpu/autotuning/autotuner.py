@@ -232,6 +232,8 @@ class Autotuner:
                                 - ma.alias_size_in_bytes + ma.temp_size_in_bytes)
             exp.arg_bytes = int(ma.argument_size_in_bytes)
         ca = compiled.cost_analysis()
+        if isinstance(ca, list):  # jax 0.4.x: one dict per device program
+            ca = ca[0] if ca else None
         if ca:
             exp.flops = float(ca.get("flops", 0.0))
             exp.bytes_accessed = float(ca.get("bytes accessed", 0.0))

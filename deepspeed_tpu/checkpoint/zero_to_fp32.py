@@ -46,8 +46,17 @@ def _restore_numpy(checkpoint_dir: str, tag: Optional[str] = None,
     if not params_only:
         return ocp.StandardCheckpointer().restore(path)
     import jax
-    meta = dict(ocp.StandardCheckpointer().metadata(path).item_metadata)
-    item = {k: jax.tree.map(lambda m: ocp.PLACEHOLDER, v) for k, v in meta.items()}
+    raw_meta = ocp.StandardCheckpointer().metadata(path)
+    # orbax >= 0.10 wraps the tree in .item_metadata; 0.7 returns the
+    # tree-shaped dict directly
+    meta = dict(getattr(raw_meta, "item_metadata", raw_meta))
+    placeholder = getattr(ocp, "PLACEHOLDER", None)
+    if placeholder is None:
+        # old orbax has no partial-restore placeholder: restore everything
+        # and keep only params (costs moment bytes transiently)
+        out = ocp.StandardCheckpointer().restore(path)
+        return {"params": jax.tree.map(np.asarray, dict(out)["params"])}
+    item = {k: jax.tree.map(lambda m: placeholder, v) for k, v in meta.items()}
     item["params"] = jax.tree.map(lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype),
                                   meta["params"])
     out = ocp.PyTreeCheckpointer().restore(path, ocp.args.PyTreeRestore(item=item))

@@ -221,13 +221,10 @@ def init_compression(model_or_engine, deepspeed_config=None, teacher_model=None,
         if teacher_model is not None and needs_teacher:
             t_module, t_params = _resolve_teacher(teacher_model, engine)
             if cfg[LR].get("enabled", False):
+                # the engine's consumer owns the apply (owned buffers via
+                # utils/device.py in one place)
                 engine._pending_student_init = (t_params, raw)
-                if engine.state is not None:
-                    new = student_initialization(
-                        jax.device_get(engine.state.params), jax.device_get(t_params), raw)
-                    engine.state = engine.state._replace(
-                        params=jax.device_put(new, engine.state_shardings.params))
-                    engine._pending_student_init = None
+                engine._maybe_apply_student_init()
             if cfg[KNOWLEDGE_DISTILLATION]["enabled"]:
                 t_placed = _place_teacher(t_module, t_params, engine)
                 engine._kd_config = dict(cfg[KNOWLEDGE_DISTILLATION],
@@ -269,7 +266,10 @@ def _place_teacher(t_module, t_params, engine):
         # inheriting a stage-0/1/2 plan that would leave it replicated
         zc = engine.config.zero_config.model_copy(update={"stage": 3})
         plan = build_plan(aboxed["params"], zc, engine.topology)
-        placed = jax.device_put(t_params, plan.param_shardings())
+        # owned copy: teacher host buffers feed the captured KD step
+        # (utils/device.py zero-copy + donation hazard)
+        from deepspeed_tpu.utils.device import owned_device_put
+        placed = owned_device_put(t_params, plan.param_shardings())
         log_dist("KD teacher placed fsdp-sharded over the mesh (stage-3 carve)")
         return placed
     except Exception as e:  # noqa: BLE001 — placement is an optimization

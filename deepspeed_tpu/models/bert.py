@@ -8,13 +8,14 @@ Post-LN encoder (original BERT), logical sharding names as in gpt2.py.
 """
 
 import dataclasses
-from typing import Any
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.models.common import config_from, dense_init as _init, normalize_padding_mask
+from deepspeed_tpu.models.common import (attention_geometry_kwargs, config_from,
+                                         dense_init as _init, normalize_padding_mask)
 from deepspeed_tpu.ops.transformer.attention import dot_product_attention
 
 
@@ -38,6 +39,10 @@ class BertConfig:
     param_dtype: Any = jnp.float32
     remat: bool = False
     attention_backend: str = "xla"
+    # flash-backend block geometry / bwd policy override, as a spec string
+    # (models/common.py attention_geometry_kwargs); None = resolve via
+    # env/config/autotune layers
+    attention_blocks: Optional[str] = None
     # progressive layer drop (arXiv:2010.13369 targets BERT; reference
     # ``runtime/progressive_layer_drop.py``): stochastically skip sublayers
     # at train time with depth-scaled keep probability when the engine
@@ -128,11 +133,13 @@ class BertSelfAttention(nn.Module):
             # in pre-broadcast [B,1,1,L]) to take the exact mask= path.
             out = dot_product_attention(q, k, v, backend=cfg.attention_backend,
                                         causal=False,
-                                        kv_lengths=attention_mask.sum(axis=-1).astype(jnp.int32))
+                                        kv_lengths=attention_mask.sum(axis=-1).astype(jnp.int32),
+                                        **attention_geometry_kwargs(cfg))
         else:
             mask = normalize_padding_mask(attention_mask)
             out = dot_product_attention(q, k, v, backend=cfg.attention_backend,
-                                        causal=False, mask=mask)
+                                        causal=False, mask=mask,
+                                        **attention_geometry_kwargs(cfg))
         out = nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype,
                               param_dtype=cfg.param_dtype,
                               kernel_init=nn.with_logical_partitioning(_init(), ("heads", "kv", "embed")),

@@ -260,6 +260,25 @@ def config_from(table: dict, cls, name: str, **overrides):
     return cls(**base)
 
 
+def attention_geometry_kwargs(cfg):
+    """Per-model flash-attention geometry overrides, zoo-shared.
+
+    ``cfg.attention_blocks`` is a spec string (the grammar of
+    ``ops/pallas/attention_geometry.parse_spec``, e.g.
+    ``"block_q=256,block_k=512,policy=recompute"`` — a string so frozen
+    model configs stay hashable). Returns ``dot_product_attention`` kwargs
+    for the flash backend, ``{}`` otherwise: the XLA/ring backends have no
+    block geometry and must not receive the kwargs. Passed as
+    ``geometry_spec`` (not direct block kwargs) so the pinned blocks CLAMP
+    to each call shape's divisors instead of knocking untileable shapes
+    off the kernel; unset fields still resolve through the engine config /
+    env / autotune-cache layers inside the kernel."""
+    spec = getattr(cfg, "attention_blocks", None)
+    if not spec or getattr(cfg, "attention_backend", "xla") != "flash":
+        return {}
+    return {"geometry_spec": spec}
+
+
 def normalize_padding_mask(attention_mask, ndim_target: int = 4):
     """[B, L] 0/1 padding mask → [B, 1, 1, L] boolean; pass through masks
     that already have a broadcastable rank."""

@@ -46,6 +46,10 @@ class GPT2Config:
     remat_policy: Optional[str] = None
     remat_every: int = 1
     attention_backend: str = "xla"
+    # flash-backend block geometry / bwd policy override, as a spec string
+    # ("block_q=256,block_k=512,policy=recompute", see models/common.py
+    # attention_geometry_kwargs); None = resolve via env/config/autotune
+    attention_blocks: Optional[str] = None
     # backward of the token-embedding gather as a one-hot matmul instead of
     # a scatter-add. Default ON: scatter serializes on TPU (measured +10%
     # with the matmul form, PERF.md r3 session 3) AND the scatter-add's
@@ -139,6 +143,7 @@ class SelfAttention(nn.Module):
             # validity mask from it
             decode_lengths = jnp.broadcast_to(idx + l, (b,))
             causal = False
+        from deepspeed_tpu.models.common import attention_geometry_kwargs
         attn_out = dot_product_attention(q,
                                          k,
                                          v,
@@ -146,7 +151,8 @@ class SelfAttention(nn.Module):
                                          causal=causal,
                                          decode_lengths=decode_lengths,
                                          dropout_rate=0.0 if deterministic else cfg.dropout,
-                                         dropout_rng=dropout_rng)
+                                         dropout_rng=dropout_rng,
+                                         **attention_geometry_kwargs(cfg))
         out = nn.DenseGeneral(features=cfg.n_embd,
                               axis=(-2, -1),
                               dtype=cfg.dtype,

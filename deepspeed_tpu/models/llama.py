@@ -45,6 +45,10 @@ class LlamaConfig:
     remat_policy: Optional[str] = None
     remat_every: int = 1
     attention_backend: str = "xla"
+    # flash-backend block geometry / bwd policy override, as a spec string
+    # (models/common.py attention_geometry_kwargs); None = resolve via
+    # env/config/autotune layers
+    attention_blocks: Optional[str] = None
     attention_bias: bool = False  # Qwen2-style biased q/k/v projections
     # Mistral-style sliding-window attention: each token attends the last
     # ``sliding_window`` positions. Training/prefill only — the flash
@@ -206,9 +210,11 @@ class LlamaAttention(nn.Module):
             # silently ignoring the window would change the model's math
             raise ValueError(f"sliding_window is supported by the flash/xla attention "
                              f"backends, not {cfg.attention_backend!r}")
+        from deepspeed_tpu.models.common import attention_geometry_kwargs
         out = dot_product_attention(q, k, v, backend=cfg.attention_backend, causal=causal,
                                     mask=mask, decode_lengths=decode_lengths,
-                                    window=cfg.sliding_window if not decode else None)
+                                    window=cfg.sliding_window if not decode else None,
+                                    **attention_geometry_kwargs(cfg))
         return nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1), use_bias=False,
                                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                                kernel_init=nn.with_logical_partitioning(_init(), ("heads", "kv", "embed")),

@@ -21,6 +21,18 @@ single-chip ceiling is HBM, not VMEM (VERDICT r2 weak #5: the previous
 design staged full-length K/V per cell, capping L at ~24k). Causally dead
 K blocks skip their FLOPs via ``pl.when``. Longer-than-HBM contexts remain
 the job of sequence parallelism (``deepspeed_tpu.parallel.ring_attention``).
+
+Work partitioning is TUNABLE (``attention_geometry``): forward and backward
+block sizes are independent (FlashAttention-2's dq/dkv passes prefer
+different tilings than the forward), the backward's causal work-skipping
+is a policy (``bwd_skip``: "block" gates dead grid steps behind ``pl.when``
++ index-map clamps; "none" runs every step and masks — less scalar
+overhead, sometimes faster at short L), and the backward can either read
+the stashed log-sum-exp residual (``policy="lse"``) or recompute it with an
+extra forward pass (``policy="recompute"`` — drops the [B,H,L] residual per
+layer between fwd and bwd, which matters under remat at long L). Unset
+knobs resolve through env/config/autotune-cache/shape defaults
+(``attention_geometry.resolve_geometry``).
 """
 
 import functools
@@ -31,6 +43,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from deepspeed_tpu.ops.pallas.attention_geometry import (AttentionGeometry,
+                                                         parse_spec,
+                                                         pick_block,
+                                                         resolve_geometry)
 from deepspeed_tpu.ops.transformer.attention import register_backend
 
 NEG_INF = float(jnp.finfo(jnp.float32).min)
@@ -79,13 +95,6 @@ def _last_q_block(ki, blk_q, blk_k, off, window):
 def _n_live_blocks(kv_len, blk_k):
     """K blocks intersecting the valid prefix (>=1 so state initializes)."""
     return jnp.maximum((kv_len + blk_k - 1) // blk_k, 1)
-
-
-def _pick_block(length: int, preferred: int = 512) -> int:
-    for blk in (preferred, 256, 128, 64, 32, 16, 8):
-        if blk <= length and length % blk == 0:
-            return blk
-    return length
 
 
 def _interpret_default() -> bool:
@@ -269,7 +278,7 @@ def _flash_fwd(q, k, v, scale, causal, blk_q, blk_k, interpret, kv_lengths=None,
 # ---------------------------------------------------------------------------
 # backward
 # ---------------------------------------------------------------------------
-def _bwd_dq_kernel(*refs, scale, causal, blk_q, blk_k, nq, nk, masked, window):
+def _bwd_dq_kernel(*refs, scale, causal, blk_q, blk_k, nq, nk, masked, window, skip):
     if masked:
         lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref = refs
         kv_len = lens_ref[pl.program_id(0)]
@@ -283,14 +292,14 @@ def _bwd_dq_kernel(*refs, scale, causal, blk_q, blk_k, nq, nk, masked, window):
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    nk_eff = _last_k_block(qi, blk_q, blk_k, off, nk) if causal else nk
-    if masked:
-        nk_eff = jnp.minimum(nk_eff, _n_live_blocks(kv_len, blk_k))
-    live = j < nk_eff
-    if window is not None:
-        live = live & (j >= _first_k_block(qi, blk_q, blk_k, off, window))
+    if skip:
+        nk_eff = _last_k_block(qi, blk_q, blk_k, off, nk) if causal else nk
+        if masked:
+            nk_eff = jnp.minimum(nk_eff, _n_live_blocks(kv_len, blk_k))
+        live = j < nk_eff
+        if window is not None:
+            live = live & (j >= _first_k_block(qi, blk_q, blk_k, off, window))
 
-    @pl.when(live)
     def _block():
         q = q_ref[...].astype(jnp.float32) * scale
         do = do_ref[...].astype(jnp.float32)
@@ -311,12 +320,19 @@ def _bwd_dq_kernel(*refs, scale, causal, blk_q, blk_k, nq, nk, masked, window):
         acc_ref[...] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                             preferred_element_type=jnp.float32)
 
+    if skip:
+        pl.when(live)(_block)
+    else:
+        # bwd_skip="none": every step computes unpredicated; the score masks
+        # above zero dead contributions (p = exp(NEG_INF - finite lse) = 0)
+        _block()
+
     @pl.when(j == nk - 1)
     def _finalize():
         dq_ref[...] = (acc_ref[...] * scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(*refs, scale, causal, blk_q, blk_k, nq, nk, masked, window):
+def _bwd_dkv_kernel(*refs, scale, causal, blk_q, blk_k, nq, nk, masked, window, skip):
     if masked:
         (lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          dk_ref, dv_ref, dk_acc, dv_acc) = refs
@@ -333,21 +349,21 @@ def _bwd_dkv_kernel(*refs, scale, causal, blk_q, blk_k, nq, nk, masked, window):
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    if causal:
-        # first q block whose causal window reaches this k block
-        first = jnp.maximum((ki * blk_k - off) // blk_q, 0)
-    else:
-        first = 0
+    if skip:
+        if causal:
+            # first q block whose causal window reaches this k block
+            first = jnp.maximum((ki * blk_k - off) // blk_q, 0)
+        else:
+            first = 0
 
-    live = (i >= first)
-    if masked:
-        # K blocks entirely beyond the valid prefix contribute nothing —
-        # skip all their FLOPs (their dk/dv stay at the zero-initialized acc)
-        live = live & (ki * blk_k < kv_len)
-    if window is not None:
-        live = live & (i <= _last_q_block(ki, blk_q, blk_k, off, window))
+        live = (i >= first)
+        if masked:
+            # K blocks entirely beyond the valid prefix contribute nothing —
+            # skip all their FLOPs (their dk/dv stay at the zero-initialized acc)
+            live = live & (ki * blk_k < kv_len)
+        if window is not None:
+            live = live & (i <= _last_q_block(ki, blk_q, blk_k, off, window))
 
-    @pl.when(live)
     def _block():
         k = k_ref[...].astype(jnp.float32)
         v = v_ref[...].astype(jnp.float32)
@@ -370,13 +386,25 @@ def _bwd_dkv_kernel(*refs, scale, causal, blk_q, blk_k, nq, nk, masked, window):
         dk_acc[...] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                            preferred_element_type=jnp.float32)
 
+    if skip:
+        pl.when(live)(_block)
+    else:
+        # bwd_skip="none": unpredicated — masking alone zeroes dead
+        # contributions (fully-masked rows carry a finite large-negative
+        # lse, so exp(s - lse) is exactly 0, never NaN)
+        _block()
+
     @pl.when(i == nq - 1)
     def _finalize():
         dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd(res, g, scale, causal, blk_q, blk_k, interpret, window=None):
+def _flash_bwd(res, g, scale, causal, blk_q, blk_k, interpret, window=None,
+               skip=True):
+    # blk_q/blk_k here are the BACKWARD blocks (may differ from forward);
+    # skip=False (bwd_skip="none") drops the liveness predicates AND the
+    # DMA-eliding index-map clamps — every grid step fetches and computes.
     q, k, v, o, lse, kv_lengths = res
     b, h, lq, d = q.shape
     lk = k.shape[2]
@@ -390,7 +418,10 @@ def _flash_bwd(res, g, scale, causal, blk_q, blk_k, interpret, window=None):
     delta4 = delta.reshape(b, h, 1, lq)
 
     off = lk - lq
-    kv_idx = _kv_index_map(causal, blk_q, blk_k, off, nk, masked, window)
+    if skip:
+        kv_idx = _kv_index_map(causal, blk_q, blk_k, off, nk, masked, window)
+    else:
+        kv_idx = _pad_idx(lambda bi, hi, qi, j: (bi, hi, j, 0), masked)
     qo_idx = _pad_idx(lambda bi, hi, qi, j: (bi, hi, qi, 0), masked)
     stat_q_idx = _pad_idx(lambda bi, hi, qi, j: (bi, hi, 0, qi), masked)
 
@@ -400,7 +431,8 @@ def _flash_bwd(res, g, scale, causal, blk_q, blk_k, interpret, window=None):
 
     dq = _call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, blk_q=blk_q,
-                          blk_k=blk_k, nq=nq, nk=nk, masked=masked, window=window),
+                          blk_k=blk_k, nq=nq, nk=nk, masked=masked, window=window,
+                          skip=skip),
         (b, h, nq, nk),
         [
             pl.BlockSpec((None, None, blk_q, d), qo_idx),
@@ -444,10 +476,16 @@ def _flash_bwd(res, g, scale, causal, blk_q, blk_k, interpret, window=None):
                   if masked else ki)
         return (bi, hi, ki_eff, 0)
 
+    if not skip:
+        q_idx = _pad_idx(lambda bi, hi, ki, i: (bi, hi, i, 0), masked)
+        stat_idx = _pad_idx(lambda bi, hi, ki, i: (bi, hi, 0, i), masked)
+        kv_in_idx = _pad_idx(lambda bi, hi, ki, i: (bi, hi, ki, 0), masked)
+
     kv_out_idx = _pad_idx(lambda bi, hi, ki, i: (bi, hi, ki, 0), masked)
     dk, dv = _call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, blk_q=blk_q,
-                          blk_k=blk_k, nq=nq, nk=nk, masked=masked, window=window),
+                          blk_k=blk_k, nq=nq, nk=nk, masked=masked, window=window,
+                          skip=skip),
         (b, h, nk, nq),
         [
             pl.BlockSpec((None, None, blk_q, d), q_idx),
@@ -474,8 +512,9 @@ def _flash_bwd(res, g, scale, causal, blk_q, blk_k, interpret, window=None):
 # ---------------------------------------------------------------------------
 # public op (BHLD), custom VJP
 # ---------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
-def _flash_attention_bhld(q, k, v, kv_lengths, scale, causal, blk_q, blk_k, interpret,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11, 12, 13))
+def _flash_attention_bhld(q, k, v, kv_lengths, scale, causal, blk_q, blk_k,
+                          blk_q_bwd, blk_k_bwd, bwd_skip, policy, interpret,
                           window):
     o, _ = _flash_fwd(q, k, v, scale, causal, blk_q, blk_k, interpret,
                       kv_lengths=kv_lengths, window=window)
@@ -483,14 +522,25 @@ def _flash_attention_bhld(q, k, v, kv_lengths, scale, causal, blk_q, blk_k, inte
 
 
 def _flash_attention_bhld_fwd(q, k, v, kv_lengths, scale, causal, blk_q, blk_k,
-                              interpret, window):
+                              blk_q_bwd, blk_k_bwd, bwd_skip, policy, interpret,
+                              window):
     o, lse = _flash_fwd(q, k, v, scale, causal, blk_q, blk_k, interpret,
                         kv_lengths=kv_lengths, window=window)
-    return o, (q, k, v, o, lse, kv_lengths)
+    # policy="recompute": don't stash the [B,H,L] log-sum-exp — the backward
+    # regenerates it with one extra forward pass. Saves the residual HBM
+    # held per layer between forward and backward (remat-style tradeoff).
+    return o, (q, k, v, o, lse if policy != "recompute" else None, kv_lengths)
 
 
-def _flash_attention_bhld_bwd(scale, causal, blk_q, blk_k, interpret, window, res, g):
-    return _flash_bwd(res, g, scale, causal, blk_q, blk_k, interpret, window=window)
+def _flash_attention_bhld_bwd(scale, causal, blk_q, blk_k, blk_q_bwd, blk_k_bwd,
+                              bwd_skip, policy, interpret, window, res, g):
+    q, k, v, o, lse, kv_lengths = res
+    if lse is None:  # recompute policy: regenerate lse at the forward blocks
+        _, lse = _flash_fwd(q, k, v, scale, causal, blk_q, blk_k, interpret,
+                            kv_lengths=kv_lengths, window=window)
+    return _flash_bwd((q, k, v, o, lse, kv_lengths), g, scale, causal,
+                      blk_q_bwd, blk_k_bwd, interpret, window=window,
+                      skip=(bwd_skip != "none"))
 
 
 _flash_attention_bhld.defvjp(_flash_attention_bhld_fwd, _flash_attention_bhld_bwd)
@@ -568,7 +618,7 @@ def flash_decode(q: jax.Array,
         scale = d**-0.5
     if interpret is None:
         interpret = _interpret_default()
-    blk_k = block_k or _pick_block(lk)
+    blk_k = block_k or pick_block(lk)
     if lk % blk_k:
         raise ValueError(f"KV cache length {lk} not divisible by block {blk_k}")
     nk = lk // blk_k
@@ -624,6 +674,11 @@ def flash_attention(q: jax.Array,
                     window: Optional[int] = None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
+                    block_q_bwd: Optional[int] = None,
+                    block_k_bwd: Optional[int] = None,
+                    bwd_skip: Optional[str] = None,
+                    policy: Optional[str] = None,
+                    geometry_spec: Optional[str] = None,
                     interpret: Optional[bool] = None) -> jax.Array:
     """Flash attention over BLHD tensors; falls back to the XLA backend for
     features the kernel doesn't cover (bias/arbitrary mask/dropout).
@@ -637,7 +692,22 @@ def flash_attention(q: jax.Array,
     ``window``: sliding-window size (Mistral semantics, requires
     ``causal=True``) — each query attends keys in ``(pos-window, pos]``;
     out-of-window blocks skip their FLOPs and DMA in both passes, so the
-    cost is O(L*window) instead of O(L^2)."""
+    cost is O(L*window) instead of O(L^2).
+
+    Block geometry + backward policy (``block_q``/``block_k`` forward,
+    ``block_q_bwd``/``block_k_bwd`` backward, ``bwd_skip`` in
+    {"block", "none"}, ``policy`` in {"lse", "recompute"}): any knob left
+    None resolves through the layered geometry engine — ``DS_ATTN_BLOCKS``
+    env override, the engine config's ``"attention"`` block, the
+    autotuner's shape-keyed winners cache, then v5e shape defaults
+    (``attention_geometry.resolve_geometry``).
+
+    Direct block kwargs that don't tile the call warn and fall back to
+    XLA (the historical contract). ``geometry_spec`` — a spec string, the
+    vehicle for per-model ``attention_blocks`` config pins — instead joins
+    the resolution as a highest-precedence layer whose blocks are CLAMPED
+    to divisors like every other layer, so a pin tuned at one shape can
+    never knock another shape off the kernel."""
     b, lq, h, d = q.shape
     lk = k.shape[1]
     if decode_lengths is not None and kv_lengths is not None:
@@ -650,7 +720,7 @@ def flash_attention(q: jax.Array,
                          "attends the whole cache")
     if decode_lengths is not None:
         # KV-cache decode: per-sequence length masking in the kernel
-        if bias is None and mask is None and dropout_rate == 0.0 and lk % (block_k or _pick_block(lk)) == 0:
+        if bias is None and mask is None and dropout_rate == 0.0 and lk % (block_k or pick_block(lk)) == 0:
             return flash_decode(q, k, v, decode_lengths, scale=scale,
                                 block_k=block_k, interpret=interpret)
         _warn_fallback("decode with bias/mask/dropout or untileable cache")
@@ -669,17 +739,30 @@ def flash_attention(q: jax.Array,
         scale = d**-0.5
     if interpret is None:
         interpret = _interpret_default()
-    blk_q = block_q or _pick_block(lq)
-    blk_k = block_k or _pick_block(lk)
-    if lq % blk_q or lk % blk_k:
-        _warn_fallback(f"sequence lengths ({lq}, {lk}) not tileable")
+    # explicit block kwargs keep the historical contract: a size that does
+    # not tile the call warns and falls back to XLA (lower-precedence
+    # layers are instead clamped to divisors inside resolve_geometry)
+    if (block_q and lq % block_q) or (block_k and lk % block_k) \
+            or (block_q_bwd and lq % block_q_bwd) or (block_k_bwd and lk % block_k_bwd):
+        _warn_fallback(f"sequence lengths ({lq}, {lk}) not tileable by "
+                       f"explicit blocks")
         from deepspeed_tpu.ops.transformer.attention import xla_attention
         return xla_attention(q, k, v, causal=causal, scale=scale,
                              kv_lengths=kv_lengths, window=window)
+    overrides = AttentionGeometry(block_q=block_q, block_k=block_k,
+                                  block_q_bwd=block_q_bwd,
+                                  block_k_bwd=block_k_bwd,
+                                  bwd_skip=bwd_skip, policy=policy)
+    if geometry_spec:
+        overrides = overrides.merged_over(parse_spec(geometry_spec))
+    geom, _ = resolve_geometry(lq, lk, d, h, b, bool(causal), q.dtype,
+                               overrides=overrides)
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
     o = _flash_attention_bhld(qt, kt, vt, kv_lengths, float(scale), bool(causal),
-                              blk_q, blk_k, interpret,
+                              geom.block_q, geom.block_k,
+                              geom.block_q_bwd, geom.block_k_bwd,
+                              geom.bwd_skip, geom.policy, interpret,
                               int(window) if window is not None else None)
     return o.transpose(0, 2, 1, 3)

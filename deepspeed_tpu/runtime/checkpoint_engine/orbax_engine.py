@@ -59,6 +59,11 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         abstract = jax.tree.map(
             lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s), state, shardings)
         restored = self._ckptr.restore(os.path.join(path, "state"), abstract)
+        # the restored state flows into the DONATED train step: re-own the
+        # buffers (tensorstore views are not jax-owned; donating them
+        # corrupts the heap on CPU jaxlib 0.4.x — utils/device.py)
+        from deepspeed_tpu.utils.device import owned_device_put
+        restored = owned_device_put(restored, shardings)
         if load_module_only or not load_optimizer_states:
             # keep current optimizer state / counters, take params only
             restored = state._replace(params=restored.params) if load_module_only else \

@@ -67,6 +67,31 @@ class ActivationCheckpointingConfig(DeepSpeedConfigModel):
     profile: bool = False
 
 
+class AttentionConfig(DeepSpeedConfigModel):
+    """Flash-attention work-partitioning block (TPU-native; no reference
+    analog — the reference's CUDA kernels hard-code their tiling).
+
+    Every field is optional: unset knobs resolve through the geometry
+    engine's remaining layers (``DS_ATTN_BLOCKS`` env override, the
+    autotuner's shape-keyed winners cache, v5e shape defaults) — see
+    ``ops/pallas/attention_geometry.py``. ``cache_file`` repoints the
+    winners cache (default ``autotuning_results/attention_blocks.json``,
+    also via ``DS_ATTN_CACHE``)."""
+    block_q: Optional[int] = Field(None, ge=8)
+    block_k: Optional[int] = Field(None, ge=8)
+    block_q_bwd: Optional[int] = Field(None, ge=8)
+    block_k_bwd: Optional[int] = Field(None, ge=8)
+    bwd_skip: Optional[str] = None      # "block" | "none"
+    policy: Optional[str] = None        # "lse" | "recompute"
+    cache_file: Optional[str] = None
+
+    def geometry_fields(self) -> dict:
+        return {k: v for k, v in dict(
+            block_q=self.block_q, block_k=self.block_k,
+            block_q_bwd=self.block_q_bwd, block_k_bwd=self.block_k_bwd,
+            bwd_skip=self.bwd_skip, policy=self.policy).items() if v is not None}
+
+
 class MeshConfig(DeepSpeedConfigModel):
     """TPU-native parallel-topology block (replaces mpu/world-size plumbing).
 
@@ -202,6 +227,7 @@ class DeepSpeedConfig:
         self.flops_profiler_config = get_flops_profiler_config(param_dict)
         self.trace_profiler_config = get_trace_profiler_config(param_dict)
         self.comms_config = DeepSpeedCommsConfig(param_dict)
+        self.attention_config = AttentionConfig(**param_dict.get(C.ATTENTION, {}))
         self.checkpoint_config = CheckpointConfig(**param_dict.get(C.CHECKPOINT, {}))
         self.nebula_config = NebulaConfig(**param_dict.get(C.NEBULA, {}))
         self.hybrid_engine_config = HybridEngineConfig(**param_dict.get("hybrid_engine", {}))

@@ -116,6 +116,9 @@ MESH_FSDP = "fsdp"
 #############################################
 ZERO_OPTIMIZATION = "zero_optimization"
 ACTIVATION_CHECKPOINTING = "activation_checkpointing"
+# flash-attention block geometry / backward policy (TPU-native; see
+# ops/pallas/attention_geometry.py for the resolution layering)
+ATTENTION = "attention"
 COMMS_LOGGER = "comms_logger"
 MONITOR_TENSORBOARD = "tensorboard"
 MONITOR_WANDB = "wandb"

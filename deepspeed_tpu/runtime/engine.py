@@ -155,6 +155,19 @@ class DeepSpeedEngine:
         from deepspeed_tpu.parallel.topology import set_topology
         set_topology(topology)  # sequence-parallel attention finds the mesh here
 
+        # -- attention block geometry ("attention" config block): install the
+        # engine-level default + winners-cache path in the geometry resolver
+        # so every flash_attention call site (model zoo, ops) picks it up.
+        # Process-wide on purpose — the geometry is a property of the chip +
+        # workload, not of one engine; per-model `attention_blocks` config
+        # fields and per-call kwargs still override. Unset fields clear any
+        # previous engine's install (an engine without an "attention" block
+        # must not inherit one from an earlier init in the same process).
+        _attn = config.attention_config
+        from deepspeed_tpu.ops.pallas import attention_geometry as _ag
+        _ag.set_cache_path(_attn.cache_file or None)
+        _ag.set_default_geometry(_attn.geometry_fields() or None)
+
         # -- precision (reference engine.py:1056-1069 half()/bfloat16())
         if config.bfloat16_enabled:
             self.compute_dtype = jnp.bfloat16
@@ -1202,8 +1215,12 @@ class DeepSpeedEngine:
         t_params, raw = self._pending_student_init
         new = student_initialization(jax.device_get(self.state.params),
                                      jax.device_get(t_params), raw)
+        # owned copy: the host-built tree enters the DONATED train step; a
+        # zero-copy device_put would hand XLA foreign memory to free
+        # (utils/device.py)
+        from deepspeed_tpu.utils.device import owned_device_put
         self.state = self.state._replace(
-            params=jax.device_put(new, self.state_shardings.params))
+            params=owned_device_put(new, self.state_shardings.params))
         self._pending_student_init = None
 
     def _kd_block_filter(self, module=None):
@@ -1423,8 +1440,14 @@ class DeepSpeedEngine:
         # fsdp) only and GSPMD keeps owning the TP collectives inside
         # (qcomm.py axis_names); pipe/expert/sequence still fall back
         dp_compat = all(self.mesh.shape[a] == 1 for a in ("pipe", "sequence", "expert"))
+        # TP composes through qcomm's partial-manual shard_map (tensor stays
+        # an automatic axis) — only when the jax runtime supports live auto
+        # axes inside manual regions (jax_compat shims can't emulate it)
+        from deepspeed_tpu.utils import jax_compat
+        tp_compat = self.mesh.shape["tensor"] == 1 or jax_compat.PARTIAL_MANUAL_OK
         dp_world = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
-        self._use_qcomm = (want_qcomm and dp_compat and dp_world > 1 and not has_moe
+        self._use_qcomm = (want_qcomm and dp_compat and tp_compat and dp_world > 1
+                           and not has_moe
                            and not getattr(self, "_offload_enabled", False)
                            and not getattr(self, "_param_offload_enabled", False))
         if want_qcomm and not self._use_qcomm:
@@ -2021,10 +2044,13 @@ class DeepSpeedEngine:
             return
         n_micro = self.config.gradient_accumulation_steps
         if getattr(self, "_retain_grads_flag", False):
-            # averaged, unscaled grads for utils.tensor_fragment debug access
+            # averaged, unscaled grads for utils.tensor_fragment debug access.
+            # The apply call below DONATES _grad_acc: the eager divisions
+            # must finish materializing before XLA reuses those buffers as
+            # scratch, or the retained copies read garbage
             scale = float(self.state.loss_scale.loss_scale) if self._fp16_mode else 1.0
-            self._retained_grads = jax.tree.map(
-                lambda g: g / (n_micro * scale), self._grad_acc)
+            self._retained_grads = jax.block_until_ready(jax.tree.map(
+                lambda g: g / (n_micro * scale), self._grad_acc))
         self.state, metrics = self._apply_grads_fn(self.state, self._grad_acc, n_micro)
         self._grad_acc = None
         self.global_steps += 1

@@ -56,12 +56,47 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax.memory import Space
+try:  # jax >= 0.5
+    from jax.memory import Space
+    _DEVICE_SPACE = Space.Device
+except ImportError:  # 0.4.x: spell "device memory" as a TransferToMemoryKind
+    Space = None
+    from jax._src.sharding_impls import TransferToMemoryKind
+    _DEVICE_SPACE = TransferToMemoryKind("device")
 from jax.sharding import NamedSharding
 
 import flax.linen as nn
 
 HOST_MEMORY_KIND = "pinned_host"
+
+
+def host_memory_kind() -> str:
+    """The host-resident memory kind of the default backend. TPU/GPU expose
+    ``pinned_host``; XLA:CPU exposes only ``unpinned_host`` — which IS the
+    default memory, so host placement is a no-op there (residency tests
+    must skip when ``host_memory_kind()`` equals the default kind)."""
+    try:
+        dev = jax.devices()[0]
+        kinds = {m.kind for m in dev.addressable_memories()}
+    except Exception:
+        return HOST_MEMORY_KIND
+    if HOST_MEMORY_KIND in kinds:
+        return HOST_MEMORY_KIND
+    for kind in sorted(kinds):
+        if "host" in kind:
+            return kind
+    return HOST_MEMORY_KIND
+
+
+def host_is_default_memory() -> bool:
+    """True when the backend has no distinct host memory space (XLA:CPU):
+    offload degrades to default placement and residency evidence is
+    unavailable."""
+    try:
+        return host_memory_kind() == jax.devices()[0].default_memory().kind
+    except Exception:
+        return False
+
 
 # trace-time switch: stream_block_params wraps every remat'd block in the
 # model zoo unconditionally, but only emits transfers when a step function
@@ -95,17 +130,29 @@ def param_streaming(enabled: bool = True, cast_dtype=None):
         _state.cast_dtype = prev_cast
 
 
+def _to_device_memory(x):
+    try:
+        return jax.device_put(x, _DEVICE_SPACE)
+    except ValueError:
+        # 0.4.x eager path: TransferToMemoryKind needs jit; resolve a
+        # concrete sharding instead (or plain device_put when unsharded)
+        sh = getattr(x, "sharding", None)
+        if sh is not None and getattr(sh, "memory_kind", None):
+            return jax.device_put(x, sh.with_memory_kind("device"))
+        return jax.device_put(x)
+
+
 @jax.custom_vjp
 def stream_in(x):
     """Host→device DMA as a differentiable program op. The backward is
     identity: the reference gathers params for backward and reduces grads
     device-side too (stage3 reduce-scatter) — a d2h on the cotangent would
     serialize every layer's backward behind PCIe for no semantic gain."""
-    return jax.device_put(x, Space.Device)
+    return _to_device_memory(x)
 
 
 def _stream_in_fwd(x):
-    return jax.device_put(x, Space.Device), None
+    return _to_device_memory(x), None
 
 
 def _stream_in_bwd(_, ct):
@@ -186,10 +233,12 @@ def stream_block_params(block_cls):
 
 
 def host_shardings(shardings):
-    """Map a pytree of ``NamedSharding`` to the same specs resting in
-    ``pinned_host`` memory."""
+    """Map a pytree of ``NamedSharding`` to the same specs resting in the
+    backend's host memory (``pinned_host`` on TPU/GPU; per-backend via
+    :func:`host_memory_kind`)."""
+    kind = host_memory_kind()
     return jax.tree.map(
-        lambda s: NamedSharding(s.mesh, s.spec, memory_kind=HOST_MEMORY_KIND)
+        lambda s: NamedSharding(s.mesh, s.spec, memory_kind=kind)
         if isinstance(s, NamedSharding) else s,
         shardings,
         is_leaf=lambda x: isinstance(x, NamedSharding))

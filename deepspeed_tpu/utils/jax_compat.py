@@ -1,0 +1,96 @@
+"""Compatibility shims for older jax runtimes.
+
+The codebase is written against the current ``jax.shard_map`` surface
+(``mesh=``, ``axis_names=``, ``check_vma=``). Older jaxlibs (the pinned
+container ships jax 0.4.37) only expose the experimental
+``jax.experimental.shard_map.shard_map`` (``auto=``, ``check_rep=``) and
+lack ``lax.axis_size``. Importing this module (done unconditionally from
+``deepspeed_tpu/__init__``) installs API-equivalent shims when — and only
+when — the native symbols are missing, so every call site can use the
+modern spelling unconditionally.
+
+One capability CANNOT be shimmed: *partial-manual* shard_map with live
+(size > 1) auto axes. On jax 0.4.37 the eager impl raises and the jit
+path either rejects the program (PartitionId) or hard-ABORTS the process
+inside the XLA:CPU SPMD partitioner (``spmd_partitioner.cc`` manual-
+subgroup check). The shim therefore raises ``NotImplementedError`` for
+live auto axes instead of letting XLA kill the process; callers that
+want GSPMD-composed auto axes inside a manual region must gate on
+:data:`PARTIAL_MANUAL_OK` (engine.py's qcomm path falls back to QDQ
+numerics this way). KNOWN GAP: ``runtime/pipe/engine.py`` still maps
+over ``{PIPE_AXIS}`` only, so pipeline meshes with a live data/fsdp axis
+hit this error on 0.4.37 — the pipe tier-1 tests fail on the pinned
+container (they fail at seed too; making the pipe step fully manual over
+every mesh axis is the fix). Auto axes of size 1 are folded into the
+manual set: a size-1 axis shards nothing, so full-manual is semantically
+identical.
+"""
+
+import jax
+from jax import lax
+
+__all__ = ["PARTIAL_MANUAL_OK", "install"]
+
+#: True when the runtime natively supports partial-manual shard_map
+#: (modern ``jax.shard_map`` present). When False, callers must avoid
+#: manual regions with live automatic axes (see module docstring).
+PARTIAL_MANUAL_OK = hasattr(jax, "shard_map")
+
+
+def _shim_shard_map():
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None, axis_names=None,
+                  check_vma=None, check_rep=None, auto=None, **kwargs):
+        check = True
+        if check_rep is not None:
+            check = check_rep
+        if check_vma is not None:
+            check = check_vma
+        if axis_names is not None and auto is None:
+            manual = set(axis_names)
+            auto_axes = [a for a in mesh.axis_names if a not in manual]
+            live = [a for a in auto_axes if mesh.shape[a] > 1]
+            if live:
+                raise NotImplementedError(
+                    f"partial-manual shard_map (manual={sorted(manual)}, live auto "
+                    f"axes {live}) is unsupported on jax {jax.__version__}: the SPMD "
+                    "partitioner aborts on manual-subgroup resharding. Gate on "
+                    "deepspeed_tpu.utils.jax_compat.PARTIAL_MANUAL_OK or make the "
+                    "region fully manual (runtime/pipe/engine.py pattern).")
+            # every auto axis is size 1: full manual is identical
+        elif auto:
+            live = [a for a in auto if mesh.shape[a] > 1]
+            if live:
+                raise NotImplementedError(
+                    f"partial-manual shard_map with live auto axes {live} is "
+                    f"unsupported on jax {jax.__version__} (see jax_compat docstring)")
+        return _legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                       check_rep=check)
+
+    jax.shard_map = shard_map
+
+
+def _shim_axis_size():
+    def axis_size(axis_name):
+        if isinstance(axis_name, (tuple, list)):
+            total = 1
+            for a in axis_name:
+                total = total * axis_size(a)
+            return total
+        # the documented idiom: psum of a concrete 1 constant-folds to the
+        # axis size at trace time (no collective is emitted)
+        return lax.psum(1, axis_name)
+
+    lax.axis_size = axis_size
+
+
+def install():
+    """Idempotently install the shims (no-ops on modern jax)."""
+    if not hasattr(jax, "shard_map"):
+        _shim_shard_map()
+    if not hasattr(lax, "axis_size"):
+        _shim_axis_size()
+
+
+install()

@@ -150,10 +150,12 @@ def test_multiple_tags_and_latest(tmp_path):
     e, _, _, _ = deepspeed_tpu.initialize(model=make_model(), config=base_config())
     e.train_batch(batch)
     e.save_checkpoint(str(tmp_path), tag="step1")
-    w1 = np.asarray(e.state.params["wte"])
+    # np.asarray of a CPU jax array is a zero-copy VIEW; the next donated
+    # train step reuses the buffer in place — snapshot with a real copy
+    w1 = np.array(e.state.params["wte"])
     e.train_batch(batch)
     e.save_checkpoint(str(tmp_path), tag="step2")
-    w2 = np.asarray(e.state.params["wte"])
+    w2 = np.array(e.state.params["wte"])
 
     # latest points at the most recent tag
     e_l, _, _, _ = deepspeed_tpu.initialize(model=make_model(), config=base_config())

@@ -24,6 +24,19 @@ REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", "..")
 WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_worker.py")
 
 
+def require_multiprocess_backend():
+    """Version gate: jaxlib < 0.5 has no CPU cross-process collectives
+    ("Multiprocess computations aren't implemented on the CPU backend") —
+    every distributed launch fails after paying two cold jax imports.
+    Skip up front on such runtimes."""
+    import jax
+    import pytest
+    ver = tuple(int(x) for x in jax.__version__.split(".")[:2])
+    if ver < (0, 5):
+        pytest.skip("CPU multiprocess collectives need jaxlib >= 0.5 "
+                    f"(running {jax.__version__})")
+
+
 def free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -39,6 +52,8 @@ def launch_procs(payload: str, n_procs: int = 2, devices_per_proc: int = 4,
     Returns a list of per-rank result dicts (rank order). Raises with both
     ranks' stderr tails on any failure. ``n_procs=1`` runs the same payload
     single-process (no distributed init) — the parity reference."""
+    if n_procs > 1:
+        require_multiprocess_backend()
     sys.path.insert(0, REPO)
     from envutil import cpu_subprocess_env
 

@@ -1,0 +1,284 @@
+"""Tunable-geometry flash attention: parity across block geometries and
+backward policies, and the layered geometry resolution itself.
+
+The kernel's work partitioning is now a knob (ISSUE 5 / FlashAttention-2:
+the partitioning is where the last 1.5-2x lives), so every geometry the
+autotuner may pick must be bit-compatible with the reference — interpret
+mode runs the same Pallas code path on CPU as the chip runs compiled.
+"""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.pallas import attention_geometry as ag
+from deepspeed_tpu.ops.pallas.attention_geometry import (AttentionGeometry,
+                                                         parse_spec,
+                                                         resolve_geometry,
+                                                         signature,
+                                                         store_winner)
+from deepspeed_tpu.ops.transformer.attention import dot_product_attention
+
+
+@pytest.fixture(autouse=True)
+def _clean_geometry_state(monkeypatch, tmp_path):
+    """Every test sees an empty env/config/cache resolution stack; the
+    winners cache points into tmp so repo artifacts can't leak in."""
+    monkeypatch.delenv(ag.ENV_BLOCKS, raising=False)
+    monkeypatch.delenv(ag.ENV_CACHE, raising=False)
+    ag.set_cache_path(str(tmp_path / "attention_blocks.json"))
+    ag.set_default_geometry(None)
+    yield
+    ag.set_cache_path(None)
+    ag.set_default_geometry(None)
+
+
+def _rand_qkv(seed, b, l, h, d, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((b, l, h, d)), dtype)
+    k = jnp.asarray(rng.standard_normal((b, l, h, d)), dtype)
+    v = jnp.asarray(rng.standard_normal((b, l, h, d)), dtype)
+    return q, k, v
+
+
+# geometry x policy grid: asymmetric fwd/bwd blocks, both causal-skip
+# granularities, both recompute policies (>= 6 combos per the acceptance
+# criteria; every one must match the XLA reference in fwd AND grads)
+GEOMETRIES = [
+    dict(block_q=64, block_k=64, block_q_bwd=64, block_k_bwd=64,
+         bwd_skip="block", policy="lse"),
+    dict(block_q=64, block_k=128, block_q_bwd=32, block_k_bwd=64,
+         bwd_skip="block", policy="lse"),
+    dict(block_q=128, block_k=64, block_q_bwd=64, block_k_bwd=32,
+         bwd_skip="none", policy="lse"),
+    dict(block_q=64, block_k=64, block_q_bwd=64, block_k_bwd=64,
+         bwd_skip="block", policy="recompute"),
+    dict(block_q=128, block_k=128, block_q_bwd=32, block_k_bwd=32,
+         bwd_skip="none", policy="recompute"),
+    dict(block_q=32, block_k=64, block_q_bwd=128, block_k_bwd=64,
+         bwd_skip="block", policy="recompute"),
+]
+
+
+def _loss(fn):
+    def wrapped(q, k, v):
+        o = fn(q, k, v)
+        return (o * jnp.sin(jnp.arange(o.size).reshape(o.shape))).sum()
+    return wrapped
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("geom", GEOMETRIES,
+                         ids=[AttentionGeometry(**g).spec() for g in GEOMETRIES])
+def test_geometry_policy_parity_fwd_and_grads(geom, causal):
+    q, k, v = _rand_qkv(0, 1, 128, 2, 32)
+    ref_fn = _loss(lambda q, k, v: dot_product_attention(
+        q, k, v, backend="xla", causal=causal))
+    fl_fn = _loss(lambda q, k, v: dot_product_attention(
+        q, k, v, backend="flash", causal=causal, **geom))
+    ref_o = dot_product_attention(q, k, v, backend="xla", causal=causal)
+    fl_o = dot_product_attention(q, k, v, backend="flash", causal=causal, **geom)
+    np.testing.assert_allclose(np.asarray(fl_o), np.asarray(ref_o),
+                               atol=2e-5, rtol=2e-5)
+    ref_g = jax.grad(ref_fn, argnums=(0, 1, 2))(q, k, v)
+    fl_g = jax.grad(fl_fn, argnums=(0, 1, 2))(q, k, v)
+    for rg, fg, name in zip(ref_g, fl_g, "qkv"):
+        np.testing.assert_allclose(np.asarray(fg), np.asarray(rg),
+                                   atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{name} mismatch for {geom}")
+
+
+@pytest.mark.parametrize("bwd_skip", ["block", "none"])
+def test_kv_lengths_parity_across_skip_policies(bwd_skip):
+    # the masked (right-padded) path drives the skip predicates hardest:
+    # dead K blocks must contribute exactly zero either way
+    q, k, v = _rand_qkv(3, 2, 128, 2, 32)
+    kv_lengths = jnp.array([96, 40], jnp.int32)
+    ref_fn = _loss(lambda q, k, v: dot_product_attention(
+        q, k, v, backend="xla", causal=True, kv_lengths=kv_lengths))
+    fl_fn = _loss(lambda q, k, v: dot_product_attention(
+        q, k, v, backend="flash", causal=True, kv_lengths=kv_lengths,
+        block_q=32, block_k=32, bwd_skip=bwd_skip, policy="recompute"))
+    ref_g = jax.grad(ref_fn, argnums=(0, 1, 2))(q, k, v)
+    fl_g = jax.grad(fl_fn, argnums=(0, 1, 2))(q, k, v)
+    for rg, fg, name in zip(ref_g, fl_g, "qkv"):
+        np.testing.assert_allclose(np.asarray(fg), np.asarray(rg),
+                                   atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{name} mismatch (skip={bwd_skip})")
+
+
+def test_recompute_policy_stashes_no_lse_residual():
+    # policy="recompute" must drop the [B,H,L] log-sum-exp from the
+    # fwd->bwd residuals (that HBM saving is the policy's whole point)
+    from deepspeed_tpu.ops.pallas.flash_attention import _flash_attention_bhld_fwd
+    q, k, v = _rand_qkv(4, 1, 64, 1, 32)
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    common = (None, 32**-0.5, True, 32, 32, 32, 32)
+    _, res_lse = _flash_attention_bhld_fwd(qt, kt, vt, *common, "block", "lse",
+                                           True, None)
+    _, res_rec = _flash_attention_bhld_fwd(qt, kt, vt, *common, "block",
+                                           "recompute", True, None)
+    assert res_lse[4] is not None and res_lse[4].shape == (1, 1, 64)
+    assert res_rec[4] is None
+
+
+# ---------------------------------------------------------------------------
+# spec grammar + resolution layering
+# ---------------------------------------------------------------------------
+def test_parse_spec_grammar():
+    g = parse_spec("block_q=512,block_k=1024,bwd_skip=none,policy=recompute")
+    assert (g.block_q, g.block_k, g.bwd_skip, g.policy) == (512, 1024, "none", "recompute")
+    assert parse_spec("512,1024") == AttentionGeometry(block_q=512, block_k=1024)
+    assert parse_spec("256") == AttentionGeometry(block_q=256, block_k=256)
+    assert parse_spec("") == AttentionGeometry()
+    assert parse_spec(g.spec()) == g  # spec() round-trips
+    with pytest.raises(ValueError):
+        parse_spec("block_q=512,oops=1")
+    with pytest.raises(ValueError):
+        parse_spec("bwd_skip=sometimes")
+    with pytest.raises(ValueError):
+        parse_spec("block_q=-8")
+
+
+def test_default_geometry_shape_keyed():
+    short, _ = resolve_geometry(1024, 1024, 64, 16, 8, True)
+    assert (short.block_q, short.block_k) == (512, 512)  # judged-config point
+    lng, _ = resolve_geometry(8192, 8192, 64, 16, 1, True)
+    assert lng.block_k == 1024  # head_dim<=64 doubles the kv tile at 4k+
+    assert lng.block_q_bwd < lng.block_q  # FA-2 asymmetric backward
+    wide, _ = resolve_geometry(8192, 8192, 128, 16, 1, True)
+    assert wide.block_k == 512  # wide heads keep the smaller tile
+
+
+def test_resolution_precedence_env_config_cache_default(monkeypatch):
+    shape = dict(lq=256, lk=256, head_dim=32, heads=2, batch=1, causal=True)
+    sig = signature(256, 256, 32, 2, 1, True)
+
+    g, src = resolve_geometry(**shape)
+    assert src == "default"
+
+    store_winner(sig, AttentionGeometry(block_q=64, block_k=128))
+    g, src = resolve_geometry(**shape)
+    assert (src, g.block_q, g.block_k) == ("cache", 64, 128)
+
+    ag.set_default_geometry("block_q=32")
+    g, src = resolve_geometry(**shape)
+    assert (src, g.block_q) == ("config", 32)
+    assert g.block_k == 128  # unset config fields fall through to the cache
+
+    monkeypatch.setenv(ag.ENV_BLOCKS, "block_q=128,policy=recompute")
+    g, src = resolve_geometry(**shape)
+    assert (src, g.block_q, g.policy) == ("env", 128, "recompute")
+
+    g, src = resolve_geometry(**shape,
+                              overrides=AttentionGeometry(block_q=16))
+    assert (src, g.block_q) == ("explicit", 16)
+    assert g.policy == "recompute"  # env still supplies unset fields
+
+
+def test_cache_winner_clamped_to_divisors():
+    # a winner tuned at 8k (block 1024) must not break a smaller call
+    sig = signature(128, 128, 32, 2, 1, True)
+    store_winner(sig, AttentionGeometry(block_q=1024, block_k=768))
+    g, src = resolve_geometry(128, 128, 32, 2, 1, True)
+    assert src == "cache"
+    assert 128 % g.block_q == 0 and 128 % g.block_k == 0
+
+
+def test_forward_only_override_keeps_shape_default_bwd():
+    # overriding just the forward tiling must not disturb the backward's
+    # shape-keyed defaults (the two passes prefer different partitionings)
+    ag.set_default_geometry("block_q=64,block_k=32")
+    g, _ = resolve_geometry(256, 256, 32, 2, 1, True)
+    assert (g.block_q, g.block_k) == (64, 32)
+    base = ag.default_geometry(256, 256, 32, True)
+    assert (g.block_q_bwd, g.block_k_bwd) == (base.block_q_bwd, base.block_k_bwd)
+    assert (g.bwd_skip, g.policy) == ("block", "lse")
+
+
+def test_store_and_reload_winner_roundtrip(tmp_path):
+    path = str(tmp_path / "winners.json")
+    sig = signature(512, 512, 64, 4, 2, False, jnp.dtype(jnp.bfloat16))
+    geom = AttentionGeometry(block_q=128, block_k=256, bwd_skip="none",
+                             policy="recompute")
+    store_winner(sig, geom, path=path, seconds=0.012, backend="cpu")
+    with open(path) as f:
+        data = json.load(f)
+    assert data[sig]["geometry"] == geom.as_dict()
+    assert data[sig]["seconds"] == 0.012
+    assert ag.lookup_cached(sig, path=path) == geom
+    # corrupt entries degrade to None, not an exception
+    data[sig]["geometry"] = {"block_q": "huge"}
+    with open(path, "w") as f:
+        json.dump(data, f)
+    assert ag.lookup_cached(sig, path=path) is None
+
+
+def test_env_cache_path_override(monkeypatch, tmp_path):
+    ag.set_cache_path(None)
+    p = tmp_path / "elsewhere.json"
+    monkeypatch.setenv(ag.ENV_CACHE, str(p))
+    assert ag.cache_path() == str(p)
+    sig = signature(64, 64, 16, 1, 1, True)
+    store_winner(sig, AttentionGeometry(block_q=32))
+    assert p.exists()
+    g, src = resolve_geometry(64, 64, 16, 1, 1, True)
+    assert (src, g.block_q) == ("cache", 32)
+
+
+def test_bad_env_spec_raises(monkeypatch):
+    monkeypatch.setenv(ag.ENV_BLOCKS, "block_q=nope")
+    with pytest.raises(ValueError, match=ag.ENV_BLOCKS):
+        resolve_geometry(128, 128, 32, 2, 1, True)
+
+
+def test_attention_config_block_installs_engine_default():
+    from deepspeed_tpu.runtime.config import AttentionConfig
+    cfg = AttentionConfig(block_q=256, policy="recompute")
+    assert cfg.geometry_fields() == {"block_q": 256, "policy": "recompute"}
+    ag.set_default_geometry(cfg.geometry_fields())
+    g, src = resolve_geometry(512, 512, 64, 4, 1, True)
+    assert (src, g.block_q, g.policy) == ("config", 256, "recompute")
+
+
+def test_model_config_spec_overrides_resolution():
+    # models pass cfg.attention_blocks through attention_geometry_kwargs as
+    # a geometry_spec — highest precedence, but CLAMPED per call shape
+    from deepspeed_tpu.models.common import attention_geometry_kwargs
+
+    class Cfg:
+        attention_backend = "flash"
+        attention_blocks = "block_q=32,block_k=64,policy=recompute"
+
+    kw = attention_geometry_kwargs(Cfg())
+    assert kw == {"geometry_spec": Cfg.attention_blocks}
+
+    class XlaCfg:
+        attention_backend = "xla"
+        attention_blocks = "block_q=32"
+
+    assert attention_geometry_kwargs(XlaCfg()) == {}  # xla takes no blocks
+
+    q, k, v = _rand_qkv(7, 1, 128, 2, 32)
+    ref = dot_product_attention(q, k, v, backend="xla", causal=True)
+    out = dot_product_attention(q, k, v, backend="flash", causal=True, **kw)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_model_spec_clamps_but_explicit_blocks_fall_back():
+    # a per-model pin tuned at one shape must stay on the kernel at shapes
+    # its blocks don't divide (clamped); the same sizes as direct kwargs
+    # keep the historical warn-and-fallback-to-XLA contract
+    q, k, v = _rand_qkv(9, 1, 96, 2, 32)  # 96 not divisible by 64
+    ref = dot_product_attention(q, k, v, backend="xla", causal=True)
+    out = dot_product_attention(q, k, v, backend="flash", causal=True,
+                                geometry_spec="block_q=64,block_k=64")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    out = dot_product_attention(q, k, v, backend="flash", causal=True,
+                                block_q=64, block_k=64)  # XLA fallback path
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)

@@ -23,6 +23,7 @@ from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
 from deepspeed_tpu.parallel.topology import MeshTopology
 from deepspeed_tpu.runtime.zero.param_offload import (HOST_MEMORY_KIND,
                                                       PartitionedParamSwapper,
+                                                      host_memory_kind,
                                                       param_streaming, stream_in,
                                                       stream_tree)
 
@@ -53,7 +54,7 @@ def test_stream_in_gradient_is_identity():
     device (no d2h transpose) and match the un-streamed computation."""
     mesh = MeshTopology(fsdp=8).mesh
     from jax.sharding import NamedSharding, PartitionSpec as P
-    host = NamedSharding(mesh, P("fsdp"), memory_kind=HOST_MEMORY_KIND)
+    host = NamedSharding(mesh, P("fsdp"), memory_kind=host_memory_kind())
     w = jax.device_put(jnp.arange(32.0).reshape(8, 4), host)
     x = jnp.ones((2, 8))
 
@@ -80,6 +81,10 @@ def test_offload_param_host_residency():
     """Residency evidence checkable without a real HBM split (XLA:CPU maps
     both spaces to RAM): every param leaf RESTS in pinned_host, and every
     param entry of the lowered step carries the host memory kind."""
+    from deepspeed_tpu.runtime.zero.param_offload import host_is_default_memory
+    if host_is_default_memory():
+        pytest.skip("backend has no distinct host memory space (host kind IS "
+                    "the default memory) — residency is unobservable here")
     eng, cfg = _engine({"offload_param": {"device": "cpu"}})
     _train(eng, cfg, steps=1)
     leaves = jax.tree.leaves(eng.state.params)
@@ -110,7 +115,7 @@ def test_offload_param_with_optimizer_offload():
     losses = _train(eng, cfg, steps=3)
     assert all(np.isfinite(l) for l in losses)
     leaves = jax.tree.leaves(eng.state.params)
-    assert all(l.sharding.memory_kind == HOST_MEMORY_KIND for l in leaves)
+    assert all(l.sharding.memory_kind == host_memory_kind() for l in leaves)
     # parity with the param-offload-only path on the same data: both are
     # plain Adam at lr 1e-3 from the same init seed
     eng2, cfg = _engine({"offload_param": {"device": "cpu"}})

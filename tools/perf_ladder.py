@@ -59,7 +59,34 @@ def run_rung(tag, model_name, mb, offload=False, steps=None, seq=None,
     else:
         fused = int(os.environ.get("LADDER_FUSED", "10"))
         n_steps, dt, compile_s = time_fused(engine, batch, fused=fused)
-    report(tag, mb, seq or SEQ, n_params, n_steps, dt, compile_s, cfg=cfg)
+    report(tag, mb, seq or SEQ, n_params, n_steps, dt, compile_s, cfg=cfg,
+           **attn_geometry_evidence(cfg, mb, seq or SEQ))
+
+
+def attn_geometry_evidence(cfg, mb, seq):
+    """Which flash-attention geometry this rung ran, and which resolution
+    layer picked it (explicit/env/config/cache/default) — rows regenerate
+    the PERF.md long-context table, so the chosen partitioning must ride
+    next to the TFLOPS it produced."""
+    if getattr(cfg, "attention_backend", None) != "flash":
+        return {}
+    try:
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.ops.pallas.attention_geometry import (parse_spec,
+                                                                 resolve_geometry)
+        heads = getattr(cfg, "n_head", None) or getattr(cfg, "num_attention_heads", 1)
+        causal = hasattr(cfg, "n_layer") or hasattr(cfg, "rope_theta")
+        # mirror the kernel's resolution exactly: a per-model
+        # attention_blocks pin is the highest-precedence (clamped) layer
+        spec = getattr(cfg, "attention_blocks", None)
+        geom, src = resolve_geometry(seq, seq, cfg.head_dim, heads, mb, causal,
+                                     jnp.dtype(cfg.dtype),
+                                     overrides=parse_spec(spec) if spec else None)
+        return {"attn_geometry": geom.spec(), "attn_geometry_source": src}
+    except Exception as e:  # evidence must never kill a rung
+        return {"attn_geometry": f"error: {type(e).__name__}: {str(e)[:120]}",
+                "attn_geometry_source": "error"}
 
 
 RUNGS = {
@@ -94,7 +121,10 @@ RUNGS = {
                                              moe_layer_freq=2, moe_k=1)),
     # long-context rungs: the gridded flash kernel streams K/V blocks, so
     # VMEM no longer caps sequence length; fused xent keeps the logits
-    # buffers off the OOM line at long L
+    # buffers off the OOM line at long L. Rows report the chosen attention
+    # block geometry + its source — run tools/attn_tune.py first to bank
+    # shape-keyed winners, or force one via DS_ATTN_BLOCKS.
+    "350m_seq2k": dict(model_name="350m", mb=4, seq=2048, fused_xent=True),
     "350m_seq4k": dict(model_name="350m", mb=2, seq=4096, fused_xent=True),
     "350m_seq8k": dict(model_name="350m", mb=1, seq=8192, fused_xent=True),
     # the reference's 64-TFLOPS headline workload: BERT-large pretrain at
