@@ -78,6 +78,9 @@ class GPT2Config:
     moe_use_residual: bool = False
     moe_drop_tokens: bool = True
     moe_use_rts: bool = True
+    # dispatch/combine route pin ("dense"|"sorted"); None resolves through
+    # DS_MOE_ROUTE env > engine "moe" config block > default (moe/routing.py)
+    moe_route: Optional[str] = None
 
     @property
     def head_dim(self):
@@ -235,6 +238,7 @@ class Block(nn.Module):
                                     noisy_gate_policy=cfg.moe_noisy_gate_policy,
                                     drop_tokens=cfg.moe_drop_tokens,
                                     use_rts=cfg.moe_use_rts,
+                                    route=cfg.moe_route,
                                     name="moe")(h, deterministic=deterministic)
             gated_moe, b = self._pld_gate(moe_out, keep)
             x = x + gated_moe

@@ -69,6 +69,9 @@ class LlamaConfig:
     moe_eval_capacity_factor: float = 2.0  # serving must not under-provision vs training
     moe_min_capacity: int = 4
     moe_aux_loss_coef: float = 0.01
+    # dispatch/combine route pin ("dense"|"sorted"); None resolves through
+    # DS_MOE_ROUTE env > engine "moe" config block > default (moe/routing.py)
+    moe_route: Optional[str] = None
 
     @property
     def head_dim(self):
@@ -261,6 +264,7 @@ class LlamaDecoderLayer(nn.Module):
                                     capacity_factor=cfg.moe_capacity_factor,
                                     eval_capacity_factor=cfg.moe_eval_capacity_factor,
                                     min_capacity=cfg.moe_min_capacity,
+                                    route=cfg.moe_route,
                                     name="moe")(h, deterministic=deterministic)
             return x + moe_out, l_aux
         return x + LlamaMLP(cfg, name="mlp")(h), jnp.zeros([], jnp.float32)

@@ -1,11 +1,15 @@
 """Mixture-of-experts / expert parallelism (reference ``deepspeed/moe/``)."""
 
 from deepspeed_tpu.moe.layer import MoE
-from deepspeed_tpu.moe.sharded_moe import Experts, MOELayer, TopKGate, top1gating, top2gating
+from deepspeed_tpu.moe.routing import resolve_route, set_default_route
+from deepspeed_tpu.moe.sharded_moe import (Experts, MOELayer, SortedRouting, TopKGate,
+                                           top1gating, top1routing, top2gating, top2routing)
 from deepspeed_tpu.moe.mappings import drop_tokens, gather_tokens
 from deepspeed_tpu.moe.utils import (has_moe_layers, is_moe_param, split_params_into_different_moe_groups_for_optimizer)
 
 __all__ = [
-    "MoE", "MOELayer", "TopKGate", "Experts", "top1gating", "top2gating", "drop_tokens", "gather_tokens",
+    "MoE", "MOELayer", "TopKGate", "Experts", "SortedRouting",
+    "top1gating", "top2gating", "top1routing", "top2routing",
+    "resolve_route", "set_default_route", "drop_tokens", "gather_tokens",
     "has_moe_layers", "is_moe_param", "split_params_into_different_moe_groups_for_optimizer"
 ]

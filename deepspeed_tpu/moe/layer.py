@@ -41,6 +41,11 @@ class MoE(nn.Module):
     noisy_gate_policy: Optional[str] = None
     drop_tokens: bool = True
     use_rts: bool = True
+    # dispatch/combine route pin ("dense"|"sorted") + permutation kernel
+    # ("auto"|"xla"|"pallas"); None resolves through DS_MOE_ROUTE env, the
+    # engine's "moe" config block, then the default (moe/routing.py)
+    route: Optional[str] = None
+    route_kernel: Optional[str] = None
 
     def setup(self):
         if self.noisy_gate_policy not in (None, 'None', 'Jitter', 'RSample'):
@@ -65,6 +70,8 @@ class MoE(nn.Module):
             noisy_gate_policy=None if self.noisy_gate_policy == 'None' else self.noisy_gate_policy,
             drop_tokens=self.drop_tokens,
             use_rts=self.use_rts,
+            route=self.route,
+            route_kernel=self.route_kernel,
         )
         if self.use_residual:
             # PR-MoE (reference layer.py:70-77): dense MLP alongside the MoE

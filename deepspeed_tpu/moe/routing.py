@@ -1,0 +1,101 @@
+"""Route + kernel selection for the MoE dispatch/combine engine.
+
+The MoE layer has two mathematically-equivalent dispatch/combine
+formulations (``sharded_moe.MOELayer``):
+
+* ``dense`` — the GShard/Tutel einsum route inherited from the reference
+  (``sec,sm->ecm`` over a one-hot mask): materializes a ``[G,S,E,C]``
+  combine-weights tensor and pays O(S*E*C*M) FLOPs/bytes in forward AND
+  backward for what is really a gather of <= k*S rows.
+* ``sorted`` — the token-permutation route (MegaBlocks-style): each token
+  carries a flat destination slot ``expert*C + position``; the dispatch
+  buffer ``[E*C, M]`` is built by permutation (scatter of <= k*S rows),
+  experts run on the permuted buffer, and the combine is a gather plus a
+  k-way weighted sum. No ``[G,S,E,C]`` tensor exists in either pass.
+
+Which one runs resolves through layers mirroring the attention geometry
+engine (``ops/pallas/attention_geometry.py``), highest precedence first:
+
+1. explicit per-layer kwarg (``MOELayer(route=...)`` / per-model
+   ``moe_route`` config field) — tests, power users;
+2. ``DS_MOE_ROUTE`` env override — force a route for a bench run;
+3. the engine's ``"moe"`` JSON config block (:func:`set_default_route`,
+   applied by ``runtime/engine.py``);
+4. default ``"sorted"`` (the dense route remains for A/B and parity).
+
+``kernel`` selects the permutation implementation for the sorted route:
+``"xla"`` (gather/scatter via ``take``/``segment_sum``-style ops, runs
+everywhere), ``"pallas"`` (the fused row-permutation kernel in
+``ops/pallas/moe_dispatch.py``), or ``"auto"`` (pallas on TPU, xla
+elsewhere). Resolution layers: kwarg > ``DS_MOE_KERNEL`` env > config
+block > ``"auto"``.
+
+This module is import-light on purpose (no jax): the engine and bench
+tools consult it without touching kernel code.
+"""
+
+import os
+import threading
+from typing import Optional, Tuple
+
+ENV_ROUTE = "DS_MOE_ROUTE"
+ENV_KERNEL = "DS_MOE_KERNEL"
+
+ROUTE_CHOICES = ("dense", "sorted")
+KERNEL_CHOICES = ("auto", "xla", "pallas")
+
+DEFAULT_ROUTE = "sorted"
+DEFAULT_KERNEL = "auto"
+
+_lock = threading.Lock()
+_config_route: Optional[str] = None
+_config_kernel: Optional[str] = None
+
+
+def _check(value: Optional[str], choices, what: str) -> Optional[str]:
+    if value is not None and value not in choices:
+        raise ValueError(f"moe {what} must be one of {choices}, got {value!r}")
+    return value
+
+
+def set_default_route(route: Optional[str], kernel: Optional[str] = None) -> None:
+    """Install the engine-level default route/kernel (None clears — an
+    engine whose config has no ``"moe"`` block must not inherit a previous
+    engine's install; same contract as the attention geometry default)."""
+    global _config_route, _config_kernel
+    with _lock:
+        _config_route = _check(route, ROUTE_CHOICES, "route")
+        _config_kernel = _check(kernel, KERNEL_CHOICES, "kernel")
+
+
+def get_default_route() -> Tuple[Optional[str], Optional[str]]:
+    return _config_route, _config_kernel
+
+
+def resolve_route(route: Optional[str] = None,
+                  kernel: Optional[str] = None) -> Tuple[str, str, str]:
+    """Resolve ``(route, kernel, source)`` for one MoE layer call.
+
+    ``source`` names the highest-precedence layer that decided the ROUTE
+    ("explicit" > "env" > "config" > "default") — evidence for the perf
+    ladder, same convention as ``attn_geometry_source``.
+    """
+    src = "default"
+    r = DEFAULT_ROUTE
+    if _config_route is not None:
+        r, src = _config_route, "config"
+    env_r = os.environ.get(ENV_ROUTE, "").strip() or None
+    if env_r is not None:
+        r, src = _check(env_r, ROUTE_CHOICES, f"route (from {ENV_ROUTE})"), "env"
+    if route is not None:
+        r, src = _check(route, ROUTE_CHOICES, "route"), "explicit"
+
+    k = DEFAULT_KERNEL
+    if _config_kernel is not None:
+        k = _config_kernel
+    env_k = os.environ.get(ENV_KERNEL, "").strip() or None
+    if env_k is not None:
+        k = _check(env_k, KERNEL_CHOICES, f"kernel (from {ENV_KERNEL})")
+    if kernel is not None:
+        k = _check(kernel, KERNEL_CHOICES, "kernel")
+    return r, k, src

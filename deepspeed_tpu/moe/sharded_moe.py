@@ -22,16 +22,30 @@ Key departures from the reference, all forced by XLA's compilation model
   where each group maps to one data-parallel shard, so the cumulative-sum
   position assignment stays shard-local exactly like the reference's
   per-rank gating, with no cross-device traffic.
+* **Two dispatch/combine routes.** The reference's einsum formulation
+  (``sec,sm->ecm`` over a dense one-hot mask) materializes a ``[G,S,E,C]``
+  combine-weights tensor and pays O(S*E*C*M) FLOPs/bytes in both passes
+  for what is really a gather of <= k*S rows. The ``sorted`` route
+  (default; MegaBlocks-style permutation) instead flattens each kept token
+  copy to a unique slot ``expert*C + position`` — the cumulative-sum
+  position assignment is a stable counting sort by expert — builds the
+  ``[E*C, M]`` dispatch buffer by row permutation, and combines by gather
+  + k-way weighted sum. Both routes share the gating DECISION core
+  (:func:`_top1_decisions` / :func:`_top2_decisions`), so routing choices,
+  RTS drops, and rng streams are identical bit-for-bit; route selection
+  is layered (``moe/routing.py``: kwargs > ``DS_MOE_ROUTE`` > ``"moe"``
+  config block > default).
 """
 
 import math
-from typing import Optional, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 import flax.linen as nn
 
+from deepspeed_tpu.moe.routing import resolve_route
 from deepspeed_tpu.parallel.topology import (BATCH_AXES, DATA_AXIS, EXPERT_AXIS, FSDP_AXIS,
                                              get_topology)
 
@@ -53,6 +67,18 @@ def _capacity(num_tokens: int, num_experts: int, capacity_factor: float, min_cap
     capacity = math.ceil((num_tokens / num_experts) * capacity_factor)
     # a buffer larger than the token count is pure padding
     return min(max(capacity, min_capacity), num_tokens)
+
+
+def _gate_capacity(num_tokens: int, num_experts: int, capacity_factor: float,
+                   min_capacity: int, drop_tokens: bool, k: int) -> int:
+    """THE capacity derivation — single source for the gating cores (which
+    assign slots against it) and ``TopKGate.capacity`` (which the sorted
+    route sizes its permutation buffers with). The two must agree or
+    ``expert*C + slot`` mis-addresses the buffer; top-2 shares one buffer
+    between both choices, hence the doubled factor (reference
+    ``top2gating`` ``sharded_moe.py:285``)."""
+    cf = 2 * capacity_factor if k == 2 else capacity_factor
+    return _capacity(num_tokens, num_experts, cf, min_capacity, drop_tokens)
 
 
 def multiplicative_jitter(x, rng, epsilon=1e-2):
@@ -79,23 +105,27 @@ def _keep_top_capacity(mask: jax.Array, priority: jax.Array, capacity: int) -> j
     return mask * sel
 
 
-def top1gating(logits: jax.Array,
-               capacity_factor: float,
-               min_capacity: int,
-               used_token: Optional[jax.Array] = None,
-               noisy_gate_policy: Optional[str] = None,
-               drop_tokens: bool = True,
-               use_rts: bool = True,
-               rng: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    """Top-1 gating (reference ``top1gating`` ``sharded_moe.py:179``).
+class SortedRouting(NamedTuple):
+    """Compact per-token-copy routing decisions ([S, k] arrays; the sorted
+    route's whole interface — no ``[S,E,C]`` tensor exists)."""
 
-    ``logits``: [tokens, experts] fp32. Returns
-    ``(l_aux, combine_weights [S,E,C], dispatch_mask [S,E,C] bool, exp_counts [E])``.
-    """
+    expert: jax.Array   # int32 — assigned expert
+    slot: jax.Array     # int32 — position inside the expert's capacity buffer
+    weight: jax.Array   # fp32 — combine weight (0 when dropped)
+    keep: jax.Array     # int32 — 1 iff the copy survived capacity
+
+
+def _top1_decisions(logits, capacity_factor, min_capacity, used_token,
+                    noisy_gate_policy, drop_tokens, use_rts, rng):
+    """The top-1 decision core shared by the dense and sorted routes —
+    everything up to (but excluding) the ``[S,E,C]`` materialization. One
+    implementation so routing choices, RTS drops, and rng-split order can
+    never drift between routes."""
     logits = logits.astype(jnp.float32)
     num_tokens, num_experts = logits.shape
     gates = jax.nn.softmax(logits, axis=1)
-    capacity = _capacity(num_tokens, num_experts, capacity_factor, min_capacity, drop_tokens)
+    capacity = _gate_capacity(num_tokens, num_experts, capacity_factor, min_capacity,
+                              drop_tokens, k=1)
 
     if noisy_gate_policy == 'RSample' and rng is not None:
         rng, noise_rng = jax.random.split(rng)
@@ -128,23 +158,63 @@ def top1gating(logits: jax.Array,
     locations1 = jnp.cumsum(mask1, axis=0) - 1
     locations1_s = jnp.sum(locations1 * mask1, axis=1)
 
-    gates = gates * mask1.astype(gates.dtype)
-    locations1_sc = jax.nn.one_hot(locations1_s, capacity, dtype=gates.dtype)
-    combine_weights = jnp.einsum("se,sc->sec", gates, locations1_sc)
+    gates_masked = gates * mask1.astype(gates.dtype)
+    return l_aux, gates_masked, mask1, indices1_s, locations1_s, exp_counts, capacity
+
+
+def top1gating(logits: jax.Array,
+               capacity_factor: float,
+               min_capacity: int,
+               used_token: Optional[jax.Array] = None,
+               noisy_gate_policy: Optional[str] = None,
+               drop_tokens: bool = True,
+               use_rts: bool = True,
+               rng: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Top-1 gating (reference ``top1gating`` ``sharded_moe.py:179``).
+
+    ``logits``: [tokens, experts] fp32. Returns
+    ``(l_aux, combine_weights [S,E,C], dispatch_mask [S,E,C] bool, exp_counts [E])``.
+    """
+    l_aux, gates_masked, _, _, locations1_s, exp_counts, capacity = _top1_decisions(
+        logits, capacity_factor, min_capacity, used_token, noisy_gate_policy,
+        drop_tokens, use_rts, rng)
+    locations1_sc = jax.nn.one_hot(locations1_s, capacity, dtype=gates_masked.dtype)
+    combine_weights = jnp.einsum("se,sc->sec", gates_masked, locations1_sc)
     dispatch_mask = combine_weights > 0
     return l_aux, combine_weights, dispatch_mask, exp_counts
 
 
-def top2gating(logits: jax.Array,
-               capacity_factor: float,
-               min_capacity: int,
-               drop_tokens: bool = True,
-               rng: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    """Top-2 gating (reference ``top2gating`` ``sharded_moe.py:277``)."""
+def top1routing(logits: jax.Array,
+                capacity_factor: float,
+                min_capacity: int,
+                used_token: Optional[jax.Array] = None,
+                noisy_gate_policy: Optional[str] = None,
+                drop_tokens: bool = True,
+                use_rts: bool = True,
+                rng: Optional[jax.Array] = None) -> Tuple[jax.Array, SortedRouting, jax.Array]:
+    """Top-1 gating, compact form for the sorted route: same decisions as
+    :func:`top1gating` (shared core), returned as per-token (expert, slot,
+    weight, keep) instead of a dense ``[S,E,C]`` tensor.
+    Returns ``(l_aux, SortedRouting [S,1] fields, exp_counts [E])``."""
+    l_aux, gates_masked, mask1, indices1_s, locations1_s, exp_counts, _ = _top1_decisions(
+        logits, capacity_factor, min_capacity, used_token, noisy_gate_policy,
+        drop_tokens, use_rts, rng)
+    routing = SortedRouting(
+        expert=indices1_s.astype(jnp.int32)[:, None],
+        slot=locations1_s.astype(jnp.int32)[:, None],
+        weight=jnp.sum(gates_masked, axis=1)[:, None],  # gate prob, 0 when dropped
+        keep=jnp.sum(mask1, axis=1).astype(jnp.int32)[:, None],
+    )
+    return l_aux, routing, exp_counts
+
+
+def _top2_decisions(logits, capacity_factor, min_capacity, drop_tokens, rng):
+    """The top-2 decision core shared by the dense and sorted routes."""
     logits = logits.astype(jnp.float32)
     num_tokens, num_experts = logits.shape
     gates = jax.nn.softmax(logits, axis=1)
-    capacity = _capacity(num_tokens, num_experts, 2 * capacity_factor, min_capacity, drop_tokens)
+    capacity = _gate_capacity(num_tokens, num_experts, capacity_factor, min_capacity,
+                              drop_tokens, k=2)
 
     indices1_s = jnp.argmax(gates, axis=1)
     mask1 = jax.nn.one_hot(indices1_s, num_experts, dtype=jnp.int32)
@@ -183,16 +253,55 @@ def top2gating(logits: jax.Array,
     denom_s = jnp.maximum(gates1_s + gates2_s, jnp.finfo(gates.dtype).eps)
     gates1_s = gates1_s / denom_s
     gates2_s = gates2_s / denom_s
+    return (l_aux, (mask1, mask2), (mask1_f, mask2_f), (indices1_s, indices2_s),
+            (locations1_s, locations2_s), (gates1_s, gates2_s), exp_counts, capacity)
 
+
+def top2gating(logits: jax.Array,
+               capacity_factor: float,
+               min_capacity: int,
+               drop_tokens: bool = True,
+               rng: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Top-2 gating (reference ``top2gating`` ``sharded_moe.py:277``)."""
+    (l_aux, _, (mask1_f, mask2_f), _, (locations1_s, locations2_s),
+     (gates1_s, gates2_s), exp_counts, capacity) = _top2_decisions(
+        logits, capacity_factor, min_capacity, drop_tokens, rng)
     gates1 = gates1_s[:, None] * mask1_f
     gates2 = gates2_s[:, None] * mask2_f
-    locations1_sc = jax.nn.one_hot(locations1_s, capacity, dtype=gates.dtype)
-    locations2_sc = jax.nn.one_hot(locations2_s, capacity, dtype=gates.dtype)
+    locations1_sc = jax.nn.one_hot(locations1_s, capacity, dtype=gates1.dtype)
+    locations2_sc = jax.nn.one_hot(locations2_s, capacity, dtype=gates2.dtype)
     combine1 = jnp.einsum("se,sc->sec", gates1, locations1_sc)
     combine2 = jnp.einsum("se,sc->sec", gates2, locations2_sc)
     combine_weights = combine1 + combine2
     dispatch_mask = combine_weights > 0
     return l_aux, combine_weights, dispatch_mask, exp_counts
+
+
+def top2routing(logits: jax.Array,
+                capacity_factor: float,
+                min_capacity: int,
+                drop_tokens: bool = True,
+                rng: Optional[jax.Array] = None) -> Tuple[jax.Array, SortedRouting, jax.Array]:
+    """Top-2 gating, compact form for the sorted route (same decisions as
+    :func:`top2gating`). Returns ``(l_aux, SortedRouting [S,2] fields,
+    exp_counts [E])``; copy 0 is the argmax expert, copy 1 the sampled
+    second choice."""
+    (l_aux, (mask1, mask2), _, (indices1_s, indices2_s),
+     (locations1_s, locations2_s), (gates1_s, gates2_s), exp_counts, _) = _top2_decisions(
+        logits, capacity_factor, min_capacity, drop_tokens, rng)
+    keep1 = jnp.sum(mask1, axis=1)
+    keep2 = jnp.sum(mask2, axis=1)
+    stack = lambda a, b: jnp.stack([a, b], axis=1)
+    routing = SortedRouting(
+        expert=stack(indices1_s, indices2_s).astype(jnp.int32),
+        slot=stack(locations1_s, locations2_s).astype(jnp.int32),
+        # the normalized weights carry no mask; zero dropped copies so they
+        # contribute nothing to the combine (dense route: gates*_s ride a
+        # masked one-hot instead)
+        weight=stack(gates1_s * keep1, gates2_s * keep2),
+        keep=stack(keep1, keep2).astype(jnp.int32),
+    )
+    return l_aux, routing, exp_counts
 
 
 
@@ -210,7 +319,12 @@ def _constrain_groups(x, spec, n_groups: int):
 
 class TopKGate(nn.Module):
     """Gate module (reference ``TopKGate`` ``sharded_moe.py:347``): a bias-free
-    fp32 linear + top-k gating. Operates on ``[groups, tokens, model]``."""
+    fp32 linear + top-k gating. Operates on ``[groups, tokens, model]``.
+
+    ``route="dense"`` returns the historical 4-tuple with ``[G,S,E,C]``
+    combine weights; ``route="sorted"`` returns
+    ``(l_aux, SortedRouting [G,S,k] fields, exp_counts)`` — same decisions
+    (shared cores), compact representation."""
 
     model_dim: int
     num_experts: int
@@ -221,6 +335,7 @@ class TopKGate(nn.Module):
     noisy_gate_policy: Optional[str] = None
     drop_tokens: bool = True
     use_rts: bool = True
+    route: str = "dense"
 
     @nn.compact
     def __call__(self, tokens, used_token=None, deterministic: bool = True):
@@ -247,16 +362,18 @@ class TopKGate(nn.Module):
         # — caught by the EP scaling report)
         logits = _constrain_groups(logits, (BATCH_AXES, None, None), logits.shape[0])
 
-        cf = self.capacity_factor if not deterministic else self.eval_capacity_factor
+        cf = self._cf(deterministic)
         groups = logits.shape[0]
         rngs = jax.random.split(rng, groups) if rng is not None else None
 
+        top1_fn = top1routing if self.route == "sorted" else top1gating
+        top2_fn = top2routing if self.route == "sorted" else top2gating
         if self.k == 1:
-            gate_fn = lambda lg, r, ut: top1gating(lg, cf, self.min_capacity, ut,
-                                                   self.noisy_gate_policy if not deterministic else None,
-                                                   self.drop_tokens, self.use_rts, r)
+            gate_fn = lambda lg, r, ut: top1_fn(lg, cf, self.min_capacity, ut,
+                                                self.noisy_gate_policy if not deterministic else None,
+                                                self.drop_tokens, self.use_rts, r)
         elif self.k == 2:
-            gate_fn = lambda lg, r, ut: top2gating(lg, cf, self.min_capacity, self.drop_tokens, r)
+            gate_fn = lambda lg, r, ut: top2_fn(lg, cf, self.min_capacity, self.drop_tokens, r)
         else:
             raise ValueError(f"Only top-1 and top-2 gatings are supported (got k={self.k})")
 
@@ -267,8 +384,25 @@ class TopKGate(nn.Module):
             ut = used_token.reshape(groups, -1)
             out = jax.vmap(lambda lg, r, u: gate_fn(lg, r, u))(logits, rngs, ut) if rngs is not None \
                 else jax.vmap(lambda lg, u: gate_fn(lg, None, u))(logits, ut)
+        if self.route == "sorted":
+            l_aux, routing, exp_counts = out
+            return l_aux.mean(), routing, exp_counts.sum(axis=0)
         l_aux, combine_weights, dispatch_mask, exp_counts = out
         return l_aux.mean(), combine_weights, dispatch_mask, exp_counts.sum(axis=0)
+
+    def _cf(self, deterministic: bool) -> float:
+        """Train-vs-eval capacity factor selection — one source for
+        ``__call__`` (which hands it to the gating cores) and
+        :meth:`capacity`."""
+        return self.capacity_factor if not deterministic else self.eval_capacity_factor
+
+    def capacity(self, num_tokens: int, deterministic: bool = True) -> int:
+        """The static per-expert capacity this gate resolves for a group of
+        ``num_tokens`` — same :func:`_gate_capacity` the gating cores assign
+        slots against (the sorted route sizes its permutation buffers with
+        this; any divergence would mis-address ``expert*C + slot``)."""
+        return _gate_capacity(num_tokens, self.num_experts, self._cf(deterministic),
+                              self.min_capacity, self.drop_tokens, self.k)
 
 
 class Experts(nn.Module):
@@ -303,6 +437,16 @@ class Experts(nn.Module):
         return jnp.moveaxis(out, 0, 1)
 
 
+_warned_sorted = set()
+
+
+def _warn_sorted_fallback(reason: str):
+    if reason not in _warned_sorted:
+        _warned_sorted.add(reason)
+        from deepspeed_tpu.utils.logging import logger
+        logger.warning(f"sorted MoE route falling back to the XLA permutation: {reason}")
+
+
 def _num_groups(num_tokens_leading: int) -> int:
     """Pick the token-group count: one group per data-parallel shard when the
     global topology is known and divides the batch, else a single group."""
@@ -317,12 +461,21 @@ def _num_groups(num_tokens_leading: int) -> int:
 
 class MOELayer(nn.Module):
     """The MoE layer (reference ``MOELayer`` ``sharded_moe.py:420``):
-    gate → dispatch einsum → all-to-all → experts → all-to-all → combine.
+    gate → dispatch → all-to-all → experts → all-to-all → combine.
 
     On TPU the two all-to-alls are not explicit ops: the dispatched tensor's
     sharding constraint moves the ``experts`` dim onto the ``expert`` mesh
     axis (and the group dim off it), and XLA emits the all-to-all pair in
-    forward and backward.
+    forward and backward. Both routes produce the same ``[G,E,C,M]``
+    dispatched tensor with the same constraint pair, so the transfer stays
+    capacity-bounded either way; what differs is how it is BUILT —
+    ``dense``: the reference einsum over a ``[G,S,E,C]`` one-hot
+    (O(S*E*C*M) FLOPs/bytes fwd+bwd); ``sorted``: row permutation of the
+    <= k*S dispatched tokens (O(k*S*M) moved, zero mask FLOPs).
+
+    ``route``/``route_kernel`` are explicit overrides; ``None`` resolves
+    through ``DS_MOE_ROUTE``/``DS_MOE_KERNEL`` env, the engine's ``"moe"``
+    config block, then the ``"sorted"`` default (``moe/routing.py``).
     """
 
     expert: nn.Module
@@ -335,6 +488,8 @@ class MOELayer(nn.Module):
     noisy_gate_policy: Optional[str] = None
     drop_tokens: bool = True
     use_rts: bool = True
+    route: Optional[str] = None
+    route_kernel: Optional[str] = None
 
     @nn.compact
     def __call__(self, hidden_states, used_token=None, deterministic: bool = True):
@@ -342,6 +497,7 @@ class MOELayer(nn.Module):
         orig_dtype = hidden_states.dtype
         d_model = orig_shape[-1]
         batch = orig_shape[0]
+        route, kernel, _ = resolve_route(self.route, self.route_kernel)
 
         groups = _num_groups(batch)
         tokens = hidden_states.reshape(groups, -1, d_model)  # [G, S, M]
@@ -353,7 +509,35 @@ class MOELayer(nn.Module):
 
         gate = TopKGate(self.model_dim, self.num_experts, self.k, self.capacity_factor,
                         self.eval_capacity_factor, self.min_capacity, self.noisy_gate_policy,
-                        self.drop_tokens, self.use_rts, name="gate")
+                        self.drop_tokens, self.use_rts, route=route, name="gate")
+
+        if route == "sorted":
+            out, l_aux, exp_counts, kept_counts, routed_counts, capacity = self._sorted_route(
+                gate, tokens, used_token, deterministic, kernel, constrain,
+                orig_dtype, groups)
+        else:
+            out, l_aux, exp_counts, kept_counts, routed_counts, capacity = self._dense_route(
+                gate, tokens, used_token, deterministic, constrain, orig_dtype)
+
+        out = out.reshape(orig_shape)
+        # expert-load observability (threaded to monitor/ by the engine):
+        # exp_counts = first-choice routing decisions pre-drop (the reference
+        # contract, and the signal the aux loss balances), kept_counts =
+        # surviving token COPIES post-capacity (all k choices),
+        # routed_counts = all k copies pre-capacity (kept's denominator —
+        # sown only where the route exposes it: the dense top-2 gate's
+        # public 4-tuple hides the second-choice decisions),
+        # capacity_slots = buffer slots per expert
+        self.sow("intermediates", "exp_counts", exp_counts)
+        self.sow("intermediates", "kept_counts", kept_counts)
+        if routed_counts is not None:
+            self.sow("intermediates", "routed_counts", routed_counts)
+        self.sow("intermediates", "capacity_slots",
+                 jnp.asarray(groups * capacity, jnp.int32))
+        return out, l_aux.astype(jnp.float32), exp_counts
+
+    def _dense_route(self, gate, tokens, used_token, deterministic, constrain,
+                     orig_dtype):
         l_aux, combine_weights, dispatch_mask, exp_counts = gate(tokens, used_token, deterministic)
 
         # dispatch: [G,S,E,C] × [G,S,M] → [G,E,C,M] (reference 'sec,sm->ecm').
@@ -383,7 +567,70 @@ class MOELayer(nn.Module):
         # combine: [G,S,E,C] × [G,E,C,M] → [G,S,M]
         combined = jnp.einsum("gsec,gecm->gsm", combine_weights.astype(orig_dtype), expert_out)
         combined = constrain(combined, (BATCH_AXES, None, None))
+        kept_counts = dispatch_mask.sum(axis=(0, 1, 3)).astype(jnp.int32)
+        # k=1: every routed copy is a first choice, so exp_counts IS the
+        # kept denominator; k=2: the dense gate's public return hides the
+        # second-choice routing — no exact denominator to report
+        routed_counts = exp_counts if self.k == 1 else None
+        return combined, l_aux, exp_counts, kept_counts, routed_counts, combine_weights.shape[-1]
 
-        out = combined.reshape(orig_shape)
-        self.sow("intermediates", "exp_counts", exp_counts)
-        return out, l_aux.astype(jnp.float32), exp_counts
+    def _sorted_route(self, gate, tokens, used_token, deterministic, kernel,
+                      constrain, orig_dtype, groups):
+        from deepspeed_tpu.ops.pallas.moe_dispatch import (inverse_index, permute_rows,
+                                                           resolve_impl)
+        l_aux, routing, exp_counts = gate(tokens, used_token, deterministic)
+        num_tokens = tokens.shape[1]
+        d_model = tokens.shape[2]
+        capacity = gate.capacity(num_tokens, deterministic)
+        E, C, k = self.num_experts, capacity, routing.expert.shape[-1]
+
+        impl = resolve_impl(kernel)
+        topo = get_topology()
+        if impl == "pallas" and topo is not None and topo.mesh.size > 1:
+            # pallas_call has no SPMD partitioning rule on a live mesh; the
+            # XLA permutation lowers to the same per-shard gathers
+            _warn_sorted_fallback("pallas MoE dispatch on a multi-device mesh")
+            impl = "xla"
+
+        # each kept copy owns a unique flat slot expert*C + position (the
+        # cumsum position assignment is a stable counting sort by expert);
+        # dropped copies park on the E*C sentinel → zero rows / no reads
+        flat_slot = jnp.where(routing.keep > 0,
+                              routing.expert * C + routing.slot,
+                              E * C).astype(jnp.int32).reshape(groups, num_tokens * k)
+        flat_slot = constrain(flat_slot, (BATCH_AXES, None))
+        src = inverse_index(flat_slot, E * C)  # [G, E*C] — slot -> token copy
+        src = constrain(src, (BATCH_AXES, None))
+
+        # [G, S, M] -> [G, S*k, M], copy j of token s at row s*k + j (the
+        # reshape order of the [S, k] routing fields)
+        tok_rep = jnp.repeat(tokens, k, axis=1) if k > 1 else tokens
+
+        # dispatch = pure row permutation; same constraint pair as the dense
+        # route so the expert all-to-all still moves only the capacity-
+        # bounded [G,E,C,M] buffer
+        dispatched = permute_rows(tok_rep, src, flat_slot, impl=impl)
+        dispatched = dispatched.reshape(groups, E, C, d_model)
+        dispatched = constrain(dispatched, (BATCH_AXES, None, None, None))
+        dispatched = constrain(dispatched, ((DATA_AXIS, FSDP_AXIS), EXPERT_AXIS, None, None))
+
+        expert_out = Experts(self.expert, self.num_experts, name="experts")(dispatched, deterministic)
+        expert_out = constrain(expert_out, ((DATA_AXIS, FSDP_AXIS), EXPERT_AXIS, None, None))
+        expert_out = constrain(expert_out, (BATCH_AXES, None, None, None))
+
+        # combine: gather each copy's expert output back and weight it —
+        # k fused multiply-adds per token instead of the [G,S,E,C] einsum
+        gathered = permute_rows(expert_out.reshape(groups, E * C, d_model),
+                                flat_slot, src, impl=impl)
+        weights = routing.weight.astype(orig_dtype).reshape(groups, num_tokens * k, 1)
+        combined = (weights * gathered).reshape(groups, num_tokens, k, d_model).sum(axis=2)
+        combined = constrain(combined, (BATCH_AXES, None, None))
+
+        kept_counts = jnp.zeros((E,), jnp.int32).at[routing.expert.reshape(-1)].add(
+            routing.keep.reshape(-1).astype(jnp.int32))
+        # all k copies pre-capacity: the compact routing names every copy's
+        # expert, so the kept denominator is exact for both k (k=1: equals
+        # exp_counts; k=2: adds the second choices the dense return hides)
+        routed_counts = exp_counts if k == 1 else (
+            exp_counts + jnp.zeros((E,), jnp.int32).at[routing.expert[..., 1].reshape(-1)].add(1))
+        return combined, l_aux, exp_counts, kept_counts, routed_counts, capacity

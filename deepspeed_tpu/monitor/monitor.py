@@ -128,3 +128,44 @@ def _rank():
         return jax.process_index()
     except Exception:
         return 0
+
+
+def moe_gate_events(moe_stats, step) -> List[Tuple]:
+    """Format per-MoE-layer gate statistics into monitor events so
+    ``capacity_factor`` tuning is data-driven instead of guessed.
+
+    ``moe_stats``: ``{layer: {"exp_counts": [E], "kept_counts": [E],
+    "routed_counts": [E] (optional), "capacity_slots": int}}`` (engine
+    ``moe_gate_stats``; ``MOELayer`` sows them). Emits per layer:
+
+    * ``drop_fraction`` — 1 - kept/routed over all k token copies
+      (capacity too small). Only when ``routed_counts`` is present — the
+      dense top-2 gate's public return hides second-choice routing, so
+      that one route/k combination has no exact denominator;
+    * ``capacity_utilization`` — kept copies / total buffer slots
+      (capacity too large: dead padding FLOPs through the experts);
+    * ``load_cv`` — coefficient of variation of per-expert FIRST-choice
+      routing counts (the balance signal the aux loss pushes down);
+    * ``expert{e}_load`` — each expert's share of first-choice routing.
+    """
+    events = []
+    for layer, s in sorted(moe_stats.items()):
+        counts = [float(c) for c in s["exp_counts"]]
+        kept = [float(c) for c in s["kept_counts"]]
+        routed = s.get("routed_counts")
+        slots = float(s["capacity_slots"]) * max(len(counts), 1)
+        total = sum(counts)
+        prefix = f"MoE/{layer}"
+        if routed is not None and sum(float(c) for c in routed) > 0:
+            routed_total = sum(float(c) for c in routed)
+            events.append((f"{prefix}/drop_fraction",
+                           max(0.0, 1.0 - sum(kept) / routed_total), step))
+        if total > 0:
+            mean = total / len(counts)
+            var = sum((c - mean)**2 for c in counts) / len(counts)
+            events.append((f"{prefix}/load_cv", (var**0.5) / mean if mean else 0.0, step))
+            for e, c in enumerate(counts):
+                events.append((f"{prefix}/expert{e}_load", c / total, step))
+        if slots > 0:
+            events.append((f"{prefix}/capacity_utilization", sum(kept) / slots, step))
+    return events

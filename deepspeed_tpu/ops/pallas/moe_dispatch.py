@@ -1,0 +1,168 @@
+"""Fused row-permutation kernel for the sorted MoE dispatch/combine route.
+
+The sorted route (``moe/routing.py``, ``moe/sharded_moe.py``) reduces both
+MoE data movements to one primitive: **permute rows of a table by a
+precomputed index vector**, where an out-of-range index yields a zero row:
+
+* dispatch: ``buf[j] = tokens[src_idx[j]]`` — each expert-capacity slot
+  pulls the token routed to it (empty slots pull the zero row);
+* combine-gather: ``rows[i] = buf[flat_slot[i]]`` — each token copy pulls
+  its expert output back (dropped copies pull the zero row).
+
+Because capacity assignment gives every token copy a *unique* slot (the
+cumulative-sum position assignment in gating is a stable counting sort),
+both directions are pure permutations-with-drop: the VJP of a gather by
+``fwd_idx`` is exactly a gather by the inverse mapping ``bwd_idx`` — no
+scatter-add is ever needed, which is what makes the Pallas formulation a
+straight-line DMA kernel.
+
+Implementations:
+
+* ``impl="xla"`` (default off-TPU): ``take_along_axis`` + mask. Natively
+  differentiable — XLA's gather/scatter pair, runs everywhere.
+* ``impl="pallas"``: one grid step per output row; the scalar-prefetched
+  index array drives the BlockSpec index map, so each step DMAs exactly
+  the one source row it needs from HBM (dead slots clamp to a resident
+  row and Mosaic elides the copy — same idiom as the flash kernel's
+  causal skipping). Interpret mode makes it CPU-testable.
+
+``permute_rows`` is the public entry; with ``impl="pallas"`` it carries a
+custom VJP that re-enters the kernel with the inverse index map.
+"""
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+IMPL_CHOICES = ("xla", "pallas")
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def resolve_impl(kernel: str) -> str:
+    """Map a routing-engine kernel choice ("auto"|"xla"|"pallas") to a
+    concrete impl for the current backend."""
+    if kernel == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    if kernel not in IMPL_CHOICES:
+        raise ValueError(f"moe kernel impl must be one of {IMPL_CHOICES} "
+                         f"(or 'auto'), got {kernel!r}")
+    return kernel
+
+
+# ---------------------------------------------------------------------------
+# XLA fallback: gather + mask, natively differentiable
+# ---------------------------------------------------------------------------
+def _xla_permute(x: jax.Array, idx: jax.Array) -> jax.Array:
+    """x: [G, N, M], idx: [G, R] int32 (entries >= N mean "zero row").
+    Returns [G, R, M]."""
+    n = x.shape[1]
+    clipped = jnp.minimum(idx, n - 1)
+    rows = jnp.take_along_axis(x, clipped[:, :, None], axis=1)
+    return jnp.where((idx < n)[:, :, None], rows, jnp.zeros([], x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel: one output row per grid step, index-map-driven source DMA
+# ---------------------------------------------------------------------------
+def _permute_kernel(idx_ref, row_ref, out_ref, *, n_rows):
+    g, r = pl.program_id(0), pl.program_id(1)
+    live = idx_ref[g, r] < n_rows
+    out_ref[...] = jnp.where(live, row_ref[...],
+                             jnp.zeros_like(row_ref)).astype(out_ref.dtype)
+
+
+def _pallas_permute(x: jax.Array, idx: jax.Array,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    if interpret is None:
+        interpret = _interpret_default()
+    groups, n, m = x.shape
+    r = idx.shape[1]
+
+    def src_map(g, i, idx_ref):
+        # dead rows (idx >= n) clamp to a valid row: the fetch is elided
+        # when already resident, and the kernel writes zeros regardless
+        return (g, jnp.minimum(idx_ref[g, i], n - 1), 0)
+
+    return pl.pallas_call(
+        functools.partial(_permute_kernel, n_rows=n),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(groups, r),
+            in_specs=[pl.BlockSpec((None, 1, m), src_map)],
+            out_specs=pl.BlockSpec((None, 1, m),
+                                   lambda g, i, idx_ref: (g, i, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((groups, r, m), x.dtype),
+        interpret=interpret,
+    )(idx.astype(jnp.int32), x)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _pallas_permute_vjp(x, fwd_idx, bwd_idx, interpret):
+    return _pallas_permute(x, fwd_idx, interpret)
+
+
+def _pallas_permute_fwd(x, fwd_idx, bwd_idx, interpret):
+    return _pallas_permute(x, fwd_idx, interpret), (fwd_idx, bwd_idx)
+
+
+def _pallas_permute_bwd(interpret, res, g):
+    fwd_idx, bwd_idx = res
+    # the inverse permutation: rows x[i] contributed to are exactly the
+    # output rows bwd_idx[i] points at (unique-slot invariant), so the
+    # cotangent is one more gather — dropped rows read the zero row
+    dx = _pallas_permute(g, bwd_idx, interpret)
+    f0 = lambda a: np.zeros(a.shape, dtype=jax.dtypes.float0)
+    return dx, f0(fwd_idx), f0(bwd_idx)
+
+
+_pallas_permute_vjp.defvjp(_pallas_permute_fwd, _pallas_permute_bwd)
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+def permute_rows(x: jax.Array,
+                 fwd_idx: jax.Array,
+                 bwd_idx: jax.Array,
+                 *,
+                 impl: str = "xla",
+                 interpret: Optional[bool] = None) -> jax.Array:
+    """Permute rows of ``x`` [G, N, M] to ``[G, R, M]`` via ``fwd_idx``
+    [G, R]; indices >= N produce zero rows.
+
+    ``bwd_idx`` [G, N] must be the inverse mapping (``bwd_idx[g, i]`` = the
+    output row that reads input row ``i``, or >= R when none does). It is
+    only consulted by the Pallas impl's custom VJP; the XLA impl
+    differentiates natively. **Both index maps must be injective on their
+    live entries** — slot uniqueness is guaranteed by the capacity
+    assignment in gating.
+    """
+    if impl == "pallas":
+        return _pallas_permute_vjp(x, fwd_idx, bwd_idx, interpret)
+    if impl != "xla":
+        raise ValueError(f"moe dispatch impl must be one of {IMPL_CHOICES}, "
+                         f"got {impl!r}")
+    return _xla_permute(x, fwd_idx)
+
+
+def inverse_index(fwd_idx: jax.Array, n_rows: int) -> jax.Array:
+    """Inverse of an injective-with-drop index map: given ``fwd_idx`` [G, R]
+    with live entries < ``n_rows`` unique per group, return ``inv`` [G,
+    n_rows] where ``inv[g, j]`` is the r with ``fwd_idx[g, r] == j`` (or
+    ``R`` — the drop sentinel — when no row maps there)."""
+    groups, r = fwd_idx.shape
+    base = jnp.full((groups, n_rows), r, jnp.int32)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (groups, r), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (groups, r), 1)
+    # out-of-range destinations (dropped entries) fall off the scatter
+    return base.at[rows, fwd_idx].set(cols, mode="drop")

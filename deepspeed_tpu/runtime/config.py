@@ -92,6 +92,20 @@ class AttentionConfig(DeepSpeedConfigModel):
             bwd_skip=self.bwd_skip, policy=self.policy).items() if v is not None}
 
 
+class MoEConfig(DeepSpeedConfigModel):
+    """MoE dispatch/combine engine block (TPU-native; no reference analog —
+    the reference's einsum route is its only formulation).
+
+    ``route``: "dense" (the GShard/Tutel ``[G,S,E,C]`` einsum route) or
+    "sorted" (token-permutation dispatch/combine). ``kernel``: permutation
+    implementation for the sorted route — "auto" | "xla" | "pallas". Unset
+    knobs resolve through the routing engine's remaining layers
+    (``DS_MOE_ROUTE``/``DS_MOE_KERNEL`` env, then the "sorted"/"auto"
+    defaults) — see ``moe/routing.py``."""
+    route: Optional[str] = None      # "dense" | "sorted"
+    kernel: Optional[str] = None     # "auto" | "xla" | "pallas"
+
+
 class MeshConfig(DeepSpeedConfigModel):
     """TPU-native parallel-topology block (replaces mpu/world-size plumbing).
 
@@ -228,6 +242,7 @@ class DeepSpeedConfig:
         self.trace_profiler_config = get_trace_profiler_config(param_dict)
         self.comms_config = DeepSpeedCommsConfig(param_dict)
         self.attention_config = AttentionConfig(**param_dict.get(C.ATTENTION, {}))
+        self.moe_config = MoEConfig(**param_dict.get(C.MOE, {}))
         self.checkpoint_config = CheckpointConfig(**param_dict.get(C.CHECKPOINT, {}))
         self.nebula_config = NebulaConfig(**param_dict.get(C.NEBULA, {}))
         self.hybrid_engine_config = HybridEngineConfig(**param_dict.get("hybrid_engine", {}))

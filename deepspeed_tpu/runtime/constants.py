@@ -119,6 +119,9 @@ ACTIVATION_CHECKPOINTING = "activation_checkpointing"
 # flash-attention block geometry / backward policy (TPU-native; see
 # ops/pallas/attention_geometry.py for the resolution layering)
 ATTENTION = "attention"
+# MoE dispatch/combine route + permutation kernel (TPU-native; see
+# moe/routing.py for the resolution layering)
+MOE = "moe"
 COMMS_LOGGER = "comms_logger"
 MONITOR_TENSORBOARD = "tensorboard"
 MONITOR_WANDB = "wandb"

@@ -168,6 +168,14 @@ class DeepSpeedEngine:
         _ag.set_cache_path(_attn.cache_file or None)
         _ag.set_default_geometry(_attn.geometry_fields() or None)
 
+        # -- MoE dispatch route ("moe" config block): same install/clear
+        # contract as the attention geometry — process-wide default, per-model
+        # `moe_route` config fields and per-layer kwargs still override, and
+        # an engine without a "moe" block clears any previous engine's install
+        from deepspeed_tpu.moe import routing as _moe_routing
+        _moe_routing.set_default_route(config.moe_config.route,
+                                       config.moe_config.kernel)
+
         # -- precision (reference engine.py:1056-1069 half()/bfloat16())
         if config.bfloat16_enabled:
             self.compute_dtype = jnp.bfloat16
@@ -206,6 +214,7 @@ class DeepSpeedEngine:
         self._eval_step_fn = None
         self._micro_grad_fn = None
         self._apply_grads_fn = None
+        self._moe_stats_fn = None  # jitted MoE gate-observability forward
         # defaults live here (not in _build_step_fns) because subclasses
         # override _build_step_fns but the base train_batch reads these
         self._onebit_cfg = None
@@ -1954,6 +1963,7 @@ class DeepSpeedEngine:
             profile_engine_step(self, device_batch, rng,
                                 step_latency_s=step_latency,
                                 output_file=fp_cfg.output_file)
+        self._last_batch_for_stats = batch  # MoE gate observability (_post_step)
         self._post_step(metrics)
         self._maybe_trace_window()  # close the window right after its last step
         return metrics["loss"]
@@ -1963,6 +1973,62 @@ class DeepSpeedEngine:
         self._ensure_params_resident()
         device_batch = self._shard_batch(batch, with_gas_dim=False)
         return self._eval_step_fn(self.state.params, device_batch, self.state.step)
+
+    def moe_gate_stats(self, batch):
+        """Per-MoE-layer expert-load statistics from one diagnostic forward
+        (train-mode gating: train capacity factor, RTS/noise live, rng keyed
+        off the current step). The forward is jitted once and reused, and
+        the batch goes through ``_shard_batch`` like every other engine
+        dispatch — on a mesh it runs sharded, not replicated; cost is one
+        compiled forward per call (``_post_step`` calls at
+        ``steps_per_print`` cadence, only with a monitor backend enabled).
+        Returns ``{layer: {"exp_counts": [E], "kept_counts": [E],
+        "routed_counts": [E] (when the route exposes it), "capacity_slots":
+        int}}`` — the gate sows these (``MOELayer``), and
+        ``monitor.moe_gate_events`` turns them into drop-fraction /
+        capacity-utilization / load-balance series so ``capacity_factor``
+        is tuned from data instead of guessed."""
+        self.initialize_state(batch)
+        self._ensure_params_resident()
+        device_batch = self._shard_batch(batch, with_gas_dim=False)
+        if self._moe_stats_fn is None:
+            def _stats(params, mb, key):
+                ids = mb["input_ids"] if isinstance(mb, dict) else mb
+                extra = self._module_kwargs(mb)
+                cparams = _cast_floating(params, self.compute_dtype)
+                drop_key, gate_key = jax.random.split(key)
+                _, ivars = self.module.apply({"params": cparams}, ids,
+                                             deterministic=False,
+                                             rngs={"dropout": drop_key, "gating": gate_key},
+                                             mutable=["intermediates"], **extra)
+                return ivars["intermediates"]
+
+            self._moe_stats_fn = jax.jit(_stats)
+        inter = jax.device_get(self._moe_stats_fn(
+            self.state.params, device_batch,
+            jax.random.fold_in(self._base_rng, self.global_steps)))
+
+        stats = {}
+
+        def walk(node, path):
+            if not isinstance(node, dict):
+                return
+            if "exp_counts" in node and "kept_counts" in node:
+                layer = "/".join(p for p in path if p) or "moe"
+                entry = {
+                    "exp_counts": np.asarray(node["exp_counts"][0]),
+                    "kept_counts": np.asarray(node["kept_counts"][0]),
+                    "capacity_slots": int(node["capacity_slots"][0]),
+                }
+                if "routed_counts" in node:
+                    entry["routed_counts"] = np.asarray(node["routed_counts"][0])
+                stats[layer] = entry
+                return
+            for k, v in node.items():
+                walk(v, path + [k])
+
+        walk(inter, [])
+        return stats
 
     def retain_grads(self, flag: bool = True):
         """Keep each optimization step's averaged full-precision gradients
@@ -2124,6 +2190,14 @@ class DeepSpeedEngine:
                       (f"Train/lr", self.get_lr()[0], self.global_samples)]
             if self._fp16_mode:
                 events.append((f"Train/loss_scale", float(metrics["loss_scale"]), self.global_samples))
+            batch = getattr(self, "_last_batch_for_stats", None)
+            mcfg = getattr(self.module, "config", None)
+            if batch is not None and mcfg is not None and getattr(mcfg, "moe_num_experts", 0) > 0:
+                from deepspeed_tpu.monitor.monitor import moe_gate_events
+                try:
+                    events += moe_gate_events(self.moe_gate_stats(batch), self.global_samples)
+                except Exception as e:  # observability must never kill a step
+                    logger.warning(f"moe gate stats collection failed: {e}")
             self.monitor.write_events(events)
         if self.config.wall_clock_breakdown and self.global_steps % self.config.steps_per_print == 0:
             self.timers.log([TRAIN_BATCH_TIMER])

@@ -6,6 +6,17 @@ jax.profiler.trace (a killed trace session wedges the tunnel).
 
 Run: python tools/perf_breakdown.py   (background it; poll stdout —
 NEVER wrap in `timeout`: a killed TPU process wedges the tunnel claim)
+
+MoE mode (``BENCH_MOE=1``): instead of the engine step, attribute one MoE
+layer's time into gate / dispatch / expert-matmul / combine sections by
+timing nested prefix programs (gate; gate+dispatch; +experts; +combine)
+per route, so the dense-vs-sorted A/B is visible per phase, not just
+end-to-end. Defaults to the 125m_moe8 shape (M=768, E=8, mb=8, seq=1024 —
+override via BENCH_MOE_DIM/EXPERTS/BENCH_MICRO_BS/BENCH_SEQ/BENCH_MOE_K/
+BENCH_MOE_CF); routes from BENCH_MOE_ROUTES (default "dense,sorted").
+Each route row also reports ``dispatch_peak_bytes`` — the routing
+metadata + dispatch buffers the route materializes (the dense route's
+[S,E,C] tensors vs the sorted route's [S*k] index vectors).
 """
 import json
 import os
@@ -45,7 +56,133 @@ def timed(tag, fn, carry):
     return dt
 
 
+def moe_sections():
+    """Per-phase MoE attribution: nested prefix programs per route. Chained
+    deps (loss-derived zero shift) keep the dedupe honest, same as the
+    model-level sections."""
+    import jax.nn
+    from deepspeed_tpu.moe.sharded_moe import _capacity, top1gating, top1routing, top2gating, top2routing
+    from deepspeed_tpu.ops.pallas.moe_dispatch import inverse_index, permute_rows, resolve_impl
+
+    M = int(os.environ.get("BENCH_MOE_DIM", "768"))       # 125m n_embd
+    E = int(os.environ.get("BENCH_MOE_EXPERTS", "8"))
+    K = int(os.environ.get("BENCH_MOE_K", "1"))
+    CF = float(os.environ.get("BENCH_MOE_CF", "1.25"))
+    S = MB * SEQ                                          # tokens per group (G=1)
+    F = 4 * M
+    C = _capacity(S, E, (2 * CF) if K == 2 else CF, 4)
+    impl = resolve_impl(os.environ.get("DS_MOE_KERNEL", "auto"))
+    routes = os.environ.get("BENCH_MOE_ROUTES", "dense,sorted").split(",")
+    dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    print(f"# moe breakdown M={M} E={E} k={K} cf={CF} S={S} C={C} "
+          f"impl={impl} dtype={dt.__name__}", flush=True)
+
+    rng = np.random.default_rng(0)
+    wg = jnp.asarray(rng.normal(0, 0.02, (M, E)), jnp.float32)
+    w1 = jnp.asarray(rng.normal(0, 0.02, (E, M, F)), dt)
+    w2 = jnp.asarray(rng.normal(0, 0.02, (E, F, M)), dt)
+    tokens0 = jnp.asarray(rng.normal(size=(S, M)), dt)
+    itemsize = jnp.dtype(dt).itemsize
+
+    def gate_dense(tok):
+        logits = tok.astype(jnp.float32) @ wg
+        if K == 2:
+            return top2gating(logits, CF, 4)
+        return top1gating(logits, CF, 4)
+
+    def gate_sorted(tok):
+        logits = tok.astype(jnp.float32) @ wg
+        if K == 2:
+            return top2routing(logits, CF, 4)
+        return top1routing(logits, CF, 4)
+
+    def dispatch_dense(tok):
+        l_aux, combine, dispatch, _ = gate_dense(tok)
+        return jnp.einsum("sec,sm->ecm", dispatch.astype(tok.dtype), tok), combine, l_aux
+
+    def dispatch_sorted(tok):
+        l_aux, rt, _ = gate_sorted(tok)
+        flat_slot = jnp.where(rt.keep > 0, rt.expert * C + rt.slot,
+                              E * C).astype(jnp.int32).reshape(1, S * K)
+        src = inverse_index(flat_slot, E * C)
+        rep = jnp.repeat(tok, K, axis=0) if K > 1 else tok
+        buf = permute_rows(rep[None], src, flat_slot, impl=impl)
+        return buf.reshape(E, C, M), (flat_slot, src, rt.weight), l_aux
+
+    def experts(buf):  # [E,C,M] -> [E,C,M], one fused GEMM pair per projection
+        h = jax.nn.gelu(jnp.einsum("ecm,emf->ecf", buf, w1))
+        return jnp.einsum("ecf,efm->ecm", h, w2)
+
+    def combine_dense(combine, eo, tok):
+        return jnp.einsum("sec,ecm->sm", combine.astype(tok.dtype), eo)
+
+    def combine_sorted(meta, eo, tok):
+        flat_slot, src, weight = meta
+        rows = permute_rows(eo.reshape(1, E * C, M), flat_slot, src, impl=impl)
+        w = weight.astype(tok.dtype).reshape(1, S * K, 1)
+        return (w * rows).reshape(S, K, M).sum(axis=1)
+
+    for route in [r.strip() for r in routes if r.strip()]:
+        disp = dispatch_dense if route == "dense" else dispatch_sorted
+        comb = combine_dense if route == "dense" else combine_sorted
+
+        def p_gate(tok):
+            out = (gate_dense if route == "dense" else gate_sorted)(tok)
+            return out[0]  # l_aux: scalar data dep through the whole gate
+
+        def p_dispatch(tok):
+            buf, _, l_aux = disp(tok)
+            return buf.astype(jnp.float32).sum() + l_aux
+
+        def p_expert(tok):
+            buf, _, l_aux = disp(tok)
+            return experts(buf).astype(jnp.float32).sum() + l_aux
+
+        def p_full(tok):
+            buf, meta, l_aux = disp(tok)
+            out = comb(meta, experts(buf), tok) if route == "sorted" \
+                else combine_dense(meta, experts(buf), tok)
+            return out.astype(jnp.float32).sum() + l_aux
+
+        times = {}
+        for tag, fn in [("gate", p_gate), ("dispatch", p_dispatch),
+                        ("expert", p_expert), ("fwd", p_full),
+                        ("fwd_bwd", lambda tok: jax.grad(p_full)(tok).astype(jnp.float32).sum())]:
+            @jax.jit
+            def prog(carry, fn=fn):
+                tok, acc = carry
+                v = fn(tok)
+                v = v.sum() if v.ndim else v
+                shift = (v * 0).astype(tok.dtype)
+                return (tok + shift, acc + v.astype(jnp.float32))
+
+            times[tag] = timed(f"moe_{route}_{tag}", lambda c: prog(c),
+                               (tokens0, jnp.float32(0)))
+
+        # routing metadata + dispatch/combine buffers materialized per route
+        if route == "dense":
+            meta_bytes = S * E * C * (4 + itemsize)  # combine f32 + mask cast
+        else:
+            meta_bytes = S * K * (4 + 4) + E * C * 4  # slots + weights + src
+        peak = meta_bytes + E * C * M * itemsize     # + the [E,C,M] buffer
+        print(json.dumps({
+            "tag": f"moe_{route}", "moe_route": route,
+            "moe_kernel": impl if route == "sorted" else None,
+            "gate_ms": round(times["gate"] * 1e3, 2),
+            "dispatch_ms": round((times["dispatch"] - times["gate"]) * 1e3, 2),
+            "expert_ms": round((times["expert"] - times["dispatch"]) * 1e3, 2),
+            "combine_ms": round((times["fwd"] - times["expert"]) * 1e3, 2),
+            "fwd_ms": round(times["fwd"] * 1e3, 2),
+            "fwd_bwd_ms": round(times["fwd_bwd"] * 1e3, 2),
+            "dispatch_peak_bytes": int(peak),
+        }), flush=True)
+
+
 def main():
+    if os.environ.get("BENCH_MOE", "0") == "1":
+        moe_sections()
+        print("# DONE", flush=True)
+        return
     cfg = get_gpt2_config(MODEL, n_positions=SEQ, remat=True,
                           attention_backend="flash", dtype=jnp.bfloat16)
     model = GPT2LMHeadModel(cfg)

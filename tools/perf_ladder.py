@@ -60,7 +60,8 @@ def run_rung(tag, model_name, mb, offload=False, steps=None, seq=None,
         fused = int(os.environ.get("LADDER_FUSED", "10"))
         n_steps, dt, compile_s = time_fused(engine, batch, fused=fused)
     report(tag, mb, seq or SEQ, n_params, n_steps, dt, compile_s, cfg=cfg,
-           **attn_geometry_evidence(cfg, mb, seq or SEQ))
+           **attn_geometry_evidence(cfg, mb, seq or SEQ),
+           **moe_route_evidence(cfg))
 
 
 def attn_geometry_evidence(cfg, mb, seq):
@@ -87,6 +88,23 @@ def attn_geometry_evidence(cfg, mb, seq):
     except Exception as e:  # evidence must never kill a rung
         return {"attn_geometry": f"error: {type(e).__name__}: {str(e)[:120]}",
                 "attn_geometry_source": "error"}
+
+
+def moe_route_evidence(cfg):
+    """Which MoE dispatch/combine route this rung ran and which resolution
+    layer picked it (explicit/env/config/default) — the dense-vs-sorted A/B
+    rows regenerate PERF.md's MoE table, so the route must ride next to the
+    TFLOPS it produced (same contract as attn_geometry_source)."""
+    if not getattr(cfg, "moe_num_experts", 0):
+        return {}
+    try:
+        from deepspeed_tpu.moe.routing import resolve_route
+        route, kernel, src = resolve_route(getattr(cfg, "moe_route", None))
+        return {"moe_route": route, "moe_route_source": src,
+                "moe_kernel": kernel if route == "sorted" else None}
+    except Exception as e:  # evidence must never kill a rung
+        return {"moe_route": f"error: {type(e).__name__}: {str(e)[:120]}",
+                "moe_route_source": "error"}
 
 
 RUNGS = {
@@ -119,6 +137,14 @@ RUNGS = {
     "125m_moe8_mb8": dict(model_name="125m", mb=8, fused_xent=True,
                           cfg_overrides=dict(moe_num_experts=8,
                                              moe_layer_freq=2, moe_k=1)),
+    # dispatch-route A/B at the same operating point: 125m_moe8_mb8 runs
+    # the resolved default (sorted unless overridden); this rung pins the
+    # dense einsum route so the sorted-route gain is measured in one window
+    # (ROADMAP 3c: >=58 active-TFLOPS target, from 48.8 dense)
+    "125m_moe8_mb8_dense": dict(model_name="125m", mb=8, fused_xent=True,
+                                cfg_overrides=dict(moe_num_experts=8,
+                                                   moe_layer_freq=2, moe_k=1,
+                                                   moe_route="dense")),
     # long-context rungs: the gridded flash kernel streams K/V blocks, so
     # VMEM no longer caps sequence length; fused xent keeps the logits
     # buffers off the OOM line at long L. Rows report the chosen attention
@@ -157,8 +183,16 @@ def main():
             touch_heartbeat()  # supervised runs: fresh clock before each rung
             run_rung(tag, **RUNGS[tag.strip()])
         except Exception as e:  # noqa: BLE001 — keep laddering past OOMs
-            print(json.dumps({"tag": tag, "error": f"{type(e).__name__}: {str(e)[:300]}"}),
-                  flush=True)
+            row = {"tag": tag, "error": f"{type(e).__name__}: {str(e)[:300]}"}
+            cfg_ov = RUNGS.get(tag.strip(), {}).get("cfg_overrides", {})
+            if cfg_ov.get("moe_num_experts"):
+                # MoE error rows still carry their route evidence (a failed
+                # rung must be attributable to the route that failed it)
+                class _C:  # minimal cfg shim for the evidence helper
+                    moe_num_experts = cfg_ov["moe_num_experts"]
+                    moe_route = cfg_ov.get("moe_route")
+                row.update(moe_route_evidence(_C))
+            print(json.dumps(row), flush=True)
             traceback.print_exc(file=sys.stderr)
     print("# DONE", flush=True)
 
