@@ -1,0 +1,80 @@
+"""graft-lint: rule-based static analysis over traced programs.
+
+Walks closed jaxprs (recursing into pjit/scan/remat/custom_vjp
+sub-jaxprs) and lowered StableHLO, plus a source-level AST pass, against
+a registry of named rules (R001..R008) that encode this repo's
+perf/determinism invariants — dense-MoE-route absence, precision
+hygiene on the parity path, no host transfers in jitted steps, donation
+hygiene, recompile hazards, sharding coverage, owned_device_put. CLI:
+``tools/graft_lint.py``; scenario matrix: :mod:`.scenarios`; gate
+semantics: :mod:`.report`.
+
+Quick in-test usage (what tests/unit/moe/test_moe_routing.py's R001
+migration calls)::
+
+    from deepspeed_tpu.analysis import check_program
+    findings = check_program(jaxpr, rules=["R001"],
+                             metadata={"moe_sec": [(S, E, C)]})
+"""
+
+from deepspeed_tpu.analysis.core import (ERROR, INFO, RULES, WARN, Finding, Rule, Waiver,
+                                         apply_waivers, ast_rules, load_waivers,
+                                         program_rules)
+from deepspeed_tpu.analysis.program import (ProgramAnalyzer, ProgramInfo, aval_bytes,
+                                            run_program_rules)
+from deepspeed_tpu.analysis import rules as _rules  # noqa: F401 — registers R001-R007
+from deepspeed_tpu.analysis import source_rules as _source_rules  # noqa: F401 — registers R008
+from deepspeed_tpu.analysis.report import (baseline_from, build_report, load_baseline,
+                                           matrix_signature, new_errors, summarize,
+                                           write_report)
+
+__all__ = [
+    "ERROR", "WARN", "INFO", "RULES", "Finding", "Rule", "Waiver",
+    "apply_waivers", "load_waivers", "program_rules", "ast_rules",
+    "ProgramAnalyzer", "ProgramInfo", "aval_bytes", "run_program_rules",
+    "check_program", "lint_engine_program",
+    "baseline_from", "build_report", "load_baseline", "matrix_signature",
+    "new_errors", "summarize", "write_report",
+]
+
+
+def check_program(jaxpr=None, rules=None, metadata=None, name="adhoc",
+                  hlo_text=None, kind="fwd_bwd"):
+    """One-call rule check over a single traced program — the in-test
+    entry point. Returns the findings list (empty == clean)."""
+    info = ProgramInfo(name=name, jaxpr=jaxpr, hlo_text=hlo_text, kind=kind,
+                       metadata=metadata)
+    findings, _ = run_program_rules(info, rules=rules)
+    return findings
+
+
+def _repo_waivers():
+    """The repo's program-layer waivers (analysis_results/waivers.json),
+    shared between the CLI and lint_engine_program so ladder evidence rows
+    never disagree with the gate about what is acknowledged."""
+    import json
+    import os
+    path = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "analysis_results", "waivers.json")
+    if not os.path.exists(path):
+        return []
+    with open(path) as fh:
+        return load_waivers(json.load(fh))
+
+
+def lint_engine_program(engine, example_batch, rules=None):
+    """Analyze a live engine's traced step program and return the compact
+    evidence summary perf_ladder embeds in its rows: rule hit counts,
+    waiver count, error count, clean flag. Chip-window rows carry this so
+    a banked TFLOPS number provably came from a lint-clean program.
+    Applies the repo's waivers.json — the row must agree with the gate."""
+    programs = engine.traced_programs(example_batch)
+    step = programs["train_step"]
+    info = ProgramInfo(name="engine_train_step", jaxpr=step["jaxpr"],
+                       hlo_text=step["hlo_text"], kind="train_step",
+                       metadata=step["metadata"])
+    findings, _ = run_program_rules(info, rules=rules)
+    apply_waivers(findings, _repo_waivers())
+    s = summarize(findings)
+    return {"lint_rule_hits": s["rule_hits"], "lint_waived": s["waived"],
+            "lint_errors": s["errors"], "lint_clean": s["clean"]}

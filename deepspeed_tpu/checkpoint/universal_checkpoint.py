@@ -21,6 +21,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from deepspeed_tpu.checkpoint.zero_to_fp32 import _flatten, _restore_numpy
+from deepspeed_tpu.utils.device import owned_device_put
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 MANIFEST = "universal_manifest.json"
@@ -108,7 +109,9 @@ def load_universal_into_state(universal_dir: str, abstract_state, shardings):
             else:
                 logger.warning(f"universal load: no fragment for {key}; initializing zeros")
             value = np.zeros(shape, dtype)
-        leaves.append(jax.device_put(value, shard))
+        # owned_device_put: these host-numpy fragments become engine state
+        # that train_step donates (utils/device.py zero-copy hazard)
+        leaves.append(owned_device_put(value, shard))
 
     unused = set(fragments) - used
     for key in sorted(unused):

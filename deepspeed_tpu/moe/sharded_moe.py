@@ -81,6 +81,20 @@ def _gate_capacity(num_tokens: int, num_experts: int, capacity_factor: float,
     return _capacity(num_tokens, num_experts, cf, min_capacity, drop_tokens)
 
 
+def sec_signature(num_tokens: int, num_experts: int, capacity_factor: float,
+                  min_capacity: int, k: int = 1,
+                  drop_tokens: bool = True) -> Tuple[int, int, int]:
+    """The dense route's ``[S, E, C]`` trailing-shape signature for one
+    group of ``num_tokens`` tokens — the tensor whose absence graft-lint
+    rule R001 enforces (analysis/rules.py). Single source of truth: both
+    the analyzer scenarios and the MoE parity tests derive the banned
+    shape from here, so a capacity-derivation change cannot silently
+    de-fang the check."""
+    return (num_tokens, num_experts,
+            _gate_capacity(num_tokens, num_experts, capacity_factor, min_capacity,
+                           drop_tokens, k))
+
+
 def multiplicative_jitter(x, rng, epsilon=1e-2):
     """Reference ``sharded_moe.py:50``: multiply by U(1-eps, 1+eps)."""
     if epsilon == 0:

@@ -544,8 +544,8 @@ class DeepSpeedEngine:
             del params_dev
 
         repl = NamedSharding(self.mesh, P())
-        ls_state = jax.device_put(self._ls_state0, repl)
-        self.state = TrainState(step=jax.device_put(jnp.zeros([], jnp.int32), repl),
+        ls_state = jax.device_put(self._ls_state0, repl)  # graft-lint: waive R008 jax-owned jnp scalars (loss_scaler.py)
+        self.state = TrainState(step=jax.device_put(jnp.zeros([], jnp.int32), repl),  # graft-lint: waive R008 jax-owned zeros
                                 params=params,
                                 opt_state=opt_state,
                                 loss_scale=ls_state)
@@ -568,11 +568,11 @@ class DeepSpeedEngine:
         self._build_step_fns()
         return abstract
 
-    def lower_train_step(self, example_batch):
-        """AOT-lower the fused train step against abstract state/batch; the
-        result's ``.compile()`` exposes XLA ``memory_analysis()`` and
-        ``cost_analysis()`` — the TPU replacement for the reference
-        autotuner's experiment launches (``autotuning/autotuner.py:1052``)."""
+    def _step_program_args(self, example_batch):
+        """The device step program this engine would dispatch, as an AOT
+        pair ``(jitted_fn, abstract_args)`` — shared by
+        :meth:`lower_train_step` (autotuner costing) and
+        :meth:`traced_programs` (graft-lint analysis)."""
         abstract = self.abstract_state(example_batch)
         gas = self.config.gradient_accumulation_steps
 
@@ -587,14 +587,58 @@ class DeepSpeedEngine:
             # offload_optimizer: the device program is the grads-only pass
             # (the update runs on host) — its memory_analysis IS the
             # candidate's HBM footprint, which is what the autotuner prunes on
-            return self._grads_only_fn.lower(abstract.params, abatch, arng)
+            return self._grads_only_fn, (abstract.params, abatch, arng)
         if getattr(self, "_param_offload_enabled", False):
             # the offload step fn splits (params, rest) so the device-resident
             # rest can be donated; memory_analysis() of this lowering is the
             # HBM-residency evidence (host params land in host_argument_size)
             rest = (abstract.step, abstract.opt_state, abstract.loss_scale)
-            return self._train_step_fn.lower(abstract.params, rest, abatch, arng)
-        return self._train_step_fn.lower(abstract, abatch, arng)
+            return self._train_step_fn, (abstract.params, rest, abatch, arng)
+        return self._train_step_fn, (abstract, abatch, arng)
+
+    def lower_train_step(self, example_batch):
+        """AOT-lower the fused train step against abstract state/batch; the
+        result's ``.compile()`` exposes XLA ``memory_analysis()`` and
+        ``cost_analysis()`` — the TPU replacement for the reference
+        autotuner's experiment launches (``autotuning/autotuner.py:1052``)."""
+        fn, args = self._step_program_args(example_batch)
+        return fn.lower(*args)
+
+    def traced_programs(self, example_batch):
+        """Expose the engine's jitted step for static analysis
+        (``deepspeed_tpu/analysis``, ``tools/graft_lint.py``): trace-only —
+        no compilation, no device buffers. Returns ``{name: {"jaxpr":
+        ClosedJaxpr, "hlo_text": StableHLO str, "metadata": {...}}}``;
+        metadata pre-declares what the rules should expect of THIS engine
+        (donation on the non-offload step, the MoE [S,E,C] signature when
+        the model routes through experts, mesh multiplicity for the
+        sharding-coverage rule)."""
+        fn, args = self._step_program_args(example_batch)
+        traced = fn.trace(*args)
+        # lower from the existing trace — fn.lower(*args) would re-trace
+        # the whole step (seconds per call at real model sizes)
+        hlo_text = traced.lower().as_text()
+        metadata = {
+            # the offload paths intentionally do NOT donate params (host
+            # masters / cross-memory-kind aliasing is illegal)
+            "expect_donation": not self._offload_enabled,
+            "multi_device": self.mesh.devices.size > 1,
+        }
+        cfg_model = getattr(self.module, "config", None)
+        moe_experts = getattr(cfg_model, "moe_num_experts", 0) if cfg_model is not None else 0
+        if moe_experts:
+            from deepspeed_tpu.moe.sharded_moe import _num_groups, sec_signature
+            batch_leaf = np.asarray(jax.tree.leaves(example_batch)[0])
+            micro = batch_leaf.shape[0] // self.config.gradient_accumulation_steps
+            seq = batch_leaf.shape[1] if batch_leaf.ndim > 1 else 1
+            tokens = (micro * seq) // _num_groups(micro)
+            metadata["moe_sec"] = [sec_signature(
+                tokens, moe_experts,
+                getattr(cfg_model, "moe_capacity_factor", 1.0),
+                getattr(cfg_model, "moe_min_capacity", 8),
+                k=getattr(cfg_model, "moe_k", 1))]
+        return {"train_step": {"jaxpr": traced.jaxpr, "hlo_text": hlo_text,
+                               "metadata": metadata}}
 
     # ------------------------------------------------------------------
     # ZeRO-Offload / ZeRO-Infinity: optimizer states off-device
@@ -916,11 +960,11 @@ class DeepSpeedEngine:
                 if i + 1 < len(leaves):
                     fut = self._offload_pool.submit(fetch, i + 1)
                 self._host_opt.step_single(i, m, g)
-                new_leaves[i] = jax.device_put(m.reshape(old.shape).astype(old.dtype), s)
+                new_leaves[i] = jax.device_put(m.reshape(old.shape).astype(old.dtype), s)  # graft-lint: waive R008 offload params never donated (grads-only fn has no donate_argnums)
         else:
             grad_leaves = [np.asarray(jax.device_get(g), np.float32) for g in grad_dev]
             self._host_opt.step(self._host_masters, grad_leaves, lr=self.get_lr()[0])
-            new_leaves = [jax.device_put(m.reshape(old.shape).astype(old.dtype), s)
+            new_leaves = [jax.device_put(m.reshape(old.shape).astype(old.dtype), s)  # graft-lint: waive R008 offload params never donated (grads-only fn has no donate_argnums)
                           for m, old, s in zip(self._host_masters, leaves, shard_leaves)]
         new_params = jax.tree.unflatten(treedef, new_leaves)
         new_ls = self._ls_update(self.state.loss_scale, jnp.asarray(False))
@@ -1806,7 +1850,7 @@ class DeepSpeedEngine:
             if jax.process_count() > 1:
                 from jax.experimental import multihost_utils
                 return multihost_utils.host_local_array_to_global_array(x, self.mesh, leaf_spec)
-            return jax.device_put(x, NamedSharding(self.mesh, leaf_spec))
+            return jax.device_put(x, NamedSharding(self.mesh, leaf_spec))  # graft-lint: waive R008 batch staging, batches are never donated
 
         return jax.tree.map(put, batch)
 
@@ -1826,7 +1870,7 @@ class DeepSpeedEngine:
             if jax.process_count() > 1:
                 from jax.experimental import multihost_utils
                 return multihost_utils.host_local_array_to_global_array(x, self.mesh, leaf_spec)
-            return jax.device_put(x, NamedSharding(self.mesh, leaf_spec))
+            return jax.device_put(x, NamedSharding(self.mesh, leaf_spec))  # graft-lint: waive R008 batch staging, batches are never donated
 
         return jax.tree.map(put, batch_stack)
 
@@ -2139,11 +2183,8 @@ class DeepSpeedEngine:
         if (not getattr(self, "_trace_active", False)
                 and step < tc.start_step + tc.num_steps
                 and step + n_steps > tc.start_step):
-            import jax.profiler
-            opts = jax.profiler.ProfileOptions()
-            opts.host_tracer_level = tc.host_tracer_level
-            opts.python_tracer_level = 1 if tc.python_tracer else 0
-            jax.profiler.start_trace(tc.output_dir, profiler_options=opts)
+            from deepspeed_tpu.utils.jax_compat import profiler_start_trace
+            profiler_start_trace(tc.output_dir, tc.host_tracer_level, tc.python_tracer)
             self._trace_active = True
             log_dist(f"XLA trace capture started at step {step} -> {tc.output_dir}")
         elif getattr(self, "_trace_active", False) and step >= tc.start_step + tc.num_steps:

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.runtime.comm.compressed import compressed_allreduce, padded_chunk_size
+from deepspeed_tpu.utils.device import owned_device_put
 from deepspeed_tpu.utils.logging import log_dist
 
 DP_AXES = ("data", "fsdp")
@@ -143,13 +144,16 @@ class ZeroOneRunner:
         # absent keys must CLEAR live buffers: rolling back to a phase-1
         # checkpoint after entering phase 2 would otherwise replay stale
         # pending updates against the rewound params
+        # owned_device_put, not device_put: the restored blobs are host
+        # numpy and these buffers are DONATED into the onebit step
+        # (donate_argnums=(0, 1)) — the utils/device.py zero-copy hazard
         if "ew" in blob:
-            self._bufs = (jax.device_put(blob["ew"], sh), jax.device_put(blob["es"], sh))
+            self._bufs = (owned_device_put(blob["ew"], sh), owned_device_put(blob["es"], sh))
         else:
             self._bufs = None
         if "m_local" in blob:
-            self._p2_state = (jax.device_put(blob["m_local"], sh),
-                              jax.device_put(blob["u"], sh))
+            self._p2_state = (owned_device_put(blob["m_local"], sh),
+                              owned_device_put(blob["u"], sh))
         else:
             self._p2_state = None
 

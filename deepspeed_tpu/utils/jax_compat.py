@@ -29,7 +29,7 @@ identical.
 import jax
 from jax import lax
 
-__all__ = ["PARTIAL_MANUAL_OK", "install"]
+__all__ = ["PARTIAL_MANUAL_OK", "install", "profiler_start_trace"]
 
 #: True when the runtime natively supports partial-manual shard_map
 #: (modern ``jax.shard_map`` present). When False, callers must avoid
@@ -83,6 +83,26 @@ def _shim_axis_size():
         return lax.psum(1, axis_name)
 
     lax.axis_size = axis_size
+
+
+def profiler_start_trace(log_dir: str, host_tracer_level: int = 2,
+                         python_tracer: bool = False) -> bool:
+    """Version-gated ``jax.profiler.start_trace``. ``ProfileOptions`` only
+    exists on newer jax; the pinned 0.4.37 container's ``start_trace``
+    takes no options object (tracer levels are fixed at its defaults).
+    Returns True when the requested tracer options were actually applied,
+    False when the legacy no-options path ran."""
+    import jax.profiler
+
+    options_cls = getattr(jax.profiler, "ProfileOptions", None)
+    if options_cls is None:
+        jax.profiler.start_trace(log_dir)
+        return False
+    opts = options_cls()
+    opts.host_tracer_level = host_tracer_level
+    opts.python_tracer_level = 1 if python_tracer else 0
+    jax.profiler.start_trace(log_dir, profiler_options=opts)
+    return True
 
 
 def install():

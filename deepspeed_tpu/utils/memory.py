@@ -76,5 +76,5 @@ class OnDevice:
             import jax
             target = [d for d in jax.devices() if self.device in (d.platform, str(d))]
             if target:
-                variables = jax.device_put(variables, target[0])
+                variables = jax.device_put(variables, target[0])  # graft-lint: waive R008 estimation probe, never donated
         return variables

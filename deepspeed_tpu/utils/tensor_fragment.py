@@ -19,6 +19,7 @@ from typing import Any, Dict, List, Optional
 import jax
 import numpy as np
 
+from deepspeed_tpu.utils.device import owned_device_put
 from deepspeed_tpu.utils.tree import keypath_str as _path_str
 
 
@@ -68,7 +69,9 @@ def safe_set_full_fp32_param(engine, name: str, value) -> None:
     value = np.asarray(value, dtype=old.dtype)
     if value.shape != old.shape:
         raise ValueError(f"shape mismatch for {name}: {value.shape} vs {old.shape}")
-    new_leaf = jax.device_put(value, old.sharding)
+    # owned_device_put: ``value`` is caller-supplied host numpy and the
+    # patched params are donated by the next train step (utils/device.py)
+    new_leaf = owned_device_put(value, old.sharding)
 
     def replace(path, leaf):
         return new_leaf if _path_str(path) == name else leaf

@@ -36,7 +36,7 @@ def _device_sync():
     try:
         import jax
 
-        (jax.device_put(0.0) + 0).block_until_ready()
+        (jax.device_put(0.0) + 0).block_until_ready()  # graft-lint: waive R008 fresh jax scalar barrier, never donated
     except Exception:
         pass
 

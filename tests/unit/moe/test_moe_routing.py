@@ -208,14 +208,17 @@ def test_top2_drop_fraction_is_sane(cf):
 
 
 # ---------------------------------------------------------------------------
-# the [G,S,E,C] elimination guarantee
+# the [G,S,E,C] elimination guarantee — enforced through graft-lint R001
+# (analysis/rules.py), the single source of truth; the hand-written jaxpr
+# scanner this file used to carry lives there now, shared with the CI gate
 # ---------------------------------------------------------------------------
-def _gsec_avals(route, k=1):
-    """All intermediate avals of a fwd+bwd step whose shape is the dense
-    route's [G, S, E, C] signature."""
-    G, S, M, E = 1, 16, 8, 4
+def _r001_findings(route, k=1):
+    """R001 findings for a fwd+bwd MOELayer step traced under ``route``."""
+    from deepspeed_tpu.analysis import check_program
+    from deepspeed_tpu.moe.sharded_moe import sec_signature
+
+    S, M, E = 16, 8, 4
     cf = 1.0
-    C = _capacity(S, E, (2 * cf) if k == 2 else cf, 1)
     x = jnp.zeros((2, S // 2, M), jnp.float32)
     layer = MOELayer(expert=_TinyExpert(), model_dim=M, num_experts=E, k=k,
                      capacity_factor=cf, eval_capacity_factor=cf, min_capacity=1,
@@ -227,38 +230,26 @@ def _gsec_avals(route, k=1):
         return (out**2).sum() + l_aux
 
     jaxpr = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1)))(variables, x)
-    hits = []
-
-    def scan(jp):
-        for eqn in jp.eqns:
-            for v in list(eqn.invars) + list(eqn.outvars):
-                aval = getattr(v, "aval", None)
-                if aval is not None and tuple(aval.shape)[-3:] == (S, E, C):
-                    hits.append(tuple(aval.shape))
-            for p in eqn.params.values():
-                for sub in jax.tree_util.tree_leaves(
-                        p, is_leaf=lambda l: isinstance(l, jax.extend.core.ClosedJaxpr)):
-                    if isinstance(sub, jax.extend.core.ClosedJaxpr):
-                        scan(sub.jaxpr)
-    scan(jaxpr.jaxpr)
-    return hits
+    return check_program(jaxpr, rules=["R001"], name=f"moe_{route}_k{k}",
+                         metadata={"moe_sec": [sec_signature(S, E, cf, 1, k=k)]})
 
 
 @pytest.mark.parametrize("k", [1, 2])
 def test_sorted_route_jaxpr_has_no_gsec_tensor(k):
-    # the dense route's signature tensor must exist there (sanity: the
-    # scanner can see it) and be absent from the sorted route's whole
-    # fwd+bwd program
-    assert _gsec_avals("dense", k), "scanner failed to find [S,E,C] in the dense route"
-    assert not _gsec_avals("sorted", k), "sorted route still materializes [*,S,E,C]"
+    # the dense route must trip R001 (sanity: the analyzer can see the
+    # signature tensor) and the sorted route's whole fwd+bwd program must
+    # not
+    assert _r001_findings("dense", k), "R001 failed to find [S,E,C] in the dense route"
+    assert not _r001_findings("sorted", k), "sorted route still materializes [*,S,E,C]"
 
 
 def test_sorted_train_step_jaxpr_has_no_gsec_tensor():
     """Model-level acceptance: the fwd+bwd jaxpr of a GPT-2-MoE loss with
     route=sorted contains no [*, S, E, C]-shaped intermediate anywhere
-    (including sub-jaxprs under remat/scan)."""
+    (including sub-jaxprs under remat/scan) — per graft-lint R001."""
+    from deepspeed_tpu.analysis import check_program
     from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
-    from deepspeed_tpu.moe.sharded_moe import _capacity
+    from deepspeed_tpu.moe.sharded_moe import sec_signature
 
     cfg = get_gpt2_config("test", n_layer=2, moe_num_experts=4, moe_layer_freq=2,
                           moe_capacity_factor=2.0, moe_min_capacity=4,
@@ -267,28 +258,17 @@ def test_sorted_train_step_jaxpr_has_no_gsec_tensor():
     ids = jnp.zeros((4, 32), jnp.int32)
     variables = model.init(jax.random.PRNGKey(0), ids)
     S = 4 * 32  # one group (no topology)
-    C = _capacity(S, 4, 2.0, 4)
 
     def loss(v):
         logits, aux = model.apply(v, ids)
         return logits.astype(jnp.float32).sum() + aux
 
     jaxpr = jax.make_jaxpr(jax.grad(loss))(variables)
-    hits = []
-
-    def scan(jp):
-        for eqn in jp.eqns:
-            for var in list(eqn.invars) + list(eqn.outvars):
-                aval = getattr(var, "aval", None)
-                if aval is not None and tuple(aval.shape)[-3:] == (S, 4, C):
-                    hits.append(tuple(aval.shape))
-            for p in eqn.params.values():
-                for sub in jax.tree_util.tree_leaves(
-                        p, is_leaf=lambda l: isinstance(l, jax.extend.core.ClosedJaxpr)):
-                    if isinstance(sub, jax.extend.core.ClosedJaxpr):
-                        scan(sub.jaxpr)
-    scan(jaxpr.jaxpr)
-    assert not hits, f"sorted train step still materializes [*,S,E,C]: {hits}"
+    findings = check_program(
+        jaxpr, rules=["R001"], name="gpt2_moe_sorted_train_step",
+        metadata={"moe_sec": [sec_signature(S, 4, 2.0, 4, k=1)]})
+    assert not findings, \
+        f"sorted train step still materializes [*,S,E,C]: {[f.message for f in findings]}"
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,176 @@
+"""graft-lint CLI: run the static-analysis scenario matrix and gate.
+
+Traces the representative program matrix (deepspeed_tpu/analysis/
+scenarios.py) on CPU — no compilation, <2 min — runs every registered
+rule (R001..R008, deepspeed_tpu/analysis/rules.py + source_rules.py),
+writes ``analysis_results/lint_<sig>.json``, and exits non-zero when a
+NEW unwaived ERROR appears relative to the committed baseline
+(``analysis_results/baseline.json``). A seeded regression — e.g. forcing
+the dense MoE dispatch with ``DS_MOE_ROUTE=dense`` — must fail this
+gate; that is the acceptance check.
+
+Usage:
+  python tools/graft_lint.py                         # full matrix + AST, gate vs baseline
+  python tools/graft_lint.py --scenarios moe_top1_route,moe_top2_route
+  python tools/graft_lint.py --update-baseline       # acknowledge current ERRORs
+  python tools/graft_lint.py --no-ast | --ast-only
+  python tools/graft_lint.py --list                  # rule + scenario inventory
+
+Waivers: ``analysis_results/waivers.json`` — a list of
+``{"rule": "R003", "scenario": "train_batch*", "match": "...", "reason": "..."}``
+entries — plus inline ``# graft-lint: waive R008 <reason>`` comments for
+the AST rule. Waived findings report but never gate.
+"""
+
+import argparse
+import ast
+import json
+import os
+import sys
+
+# CPU + an 8-device host mesh BEFORE jax initializes: the matrix includes
+# multi-device programs (same bootstrap as tests/conftest.py)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8").strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: source roots the AST rule sweeps
+AST_ROOTS = ("deepspeed_tpu", "tools", "bench.py", "envutil.py")
+
+
+def collect_source_files(repo=REPO, roots=AST_ROOTS):
+    files = []
+    for root in roots:
+        path = os.path.join(repo, root)
+        if os.path.isfile(path):
+            paths = [path]
+        else:
+            paths = [os.path.join(dp, f) for dp, _, fs in os.walk(path)
+                     for f in fs if f.endswith(".py")]
+        for p in sorted(paths):
+            rel = os.path.relpath(p, repo)
+            try:
+                with open(p) as fh:
+                    src = fh.read()
+                files.append((rel, src, ast.parse(src, filename=rel)))
+            except SyntaxError as e:  # a broken file is its own finding
+                print(f"graft-lint: cannot parse {rel}: {e}", file=sys.stderr)
+    return files
+
+
+def run(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="graft_lint", description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--scenarios", default=None,
+                    help="comma list of scenario names (default: all)")
+    ap.add_argument("--baseline", default=os.path.join(REPO, "analysis_results", "baseline.json"))
+    ap.add_argument("--waivers", default=os.path.join(REPO, "analysis_results", "waivers.json"))
+    ap.add_argument("--out", default=os.path.join(REPO, "analysis_results"))
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="acknowledge every current ERROR into the baseline and exit 0")
+    ap.add_argument("--no-ast", action="store_true", help="skip the source AST pass")
+    ap.add_argument("--ast-only", action="store_true", help="run ONLY the source AST pass")
+    ap.add_argument("--list", action="store_true", help="print rules + scenarios and exit")
+    ap.add_argument("-q", "--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    # env vars alone don't switch backends when a sitecustomize has pinned
+    # jax_platforms (e.g. the axon TPU tunnel) — re-pin in config. The lint
+    # matrix is trace-only and CPU by design; never burn chip time on it.
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from deepspeed_tpu import analysis
+    from deepspeed_tpu.analysis import scenarios as scen
+
+    if args.list:
+        print("rules:")
+        for r in analysis.RULES.values():
+            print(f"  {r.id}  [{r.severity:5s} {r.layer:5s}] {r.title}")
+        print("scenarios:")
+        for name in scen.SCENARIOS:
+            print(f"  {name}")
+        return 0
+
+    # ---- program layer -------------------------------------------------
+    per_program, skipped = {}, {}
+    if not args.ast_only:
+        names = args.scenarios.split(",") if args.scenarios else None
+        programs, skipped = scen.build(names)
+        for info in programs:
+            findings, metrics = analysis.run_program_rules(info)
+            per_program[info.name] = (findings, metrics)
+            if not args.quiet:
+                s = analysis.summarize(findings)
+                print(f"  {info.name:24s} rules_hit={s['rule_hits'] or '{}'} "
+                      f"errors={s['errors']}")
+        for name, reason in skipped.items():
+            print(f"  {name:24s} SKIPPED: {reason}")
+
+    # ---- source layer --------------------------------------------------
+    ast_findings = []
+    if not args.no_ast:
+        files = collect_source_files()
+        for rule in analysis.ast_rules():
+            ast_findings.extend(rule.check(files))
+        if not args.quiet:
+            s = analysis.summarize(ast_findings)
+            print(f"  {'<source AST>':24s} rules_hit={s['rule_hits'] or '{}'} "
+                  f"errors={s['errors']} waived={s['waived']}")
+
+    # ---- waivers -------------------------------------------------------
+    waiver_entries = []
+    if os.path.exists(args.waivers):
+        with open(args.waivers) as fh:
+            waiver_entries = json.load(fh)
+    waivers = analysis.load_waivers(waiver_entries)
+    all_findings = [f for fs, _ in per_program.values() for f in fs] + ast_findings
+    analysis.apply_waivers(all_findings, waivers)
+
+    # ---- report --------------------------------------------------------
+    sig = analysis.matrix_signature(list(per_program) + (["ast"] if not args.no_ast else []))
+    report = analysis.build_report(per_program, ast_findings, skipped=skipped,
+                                   waivers_in_effect=waiver_entries)
+    path = analysis.write_report(report, args.out, sig)
+    if not args.quiet:
+        print(f"report: {os.path.relpath(path, REPO)}")
+
+    # ---- gate ----------------------------------------------------------
+    if args.update_baseline:
+        baseline = analysis.baseline_from(all_findings)
+        os.makedirs(os.path.dirname(args.baseline), exist_ok=True)
+        with open(args.baseline, "w") as fh:
+            json.dump(baseline, fh, indent=2)
+            fh.write("\n")
+        print(f"baseline updated: {os.path.relpath(args.baseline, REPO)} "
+              f"({len(baseline['fingerprints'])} acknowledged ERRORs)")
+        return 0
+
+    baseline = analysis.load_baseline(args.baseline)
+    fresh = analysis.new_errors(all_findings, baseline)
+    if fresh:
+        print(f"graft-lint: {len(fresh)} NEW ERROR finding(s) vs baseline "
+              f"{os.path.relpath(args.baseline, REPO)}:", file=sys.stderr)
+        for f in fresh:
+            loc = f" @ {f.location}" if f.location else ""
+            print(f"  {f.rule} [{f.scenario}]{loc}: {f.message}", file=sys.stderr)
+        return 1
+    unwaived_warns = sum(1 for f in all_findings
+                         if not f.waived and f.severity == analysis.WARN)
+    if not args.quiet:
+        print(f"graft-lint: clean vs baseline "
+              f"({len(all_findings)} findings: "
+              f"{sum(1 for f in all_findings if f.waived)} waived, "
+              f"{unwaived_warns} warn)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())

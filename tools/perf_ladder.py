@@ -61,7 +61,8 @@ def run_rung(tag, model_name, mb, offload=False, steps=None, seq=None,
         n_steps, dt, compile_s = time_fused(engine, batch, fused=fused)
     report(tag, mb, seq or SEQ, n_params, n_steps, dt, compile_s, cfg=cfg,
            **attn_geometry_evidence(cfg, mb, seq or SEQ),
-           **moe_route_evidence(cfg))
+           **moe_route_evidence(cfg),
+           **lint_evidence(engine, batch))
 
 
 def attn_geometry_evidence(cfg, mb, seq):
@@ -105,6 +106,22 @@ def moe_route_evidence(cfg):
     except Exception as e:  # evidence must never kill a rung
         return {"moe_route": f"error: {type(e).__name__}: {str(e)[:120]}",
                 "moe_route_source": "error"}
+
+
+def lint_evidence(engine, batch):
+    """graft-lint summary of the step program this rung actually measured
+    (rule hit counts / waivers / clean flag — deepspeed_tpu/analysis): a
+    banked TFLOPS row must prove the measured program passed the same
+    static gates CI enforces, or a window could bank a number from a
+    program the next commit is forbidden to reproduce. Trace-only, a few
+    seconds against the rung's compile minutes; LADDER_LINT=0 opts out."""
+    if os.environ.get("LADDER_LINT", "1") != "1":
+        return {}
+    try:
+        from deepspeed_tpu.analysis import lint_engine_program
+        return lint_engine_program(engine, batch)
+    except Exception as e:  # evidence must never kill a rung
+        return {"lint_error": f"{type(e).__name__}: {str(e)[:120]}"}
 
 
 RUNGS = {
