@@ -37,12 +37,26 @@ WORLD_ENV = "DS_ELASTIC_WORLD_SIZE"
 RESTART_ENV = "DS_ELASTIC_RESTART_COUNT"
 
 
-def touch_heartbeat(path: Optional[str] = None) -> None:
+_LAST_TOUCH = {}  # path -> monotonic time of last touch (cadence throttle)
+
+
+def touch_heartbeat(path: Optional[str] = None, min_interval: float = 0.0) -> None:
     """Called by the training loop (each step / each checkpoint): refreshes
-    the supervisor's liveness signal. No-op when not under an agent."""
+    the supervisor's liveness signal. No-op when not under an agent.
+
+    ``min_interval``: skip the filesystem touch if this path was refreshed
+    less than that many seconds ago — the engine's per-step call site runs
+    cadenced (``resilience.heartbeat_interval``) so liveness costs one
+    utime per interval, not one per step, off the hot path. Supervisors
+    must size ``heartbeat_timeout`` well above the producer's interval."""
     path = path or os.environ.get(HEARTBEAT_ENV)
     if not path:
         return
+    if min_interval > 0.0:
+        now = time.monotonic()
+        if now - _LAST_TOUCH.get(path, float("-inf")) < min_interval:
+            return
+        _LAST_TOUCH[path] = now
     with open(path, "a"):
         os.utime(path, None)
 

@@ -7,6 +7,14 @@ us, for free, the reference's hardest checkpoint feature: loading with a
 needs offline reshape machinery for this, ``checkpoint/reshape_meg_2d.py``,
 ``deepspeed_checkpoint.py``) — restore simply reads each array with the new
 sharding.
+
+Resilience layer (``runtime/resilience/manifest.py``): every save stages
+into ``.tmp.<tag>``, records a per-leaf checksum + shape/dtype
+manifest and a file inventory, fsyncs, and atomically renames into the tag
+— a published tag is complete by construction, and a killed writer leaves
+only an inert staging dir the next save sweeps. ``load`` verifies the file
+inventory *before* deserializing and the restored leaves *after*, raising
+:class:`CheckpointCorruptError` instead of handing back garbage.
 """
 
 import json
@@ -17,6 +25,9 @@ import jax
 import orbax.checkpoint as ocp
 
 from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import CheckpointEngine
+from deepspeed_tpu.runtime.resilience import manifest as ckpt_manifest
+from deepspeed_tpu.runtime.resilience.faults import fault_point
+from deepspeed_tpu.runtime.resilience.manifest import CheckpointCorruptError  # noqa: F401 — re-export
 from deepspeed_tpu.utils.logging import log_dist
 
 
@@ -27,13 +38,59 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         self.base_dir = os.path.abspath(base_dir)
         self.use_async = use_async
         self._ckptr = ocp.StandardCheckpointer()
+        self._staged = {}  # tag -> (staging_dir, leaf-checksum source tree)
 
     def _path(self, tag):
         return os.path.join(self.base_dir, str(tag))
 
-    def save(self, state, tag, metadata: Optional[dict] = None):
-        path = self._path(tag)
-        self._ckptr.save(os.path.join(path, "state"), state, force=True)
+    def staging_dir(self, tag) -> Optional[str]:
+        """Where extra per-rank files (host-optimizer blobs, 1-bit error
+        feedback) belong between ``save`` and ``finalize`` — they must ride
+        the same atomic publish as the state or a crash splits the tag."""
+        staged = self._staged.get(str(tag))
+        return staged[0] if staged else None
+
+    def save(self, state, tag, metadata: Optional[dict] = None, defer_finalize: bool = False):
+        """Stage ``tag``. Published atomically by ``finalize`` — which this
+        call performs itself unless ``defer_finalize`` (caller has extra
+        files to stage; it must then finalize before the state is donated
+        to another train step — the engine's sync path does) or
+        ``use_async`` (durability lands at ``commit``)."""
+        tag = str(tag)
+        staging = ckpt_manifest.staging_path(self.base_dir, tag)
+        if jax.process_index() == 0:
+            # rank-0 only, excluding every dir THIS engine still has in
+            # flight (this tag plus any deferred/async-pending ones):
+            # another rank's collective write may be populating them
+            in_flight = {staging} | {s for s, _ in self._staged.values()}
+            ckpt_manifest.sweep_stale_staging(self.base_dir, exclude=in_flight)
+        single_process = jax.process_count() == 1
+        if self.use_async and single_process:
+            # snapshot to host BEFORE handing to orbax: the engine donates
+            # the state buffers to the next train step, and the background
+            # write would read the post-donation bytes — a torn checkpoint
+            # (real copy, not a view: np.asarray of a CPU jax array aliases
+            # the same donated buffer). This host copy is the price of
+            # correct async checkpointing; the write itself stays deferred.
+            import numpy as np
+            state = jax.tree.map(lambda x: np.array(jax.device_get(x)), state)
+        self._ckptr.save(os.path.join(staging, "state"), state, force=True)
+        if self.use_async and not single_process:
+            # multi-process shards span non-addressable devices — no host
+            # snapshot is possible, and letting the background write race
+            # the next step's donation tears the checkpoint. Degrade to a
+            # synchronous wait: correctness over save latency, loudly.
+            log_dist("async checkpointing on a multi-process mesh: waiting for the "
+                     "write before returning (donated state buffers cannot be "
+                     "snapshotted host-side; a deferred write would race the next "
+                     "step's donation)")
+            self._ckptr.wait_until_finished()
+        # the per-leaf checksum SOURCE: hashed at finalize (off the step
+        # path — async saves must not stall the loop sha256-ing gigabytes);
+        # for async this is the host snapshot, so it stays valid however
+        # late commit() runs. Single-process only — multi-process shards
+        # are not host-addressable; the file inventory still covers this host.
+        leaf_src = state if single_process else None
         if not self.use_async:
             # StandardCheckpointer finalizes asynchronously; without this a
             # process exit right after save_checkpoint() leaves a torn
@@ -42,28 +99,97 @@ class OrbaxCheckpointEngine(CheckpointEngine):
             # commit(tag) before treating the checkpoint as durable.
             self._ckptr.wait_until_finished()
         if metadata is not None and jax.process_index() == 0:
-            with open(os.path.join(path, "metadata.json"), "w") as f:
+            with open(os.path.join(staging, "metadata.json"), "w") as f:
                 json.dump(metadata, f)
-        log_dist(f"saved checkpoint {tag} -> {path}"
+        self._staged[tag] = (staging, leaf_src)
+        log_dist(f"saved checkpoint {tag} -> staged at {staging}"
                  + (" (async, pending commit)" if self.use_async else ""))
+        if not defer_finalize and not self.use_async:
+            self.finalize(tag)
+
+    def finalize(self, tag):
+        """Manifest + fsync + atomic rename: the publish barrier. After this
+        returns, the tag is visible and verifiable; before it, invisible.
+        Multi-process: rank 0 owns the publish (all ranks staged into the
+        same shared-fs dir); callers barrier around this."""
+        tag = str(tag)
+        staging, leaf_src = self._staged.pop(tag)
+        if jax.process_index() != 0:
+            return
+        leaf_entries = (ckpt_manifest.state_leaf_entries(leaf_src)
+                        if leaf_src is not None else None)
+        ckpt_manifest.write_manifest(
+            staging, ckpt_manifest.build_manifest(staging, leaf_entries=leaf_entries))
+        fault_point("ckpt_pre_rename")  # torn-save injection: die between staging and publish
+        ckpt_manifest.atomic_publish(staging, self._path(tag))
+        log_dist(f"published checkpoint {tag} -> {self._path(tag)}")
 
     def commit(self, tag):
-        """Block until every staged write for ``tag`` is durable (async
-        mode's second half; a no-op after synchronous saves)."""
+        """Block until every staged write for ``tag`` is durable and the tag
+        is atomically published (async mode's second half; a no-op after
+        synchronous saves, which finalize inline). All ranks must call this:
+        the barrier between the wait and the publish keeps rank 0 from
+        hashing/renaming a staging dir a lagging rank is still writing."""
         self._ckptr.wait_until_finished()
+        if str(tag) in self._staged:
+            from deepspeed_tpu import comm as dist
+            dist.barrier()
+            self.finalize(tag)
         log_dist(f"committed checkpoint {tag}")
         return True
 
-    def load(self, state, shardings, tag, load_optimizer_states=True, load_module_only=False):
+    def load(self, state, shardings, tag, load_optimizer_states=True, load_module_only=False,
+             verify: str = "full"):
+        """Restore ``tag``. ``verify``: "off" skips integrity checks, "files"
+        gates on the manifest's file inventory before deserializing, "full"
+        additionally re-hashes every restored leaf against its save-time
+        digest. Raises :class:`CheckpointCorruptError` on any mismatch."""
         path = self._path(tag)
+        man = None
+        if verify in ("files", "full"):
+            if jax.process_count() == 1:
+                man = ckpt_manifest.verify_checkpoint_dir(path)
+            else:
+                # rank 0 verifies, everyone follows its verdict: per-rank
+                # hashing would multiply shared-fs I/O by world size AND a
+                # divergent verdict (transient read error on one host) would
+                # send ranks into the collective restore with different
+                # tags — the fallback scan must advance in lockstep
+                import numpy as np
+                from jax.experimental import multihost_utils
+                ok = True
+                if jax.process_index() == 0:
+                    try:
+                        ckpt_manifest.verify_checkpoint_dir(path)
+                    except ckpt_manifest.CheckpointCorruptError as e:
+                        ok = False
+                        from deepspeed_tpu.utils.logging import logger
+                        logger.error(str(e))
+                ok = bool(multihost_utils.broadcast_one_to_all(np.asarray(ok)))
+                if not ok:
+                    raise CheckpointCorruptError(
+                        f"checkpoint {path} failed rank-0 integrity verification "
+                        f"(see rank-0 log for the file-level detail)")
         abstract = jax.tree.map(
             lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s), state, shardings)
-        restored = self._ckptr.restore(os.path.join(path, "state"), abstract)
+        try:
+            restored = self._ckptr.restore(os.path.join(path, "state"), abstract)
+        except CheckpointCorruptError:
+            raise
+        except Exception as e:
+            # a deserialization failure on a verified-or-manifestless dir is
+            # still corruption from the caller's viewpoint (torn pre-manifest
+            # save, tensorstore metadata damage): classify it so the engine's
+            # fallback scan can act instead of crashing the resume
+            raise CheckpointCorruptError(
+                f"checkpoint {path} failed to deserialize: {type(e).__name__}: {e}")
         # the restored state flows into the DONATED train step: re-own the
         # buffers (tensorstore views are not jax-owned; donating them
         # corrupts the heap on CPU jaxlib 0.4.x — utils/device.py)
         from deepspeed_tpu.utils.device import owned_device_put
         restored = owned_device_put(restored, shardings)
+        if verify == "full" and jax.process_count() == 1:
+            ckpt_manifest.verify_state_leaves(restored, man or {}, ckpt_dir=path)
         if load_module_only or not load_optimizer_states:
             # keep current optimizer state / counters, take params only
             restored = state._replace(params=restored.params) if load_module_only else \
@@ -73,5 +199,6 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         if os.path.exists(meta_path):
             with open(meta_path) as f:
                 meta = json.load(f)
-        log_dist(f"loaded checkpoint {tag} from {path}")
+        log_dist(f"loaded checkpoint {tag} from {path}"
+                 + (f" (verified: {verify})" if verify in ("files", "full") else ""))
         return restored, meta

@@ -144,6 +144,34 @@ class NebulaConfig(DeepSpeedConfigModel):
     load_path: Optional[str] = None
 
 
+class ResilienceConfig(DeepSpeedConfigModel):
+    """Fault-tolerance block (TPU-native; no single reference analog — it
+    federates the reference's nebula/elasticity/loss-scaler recovery
+    behaviors into one policy surface). See ``runtime/resilience/``.
+
+    ``verify_checkpoint``: integrity gate on load — "full" (file inventory
+    before restore + per-leaf checksums after), "files", or "off".
+    ``fallback_on_corruption``: a corrupt tag falls back to the newest
+    intact one (loud monitor event) instead of raising.
+    ``max_consecutive_overflows``: abort training after K consecutive
+    overflow-skipped steps (0 = disabled) — a poisoned run fails fast
+    instead of silently skipping forever.
+    ``heartbeat_interval``: minimum seconds between elastic-agent
+    heartbeat touches from the train loop (cadenced, off the hot path).
+    ``preempt_save_dir``: when set, SIGTERM/SIGINT trigger a checkpoint at
+    the next step boundary (then exit ``preempt_exit_code`` if
+    ``exit_after_preempt_save``) — preemption costs one step, not the run.
+    """
+    verify_checkpoint: str = Field("full", pattern="^(off|files|full)$")
+    fallback_on_corruption: bool = True
+    max_consecutive_overflows: int = Field(0, ge=0)
+    heartbeat_interval: float = Field(2.0, ge=0.0)
+    preempt_save_dir: Optional[str] = None
+    preempt_signals: list = ["SIGTERM", "SIGINT"]
+    exit_after_preempt_save: bool = True
+    preempt_exit_code: int = 143
+
+
 class DeepSpeedConfig:
     """Parses and validates the full config (reference ``DeepSpeedConfig``,
     ``runtime/config.py``)."""
@@ -245,6 +273,7 @@ class DeepSpeedConfig:
         self.moe_config = MoEConfig(**param_dict.get(C.MOE, {}))
         self.checkpoint_config = CheckpointConfig(**param_dict.get(C.CHECKPOINT, {}))
         self.nebula_config = NebulaConfig(**param_dict.get(C.NEBULA, {}))
+        self.resilience_config = ResilienceConfig(**param_dict.get(C.RESILIENCE, {}))
         self.hybrid_engine_config = HybridEngineConfig(**param_dict.get("hybrid_engine", {}))
         self.autotuning_config = param_dict.get(C.AUTOTUNING, {})
         self.elasticity_config = param_dict.get(C.ELASTICITY, {})

@@ -136,4 +136,5 @@ PROGRESSIVE_LAYER_DROP = "progressive_layer_drop"
 QUANTIZE_TRAINING = "quantize_training"
 CHECKPOINT = "checkpoint"
 NEBULA = "nebula"
+RESILIENCE = "resilience"
 DATA_TYPES = "data_types"

@@ -37,8 +37,10 @@ from deepspeed_tpu.ops.lamb.fused_lamb import fused_lamb
 from deepspeed_tpu.parallel.topology import MeshTopology
 from deepspeed_tpu.runtime import constants as C
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
-from deepspeed_tpu.runtime.fp16.loss_scaler import LossScaleState, create_loss_scaler, has_overflow
+from deepspeed_tpu.runtime.fp16.loss_scaler import (LossScaleState, OverflowWatcher, create_loss_scaler,
+                                                    has_overflow)
 from deepspeed_tpu.runtime.lr_schedules import get_lr_schedule
+from deepspeed_tpu.runtime.resilience.faults import fault_point
 from deepspeed_tpu.runtime.zero.planner import ZeroPlan, build_plan, resolve_topology_axes
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import (TRAIN_BATCH_TIMER, NoopTimer, SynchronizedWallClockTimer, ThroughputTimer)
@@ -204,6 +206,18 @@ class DeepSpeedEngine:
                                           steps_per_output=config.steps_per_print)
         from deepspeed_tpu.monitor.monitor import MonitorMaster
         self.monitor = MonitorMaster(config.monitor_config)
+
+        # -- resilience (runtime/resilience): host mirror of the compiled
+        #    overflow-skip state + preemption-to-checkpoint signal handling
+        _rcfg = config.resilience_config
+        self._overflow_watcher = OverflowWatcher(abort_after=_rcfg.max_consecutive_overflows)
+        self._resilience_events = []  # buffered monitor events from drains/fallbacks
+        self._preemption = None
+        self._preempt_save_dir = None
+        self._preempt_exit = bool(_rcfg.exit_after_preempt_save)
+        self._preempt_exit_code = int(_rcfg.preempt_exit_code)
+        if _rcfg.preempt_save_dir:
+            self.enable_preemption_checkpoint(_rcfg.preempt_save_dir)
 
         self.training_dataloader = None
         if training_data is not None:
@@ -1923,13 +1937,28 @@ class DeepSpeedEngine:
         self.tput_timer.stop(global_step=True)
         # every step in the stack counts toward overflow accounting, not just
         # the last one (_post_step sees a scalar; the stack's total lands here)
-        n_over = int(np.sum(np.asarray(jax.device_get(metrics["overflow"]))))
+        ov_steps = np.asarray(jax.device_get(metrics["overflow"]))
+        ls_steps = np.asarray(jax.device_get(metrics["loss_scale"]))
+        n_over = int(np.sum(ov_steps))
         last = jax.tree.map(lambda m: m[-1], metrics)
         if n_over:
             self.skipped_steps += n_over
             log_dist(f"{n_over}/{n_steps} steps in the fused stack overflowed; "
                      f"updates skipped, loss scale -> {float(last['loss_scale'])}")
-        last = dict(last, overflow=jnp.asarray(False))  # counted above
+        # per-step flags (already host-synced above) feed the overflow
+        # watcher so streaks inside a fused stack trip the same guard the
+        # per-dispatch path does. Drain first: earlier per-dispatch steps
+        # may still sit in _pending_overflow, and the watcher must see
+        # flags in step order or a stale streak replays after clean steps
+        self._drain_overflows()
+        first = self.global_steps - n_steps
+        for i in range(n_steps):
+            self._record_overflow(first + i + 1, bool(ov_steps[i]), float(ls_steps[i]))
+        # drop the key entirely (not overflow=False): a synthetic clean flag
+        # for the final step would reach the watcher at the next drain and
+        # zero a streak the real per-step flags above just built — the
+        # abort-after-K guard must see fused stacks exactly as per-dispatch
+        last = {k: v for k, v in last.items() if k != "overflow"}  # counted above
         self._post_step(last)
         self._maybe_trace_window()
         return metrics["loss"]
@@ -2210,9 +2239,10 @@ class DeepSpeedEngine:
         # boundaries, or when the pending-overflow window fills.
         # liveness signal for DSElasticAgent supervision: a cheap utime when
         # DS_ELASTIC_HEARTBEAT_FILE is set, a no-op otherwise — no device
-        # sync involved, so it does not serialize dispatch
+        # sync involved, and cadenced (resilience.heartbeat_interval) so the
+        # steady state costs one time-read per step, one utime per interval
         from deepspeed_tpu.elasticity.elastic_agent import touch_heartbeat
-        touch_heartbeat()
+        touch_heartbeat(min_interval=self.config.resilience_config.heartbeat_interval)
         if self.progressive_layer_drop is not None:
             # host mirror of the in-graph schedule (reference update_state)
             self.progressive_layer_drop.update_state(self.global_steps)
@@ -2229,6 +2259,8 @@ class DeepSpeedEngine:
         if self.monitor.enabled and self.global_steps % self.config.steps_per_print == 0:
             events = [(f"Train/loss", float(metrics.get("loss", 0.0)), self.global_samples),
                       (f"Train/lr", self.get_lr()[0], self.global_samples)]
+            if self._resilience_events:
+                events, self._resilience_events = events + self._resilience_events, []
             if self._fp16_mode:
                 events.append((f"Train/loss_scale", float(metrics["loss_scale"]), self.global_samples))
             batch = getattr(self, "_last_batch_for_stats", None)
@@ -2242,6 +2274,13 @@ class DeepSpeedEngine:
             self.monitor.write_events(events)
         if self.config.wall_clock_breakdown and self.global_steps % self.config.steps_per_print == 0:
             self.timers.log([TRAIN_BATCH_TIMER])
+        # deterministic process-death injection (resilience/faults.py): armed
+        # only via DS_FAULT_SPEC, otherwise one cached dict lookup
+        fault_point("step", step=self.global_steps)
+        # a SIGTERM/SIGINT that landed mid-step is honored HERE, at the step
+        # boundary, with a normal verified checkpoint — preemption costs one
+        # step, not the run
+        self._maybe_preempt_checkpoint()
 
     # ------------------------------------------------------------------
     # accessors (parity with engine property surface, engine.py:474-855)
@@ -2347,13 +2386,28 @@ class DeepSpeedEngine:
 
     def _drain_overflows(self):
         """Resolve deferred per-step overflow flags (host sync happens HERE,
-        off the dispatch critical path)."""
+        off the dispatch critical path). Each drained flag also feeds the
+        overflow watcher: loss-scale-cut / skip-streak monitor events, and
+        the abort-after-K guard (``resilience.max_consecutive_overflows``
+        raises ``OverflowAbort`` — a poisoned run fails fast)."""
         pending, self._pending_overflow = self._pending_overflow, []
         for step, ov, ls in pending:
+            ls_f = float(ls) if ls is not None else None
             if bool(ov):
                 self._skipped_steps += 1
-                ls_txt = f", loss scale -> {float(ls)}" if ls is not None else ""
+                ls_txt = f", loss scale -> {ls_f}" if ls_f is not None else ""
                 log_dist(f"step {step} overflow: skipped update{ls_txt}")
+            self._record_overflow(step, bool(ov), ls_f)
+
+    def _record_overflow(self, step, overflow: bool, loss_scale):
+        """One host-resolved per-step flag → watcher events (buffered for the
+        next monitor write) + the fail-fast guard."""
+        events = self._overflow_watcher.record(step, overflow, loss_scale)
+        if self.monitor.enabled and events:
+            # monitor x-axis is samples, like the Train/* series
+            self._resilience_events.extend(
+                (tag, value, ev_step * self.config.train_batch_size)
+                for tag, value, ev_step in events)
 
     @property
     def skipped_steps(self) -> int:
@@ -2371,6 +2425,108 @@ class DeepSpeedEngine:
     @property
     def module_params(self):
         return self.state.params if self.state is not None else None
+
+    # ------------------------------------------------------------------
+    # resilience: preemption-to-checkpoint + verified resume
+    # ------------------------------------------------------------------
+    def enable_preemption_checkpoint(self, save_dir, signals=None, exit_after_save=None,
+                                     exit_code=None):
+        """Arm preemption-safe checkpointing: SIGTERM/SIGINT set a flag (the
+        handler does nothing else — async-signal-safe), and the next step
+        boundary saves a verified checkpoint to ``save_dir``, then exits
+        ``exit_code`` (143 by default, so a supervisor relaunches instead of
+        reading the exit as job-finished). Config path: the
+        ``resilience.preempt_save_dir`` key arms this at engine init."""
+        from deepspeed_tpu.runtime.resilience.signals import PreemptionGuard
+        rcfg = self.config.resilience_config
+        self._preempt_save_dir = save_dir
+        if exit_after_save is not None:
+            self._preempt_exit = bool(exit_after_save)
+        if exit_code is not None:
+            self._preempt_exit_code = int(exit_code)
+        if self._preemption is not None:
+            self._preemption.uninstall()
+        self._preemption = PreemptionGuard(signals or rcfg.preempt_signals).install()
+        log_dist(f"preemption checkpointing armed: {self._preemption.signal_names} -> "
+                 f"checkpoint at next step boundary -> {save_dir}")
+        return self._preemption
+
+    def _maybe_preempt_checkpoint(self):
+        g = self._preemption
+        if g is None:
+            return
+        requested = g.requested
+        if jax.process_count() > 1:
+            # the signal rarely reaches every host inside the same step: the
+            # boundary decision must be COLLECTIVE (any rank's flag → all
+            # ranks save now), or ranks enter the collective save at
+            # different steps and deadlock. Armed multi-host runs pay one
+            # small host allgather per boundary for this.
+            try:
+                from jax.experimental import multihost_utils
+                requested = bool(np.any(multihost_utils.process_allgather(
+                    np.asarray(requested))))
+            except Exception as e:  # noqa: BLE001 — no host collectives (old CPU jaxlib)
+                if not getattr(self, "_warned_preempt_sync", False):
+                    self._warned_preempt_sync = True
+                    logger.warning(f"preemption flag cannot be synchronized across "
+                                   f"processes ({e}); falling back to local signals — "
+                                   f"deliver the signal to every host")
+        if not requested:
+            return
+        sig = g.consume() or "peer-rank signal"
+        log_dist(f"preemption signal {sig}: checkpointing at step boundary "
+                 f"{self.global_steps} -> {self._preempt_save_dir}")
+        self.save_checkpoint(self._preempt_save_dir)
+        self.flush_checkpoints()  # durability before the exit below
+        if self.monitor.enabled:
+            self.monitor.write_events([
+                ("Resilience/preempt_checkpoint", float(self.global_steps), self.global_samples)])
+        if self._preempt_exit:
+            log_dist(f"preemption checkpoint durable; exiting {self._preempt_exit_code}")
+            raise SystemExit(self._preempt_exit_code)
+
+    def resume(self, load_dir=None, tag=None):
+        """Preemption-safe auto-resume: restore from the newest intact
+        checkpoint under ``load_dir`` (default: the armed preemption dir).
+        Restores the full timeline — params/optimizer/``state.step`` (which
+        the LR schedule reads), dynamic loss scale, and the step counters
+        the per-step RNG folds in — so the continued run is bit-exact with
+        the uninterrupted one (tests/unit/resilience/test_resume_parity).
+
+        Tolerates a crash between checkpoint publish and the ``latest``
+        marker: with no/stale marker it resolves the newest intact tag
+        directly. Returns ``(tag, client_state)`` — ``(None, {})`` means no
+        checkpoint exists yet (fresh start)."""
+        from deepspeed_tpu.runtime.resilience.manifest import (list_checkpoint_tags,
+                                                               sweep_stale_staging)
+        load_dir = load_dir or self._preempt_save_dir
+        assert load_dir, "resume() needs a load_dir (or an armed resilience.preempt_save_dir)"
+        # an in-flight async save stages under .tmp.<tag> in this very dir:
+        # it must be committed before the sweep below, or the sweep would
+        # destroy the live staging mid-write
+        self.flush_checkpoints()
+        # crash-window recovery: a tag overwrite killed between its displace
+        # and publish renames left the intact copy under a .tmp.<tag>.old.*
+        # name — restore it before listing
+        if dist.get_rank() == 0:
+            sweep_stale_staging(load_dir)
+        dist.barrier()
+        tags = list_checkpoint_tags(load_dir)
+        if not tags:
+            log_dist(f"resume: no checkpoints under {load_dir}; fresh start")
+            return None, {}
+        if tag is None and not os.path.exists(os.path.join(load_dir, "latest")):
+            logger.warning(f"resume: {load_dir} has tags but no 'latest' marker (crash "
+                           f"between publish and marker?); using newest intact tag")
+            tag = tags[0]
+        path, client = self.load_checkpoint(load_dir, tag=tag)
+        if path is None:
+            return None, {}
+        loaded = getattr(self, "_loaded_checkpoint_tag", tag)
+        log_dist(f"resumed from checkpoint {loaded} at step {self.global_steps} "
+                 f"(samples {self.global_samples}, loss scale {float(self.cur_scale)})")
+        return loaded, client
 
     # ------------------------------------------------------------------
     # checkpointing (reference engine.py:2906 save / 2601 load)
@@ -2394,14 +2550,18 @@ class DeepSpeedEngine:
         }
         if self.curriculum_scheduler is not None:
             meta["curriculum_state"] = self.curriculum_scheduler.get_state()
-        engine.save(self.state, tag, metadata=meta)
+        # stage-then-publish: state AND the extra per-rank files below land
+        # in the staging dir and become visible in ONE atomic rename
+        # (finalize) — a killed writer never leaves a partial tag
+        engine.save(self.state, tag, metadata=meta, defer_finalize=True)
+        stage = engine.staging_dir(tag)
         if self._zeroone_runner is not None:
             # pending local updates (u) + error feedback are optimizer state.
             # state_dict() runs a process_allgather on multi-host meshes, so
             # EVERY rank must call it; only the write is rank-0
             zo_state = self._zeroone_runner.state_dict()
             if dist.get_rank() == 0:
-                np.save(os.path.join(save_dir, tag, "zeroone_state.npy"),
+                np.save(os.path.join(stage, "zeroone_state.npy"),
                         zo_state, allow_pickle=True)
         if getattr(self, "_host_opt", None) is not None:
             # offloaded optimizer state (host masters + moments bookkeeping).
@@ -2412,7 +2572,7 @@ class DeepSpeedEngine:
                      if getattr(self, "_host_shard_mode", False)
                      else "host_optimizer.npy")
             if getattr(self, "_host_shard_mode", False) or dist.get_rank() == 0:
-                np.save(os.path.join(save_dir, tag, fname),
+                np.save(os.path.join(stage, fname),
                         {"opt": self._host_opt.state_dict(),
                          "masters": self._host_masters}, allow_pickle=True)
         if use_async:
@@ -2445,24 +2605,26 @@ class DeepSpeedEngine:
                 register(_flush_on_exit)
                 self._flush_atexit = True
             return True
+        dist.barrier()  # all ranks' staged writes land before the publish
+        engine.finalize(tag)  # manifest + fsync + atomic rename (rank-0 rename)
         if save_latest and dist.get_rank() == 0:
-            with open(os.path.join(save_dir, "latest"), "w") as f:
-                f.write(tag)
+            from deepspeed_tpu.runtime.resilience.manifest import write_atomic_text
+            write_atomic_text(os.path.join(save_dir, "latest"), tag)
         dist.barrier()
         return True
 
     def flush_checkpoints(self):
         """Commit any pending async checkpoint (reference Nebula's persist
-        boundary): blocks until the write is durable, then publishes its
-        ``latest`` marker."""
+        boundary): blocks until the write is durable and atomically
+        published, then writes its ``latest`` marker."""
         pending = getattr(self, "_pending_ckpt", None)
         if pending is None:
             return
         engine, save_dir, tag, save_latest = pending
-        engine.commit(tag)
+        engine.commit(tag)  # wait for staged writes (all ranks), then finalize
         if save_latest and dist.get_rank() == 0:
-            with open(os.path.join(save_dir, "latest"), "w") as f:
-                f.write(tag)
+            from deepspeed_tpu.runtime.resilience.manifest import write_atomic_text
+            write_atomic_text(os.path.join(save_dir, "latest"), tag)
         dist.barrier()
         self._pending_ckpt = None
 
@@ -2508,7 +2670,10 @@ class DeepSpeedEngine:
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False):
         from deepspeed_tpu.runtime.checkpoint_engine.orbax_engine import OrbaxCheckpointEngine
+        from deepspeed_tpu.runtime.resilience.manifest import (CheckpointCorruptError,
+                                                               list_checkpoint_tags)
         self.flush_checkpoints()  # an async save must be durable before any load
+        explicit_tag = tag is not None
         if tag is None:
             latest = os.path.join(load_dir, "latest")
             if not os.path.exists(latest):
@@ -2519,9 +2684,59 @@ class DeepSpeedEngine:
         engine = OrbaxCheckpointEngine(load_dir)
         assert self.state is not None, ("initialize_state(example_batch) (or one train_batch) must run "
                                         "before load_checkpoint so shardings are known")
-        restored, meta = engine.load(self.state, self.state_shardings, tag,
-                                     load_optimizer_states=load_optimizer_states,
-                                     load_module_only=load_module_only)
+        # verified load with corruption fallback: the requested tag first,
+        # then intact tags STRICTLY OLDER than it, newest-first — a
+        # truncated or bit-flipped checkpoint costs the steps since the
+        # previous intact one, never a crash and never silently-loaded
+        # garbage. Never fall FORWARD: an explicit older-tag request (e.g.
+        # rolling back past a divergence) must not resolve to the newer
+        # state the caller is escaping.
+        rcfg = self.config.resilience_config
+        candidates = [tag]
+        if rcfg.fallback_on_corruption:
+            all_tags = list_checkpoint_tags(load_dir)
+            if tag in all_tags:
+                older = all_tags[all_tags.index(tag) + 1:]
+            elif not explicit_tag:
+                # marker-resolved tag so torn it isn't even listable: every
+                # listed tag predates the marker's save — all are older
+                older = all_tags
+            else:
+                # an EXPLICIT tag of unknown position: any fallback risks
+                # falling forward — refuse and fail loudly below instead
+                older = []
+            candidates += [t for t in older if t != tag]
+        restored = meta = None
+        loaded_tag = None
+        last_err = None
+        for cand in candidates:
+            try:
+                restored, meta = engine.load(self.state, self.state_shardings, cand,
+                                             load_optimizer_states=load_optimizer_states,
+                                             load_module_only=load_module_only,
+                                             verify=rcfg.verify_checkpoint)
+                loaded_tag = cand
+                break
+            except CheckpointCorruptError as e:
+                last_err = e
+                logger.error(f"checkpoint {cand} at {load_dir} is corrupt: {e}")
+                if self.monitor.enabled:
+                    self.monitor.write_events(
+                        [("Resilience/checkpoint_corrupt", 1.0, self.global_samples)])
+                if not rcfg.fallback_on_corruption:
+                    raise
+        if loaded_tag is None:
+            raise CheckpointCorruptError(
+                f"no intact checkpoint under {load_dir} (tried {candidates}); "
+                f"last error: {last_err}")
+        if loaded_tag != tag:
+            logger.error(f"fell back from corrupt checkpoint {tag} to newest intact "
+                         f"tag {loaded_tag} — training resumes from the older state")
+            if self.monitor.enabled:
+                self.monitor.write_events(
+                    [("Resilience/checkpoint_fallback", 1.0, self.global_samples)])
+        tag = loaded_tag
+        self._loaded_checkpoint_tag = loaded_tag
         self.state = restored
         if self._zeroone_runner is not None and load_optimizer_states:
             zo_path = os.path.join(load_dir, tag, "zeroone_state.npy")

@@ -68,6 +68,61 @@ def create_loss_scaler(static_loss_scale: float = 0.0,
     return state, update
 
 
+class OverflowAbort(RuntimeError):
+    """Raised by :class:`OverflowWatcher` when a run skips
+    ``max_consecutive_overflows`` updates in a row: persistent non-finite
+    gradients mean the run is poisoned (bad data shard, diverged params,
+    numerics bug) — failing fast beats silently skipping forever while the
+    loss scale grinds down to ``min_scale``."""
+
+
+class OverflowWatcher:
+    """Host-side mirror of the compiled overflow-skip state.
+
+    The scale cuts and skip streaks happen *inside* the jitted step, where
+    nothing host-readable observes them. The engine drains per-step
+    overflow flags lazily (``_drain_overflows``); each drained flag is fed
+    here, and the watcher turns the stream into monitor events —
+    ``Train/loss_scale_cut`` whenever the dynamic scale dropped,
+    ``Train/consecutive_overflow_skips`` tracking the streak — plus the
+    abort-after-K guard (``resilience.max_consecutive_overflows``)."""
+
+    def __init__(self, abort_after: int = 0):
+        self.abort_after = int(abort_after)
+        self.consecutive = 0
+        self.longest_streak = 0
+        self.total_skipped = 0
+        self._last_scale = None
+
+    def record(self, step: int, overflow: bool, loss_scale=None):
+        """Feed one drained (step, overflow, post-step loss_scale) tuple;
+        returns monitor events for it. Raises :class:`OverflowAbort` when
+        the streak reaches the configured guard."""
+        events = []
+        scale = float(loss_scale) if loss_scale is not None else None
+        if overflow:
+            self.consecutive += 1
+            self.total_skipped += 1
+            self.longest_streak = max(self.longest_streak, self.consecutive)
+            events.append(("Train/consecutive_overflow_skips", self.consecutive, step))
+            if scale is not None and self._last_scale is not None and scale < self._last_scale:
+                events.append(("Train/loss_scale_cut", scale, step))
+        else:
+            if self.consecutive:
+                # close the streak so dashboards show recovery, not a flat line
+                events.append(("Train/consecutive_overflow_skips", 0, step))
+            self.consecutive = 0
+        if scale is not None:
+            self._last_scale = scale
+        if self.abort_after and self.consecutive >= self.abort_after:
+            raise OverflowAbort(
+                f"{self.consecutive} consecutive overflow-skipped steps (through step "
+                f"{step}); gradients are persistently non-finite"
+                + (f", loss scale {scale}" if scale is not None else "")
+                + f" — aborting per resilience.max_consecutive_overflows={self.abort_after}")
+        return events
+
+
 def has_overflow(grads) -> jax.Array:
     """Global overflow check: any non-finite value in any grad (reference
     ``has_overflow_serial``/partitioned variants; the psum across ranks is

@@ -1,0 +1,328 @@
+"""Checkpoint integrity manifests + crash-atomic publish.
+
+A checkpoint that cannot prove it is intact is a liability: a torn write
+(process killed mid-save) or storage corruption (truncated tensorstore
+chunk, flipped bit) surfaces as garbage params *at the resume that
+matters most*. Two independent layers close that:
+
+* **Atomicity** — saves land in a ``.tmp.<tag>.<pid>`` staging dir and
+  are published with fsync + ``os.replace``-style rename
+  (:func:`atomic_publish`). A tag directory either exists complete or
+  not at all; stale staging dirs from killed processes are inert and
+  swept by the next save.
+* **Verification** — ``manifest.json`` inside the tag records (a) a file
+  inventory (relpath → size + sha256) checked *before* restore, so a
+  truncated or bit-flipped file is caught without deserializing it, and
+  (b) per-leaf shape/dtype/sha256 of the saved train-state pytree,
+  re-checked against the restored arrays *after* restore, so the
+  end-to-end storage round trip is proven, not assumed.
+
+Multi-process meshes: each process addresses only its shards, so leaf
+hashing is recorded (and verified) only when ``jax.process_count() == 1``;
+the file inventory still covers whatever this host wrote.
+"""
+
+import hashlib
+import json
+import os
+from typing import Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+MANIFEST_NAME = "manifest.json"
+MANIFEST_VERSION = 1
+_STAGING_PREFIX = ".tmp."
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed integrity verification (torn, truncated, or
+    bit-flipped). Callers fall back to the newest intact tag
+    (``DeepSpeedEngine.load_checkpoint``) or surface the failure loudly —
+    never load the garbage."""
+
+
+def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                return h.hexdigest()
+            h.update(block)
+
+
+def _leaf_key(path) -> str:
+    import jax
+    return jax.tree_util.keystr(path)
+
+
+def state_leaf_entries(state) -> dict:
+    """``{leaf_key: {shape, dtype, sha256}}`` over a (host-fetchable) state
+    pytree. Bytes are hashed C-contiguous so the digest is layout-stable."""
+    import jax
+    import numpy as np
+
+    entries = {}
+    leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+    for path, leaf in leaves:
+        arr = np.ascontiguousarray(np.asarray(jax.device_get(leaf)))
+        entries[_leaf_key(path)] = {
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+            "sha256": hashlib.sha256(arr.tobytes()).hexdigest(),
+        }
+    return entries
+
+
+def file_inventory(root: str) -> dict:
+    """``{relpath: {bytes, sha256}}`` for every file under ``root`` (the
+    manifest itself excluded — it cannot contain its own hash)."""
+    inv = {}
+    for dirpath, _, filenames in os.walk(root):
+        for name in filenames:
+            full = os.path.join(dirpath, name)
+            rel = os.path.relpath(full, root)
+            if rel == MANIFEST_NAME:
+                continue
+            inv[rel] = {"bytes": os.path.getsize(full), "sha256": _sha256_file(full)}
+    return inv
+
+
+def build_manifest(ckpt_dir: str, leaf_entries: Optional[dict] = None,
+                   extra: Optional[dict] = None) -> dict:
+    manifest = {
+        "version": MANIFEST_VERSION,
+        "files": file_inventory(ckpt_dir),
+        "leaves": leaf_entries,  # None on multi-process saves (shards not addressable)
+    }
+    if extra:
+        manifest.update(extra)
+    return manifest
+
+
+def write_manifest(ckpt_dir: str, manifest: dict) -> str:
+    path = os.path.join(ckpt_dir, MANIFEST_NAME)
+    with open(path, "w") as f:
+        json.dump(manifest, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    return path
+
+
+def read_manifest(ckpt_dir: str) -> Optional[dict]:
+    path = os.path.join(ckpt_dir, MANIFEST_NAME)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(f"unreadable manifest at {path}: {e}")
+
+
+def fsync_tree(root: str) -> None:
+    """fsync every file and directory under ``root`` (and ``root`` itself):
+    the durability barrier before the atomic rename — without it the
+    rename can land on disk before the data it publishes."""
+    for dirpath, _, filenames in os.walk(root):
+        for name in filenames:
+            fd = os.open(os.path.join(dirpath, name), os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        fd = os.open(dirpath, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def staging_path(base_dir: str, tag: str) -> str:
+    # deterministic (pid-less): on multi-process meshes every rank must
+    # stage into the SAME directory (orbax writes shards collectively);
+    # stale dirs from crashed saves are swept, not avoided
+    return os.path.join(base_dir, f"{_STAGING_PREFIX}{tag}")
+
+
+_DISPLACED_RE = None  # compiled lazily: `.tmp.<tag>.old.<pid>`
+
+
+def sweep_stale_staging(base_dir: str, exclude=None) -> None:
+    """Clean up after crashed saves. Plain ``.tmp.<tag>`` staging dirs are
+    inert partial writes and are removed. ``.tmp.<tag>.old.<pid>`` dirs are
+    different: they hold the INTACT previous copy of a tag displaced
+    mid-overwrite — if the publish crashed between its two renames the
+    published ``<tag>`` is gone, and deleting the displaced copy would lose
+    the only surviving checkpoint. Those are RESTORED to ``<tag>`` when the
+    tag is missing, removed only when the overwrite completed.
+
+    ``exclude``: staging dir(s) of saves currently in flight (a path or a
+    collection of paths) — on multi-process meshes another rank's
+    collective write may already be populating them, and sweeping one
+    mid-write would destroy the shards (callers also rank-gate the sweep
+    for the same reason)."""
+    import re
+    import shutil
+    global _DISPLACED_RE
+    if _DISPLACED_RE is None:
+        _DISPLACED_RE = re.compile(re.escape(_STAGING_PREFIX) + r"(.+)\.old\.\d+$")
+    if not os.path.isdir(base_dir):
+        return
+    if exclude is None:
+        keep = set()
+    elif isinstance(exclude, str):
+        keep = {os.path.basename(exclude)}
+    else:
+        keep = {os.path.basename(e) for e in exclude}
+    for name in sorted(os.listdir(base_dir)):
+        if not name.startswith(_STAGING_PREFIX) or name in keep:
+            continue
+        full = os.path.join(base_dir, name)
+        m = _DISPLACED_RE.match(name)
+        if m is not None:
+            tag_dir = os.path.join(base_dir, m.group(1))
+            if not os.path.exists(tag_dir):
+                logger.error(f"restoring displaced checkpoint {name} -> {m.group(1)}: "
+                             f"a tag overwrite crashed between displace and publish")
+                os.rename(full, tag_dir)
+                continue
+        logger.warning(f"sweeping stale checkpoint staging dir {name} "
+                       f"(a previous save was interrupted mid-write)")
+        shutil.rmtree(full, ignore_errors=True)
+
+
+def atomic_publish(staging_dir: str, final_dir: str) -> None:
+    """fsync the staged tree, then rename it into place. An existing
+    ``final_dir`` (tag overwrite) is first displaced to
+    ``.tmp.<tag>.old.<pid>`` and removed after the new tree is visible —
+    readers never observe a *partial* tag. A crash between the two renames
+    leaves the tag momentarily absent (plain dir renames cannot swap
+    atomically), but the displaced copy is intact and recognizable:
+    ``list_checkpoint_tags`` never mistakes it for a published tag, and
+    ``sweep_stale_staging`` (run by ``engine.resume`` and by the next
+    save) RESTORES it to ``<tag>`` when the publish never landed, deleting
+    it only once the overwrite completed."""
+    import shutil
+    fsync_tree(staging_dir)
+    displaced = None
+    if os.path.exists(final_dir):
+        displaced = os.path.join(
+            os.path.dirname(final_dir),
+            f"{_STAGING_PREFIX}{os.path.basename(final_dir)}.old.{os.getpid()}")
+        os.rename(final_dir, displaced)
+    os.rename(staging_dir, final_dir)
+    _fsync_dir(os.path.dirname(final_dir) or ".")
+    if displaced is not None:
+        shutil.rmtree(displaced, ignore_errors=True)
+
+
+def write_atomic_text(path: str, text: str) -> None:
+    """Durable single-file publish (the ``latest`` marker): write-to-temp,
+    fsync, rename — a crash leaves either the old marker or the new one,
+    never a torn file."""
+    tmp = path + f".tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path) or ".")
+
+
+def verify_checkpoint_dir(ckpt_dir: str, manifest: Optional[dict] = None) -> dict:
+    """Pre-restore integrity gate: every file in the manifest inventory must
+    exist with matching size and sha256. Returns the manifest. Raises
+    :class:`CheckpointCorruptError` naming every discrepancy. A checkpoint
+    without a manifest (pre-resilience save) passes with a warning — there
+    is nothing to verify against."""
+    if manifest is None:
+        manifest = read_manifest(ckpt_dir)
+    if manifest is None:
+        logger.warning(f"checkpoint {ckpt_dir} has no integrity manifest "
+                       f"(saved before the resilience layer); loading unverified")
+        return {}
+    problems = []
+    for rel, want in (manifest.get("files") or {}).items():
+        full = os.path.join(ckpt_dir, rel)
+        try:
+            if not os.path.exists(full):
+                problems.append(f"missing file {rel}")
+                continue
+            size = os.path.getsize(full)
+            if size != want["bytes"]:
+                problems.append(f"{rel}: size {size} != manifest {want['bytes']} (truncated?)")
+                continue
+            digest = _sha256_file(full)
+        except OSError as e:
+            # an unreadable file IS a failed verification — callers rely on
+            # CheckpointCorruptError to drive the fallback scan (and, on
+            # multi-process loads, to reach the verdict broadcast; a raw
+            # OSError escaping rank 0 would hang the other ranks)
+            problems.append(f"{rel}: unreadable ({e})")
+            continue
+        if digest != want["sha256"]:
+            problems.append(f"{rel}: sha256 mismatch (bit corruption)")
+    if problems:
+        raise CheckpointCorruptError(
+            f"checkpoint {ckpt_dir} failed integrity verification: " + "; ".join(problems))
+    return manifest
+
+
+def verify_state_leaves(state, manifest: dict, ckpt_dir: str = "") -> None:
+    """Post-restore integrity gate: the restored pytree's per-leaf
+    shape/dtype/sha256 must match what was recorded at save. Proves the
+    full storage round trip end to end (tensorstore decode included)."""
+    want = manifest.get("leaves") if manifest else None
+    if not want:
+        return
+    got = state_leaf_entries(state)
+    problems = []
+    for key, entry in want.items():
+        g = got.get(key)
+        if g is None:
+            problems.append(f"leaf {key} missing from restored state")
+        elif g != entry:
+            problems.append(f"leaf {key}: restored {g} != saved {entry}")
+    if problems:
+        raise CheckpointCorruptError(
+            f"restored state from {ckpt_dir or 'checkpoint'} does not match its save-time "
+            f"manifest: " + "; ".join(problems[:8])
+            + (f" (+{len(problems) - 8} more)" if len(problems) > 8 else ""))
+
+
+def list_checkpoint_tags(base_dir: str) -> list:
+    """Published tags under ``base_dir``, newest first. Order: the
+    ``global_steps`` recorded in each tag's metadata (falling back to dir
+    mtime) — the corruption-fallback scan walks this list."""
+    if not os.path.isdir(base_dir):
+        return []
+    tags = []
+    for name in os.listdir(base_dir):
+        full = os.path.join(base_dir, name)
+        if name.startswith(_STAGING_PREFIX) or not os.path.isdir(full):
+            continue
+        if not (os.path.exists(os.path.join(full, "state"))
+                or os.path.exists(os.path.join(full, MANIFEST_NAME))):
+            continue
+        steps = -1
+        meta_path = os.path.join(full, "metadata.json")
+        try:
+            with open(meta_path) as f:
+                steps = int(json.load(f).get("global_steps", -1))
+        except (OSError, ValueError):
+            pass
+        tags.append((steps, os.path.getmtime(full), name))
+    tags.sort(reverse=True)
+    return [name for _, _, name in tags]

@@ -31,7 +31,11 @@ def _engine(nebula: bool):
 def test_async_save_defers_latest_until_flush(tmp_path):
     engine, batch = _engine(nebula=True)
     engine.train_batch(batch)
-    snap = jax.device_get(engine.state.params)
+    # np.array, not device_get alone: on CPU device_get returns a zero-copy
+    # VIEW of the state buffer, which the next donated train step overwrites
+    # in place — the snapshot must be a real copy (the async engine itself
+    # snapshots to host for the same reason)
+    snap = jax.tree.map(np.array, jax.device_get(engine.state.params))
     engine.save_checkpoint(str(tmp_path), tag="tagA")
     # durability marker is deferred — training continues meanwhile
     assert not os.path.exists(tmp_path / "latest")

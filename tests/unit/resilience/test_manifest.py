@@ -1,0 +1,151 @@
+"""Checkpoint integrity manifests + atomic publish (runtime/resilience/
+manifest.py): roundtrip fidelity, corruption detection by class
+(truncation, bit-flip, missing file), staging visibility, tag ordering."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.resilience import manifest as M
+from deepspeed_tpu.runtime.resilience.faults import bitflip_file, truncate_file
+
+
+def _tree():
+    return {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "b": {"inner": np.ones(5, dtype=np.int32)}}
+
+
+def _make_ckpt(root, payload=b"x" * 4096):
+    os.makedirs(os.path.join(root, "state"))
+    with open(os.path.join(root, "state", "data.bin"), "wb") as f:
+        f.write(payload)
+    with open(os.path.join(root, "metadata.json"), "w") as f:
+        json.dump({"global_steps": 3}, f)
+    man = M.build_manifest(root, leaf_entries=M.state_leaf_entries(_tree()))
+    M.write_manifest(root, man)
+    return man
+
+
+def test_manifest_roundtrip(tmp_path):
+    root = str(tmp_path / "ck")
+    man = _make_ckpt(root)
+    read = M.read_manifest(root)
+    assert read == man
+    assert set(read["files"]) == {os.path.join("state", "data.bin"), "metadata.json"}
+    assert M.MANIFEST_NAME not in read["files"]  # cannot contain its own hash
+    # clean dir verifies, leaves verify against an identical tree
+    M.verify_checkpoint_dir(root)
+    M.verify_state_leaves(_tree(), read)
+
+
+def test_leaf_entries_record_shape_dtype_hash():
+    entries = M.state_leaf_entries(_tree())
+    w = entries["['w']"]
+    assert w["shape"] == [3, 4] and w["dtype"] == "float32"
+    # same values, different dtype → different entry (dtype is part of identity)
+    other = {"w": np.arange(12, dtype=np.float64).reshape(3, 4),
+             "b": {"inner": np.ones(5, dtype=np.int32)}}
+    assert M.state_leaf_entries(other)["['w']"] != w
+
+
+def test_verify_detects_truncation(tmp_path):
+    root = str(tmp_path / "ck")
+    _make_ckpt(root)
+    truncate_file(os.path.join(root, "state", "data.bin"))
+    with pytest.raises(M.CheckpointCorruptError, match="truncated"):
+        M.verify_checkpoint_dir(root)
+
+
+def test_verify_detects_bitflip(tmp_path):
+    root = str(tmp_path / "ck")
+    _make_ckpt(root)
+    bitflip_file(os.path.join(root, "state", "data.bin"), seed=1)
+    with pytest.raises(M.CheckpointCorruptError, match="sha256 mismatch"):
+        M.verify_checkpoint_dir(root)
+
+
+def test_verify_detects_missing_file(tmp_path):
+    root = str(tmp_path / "ck")
+    _make_ckpt(root)
+    os.remove(os.path.join(root, "metadata.json"))
+    with pytest.raises(M.CheckpointCorruptError, match="missing file"):
+        M.verify_checkpoint_dir(root)
+
+
+def test_manifestless_checkpoint_passes_with_warning(tmp_path):
+    root = str(tmp_path / "legacy")
+    os.makedirs(root)
+    assert M.verify_checkpoint_dir(root) == {}  # nothing to verify against
+
+
+def test_verify_leaves_detects_value_change():
+    man = {"leaves": M.state_leaf_entries(_tree())}
+    mutated = _tree()
+    mutated["w"][0, 0] += 1
+    with pytest.raises(M.CheckpointCorruptError, match="does not match"):
+        M.verify_state_leaves(mutated, man)
+
+
+def test_atomic_publish_swaps_existing_tag(tmp_path):
+    staging = str(tmp_path / ".tmp.t")
+    final = str(tmp_path / "t")
+    os.makedirs(final)
+    with open(os.path.join(final, "old.txt"), "w") as f:
+        f.write("old")
+    os.makedirs(staging)
+    with open(os.path.join(staging, "new.txt"), "w") as f:
+        f.write("new")
+    M.atomic_publish(staging, final)
+    assert os.listdir(final) == ["new.txt"]
+    assert not os.path.exists(staging)
+
+
+def test_write_atomic_text_leaves_no_temp(tmp_path):
+    path = str(tmp_path / "latest")
+    M.write_atomic_text(path, "tagA")
+    M.write_atomic_text(path, "tagB")
+    assert open(path).read() == "tagB"
+    assert os.listdir(tmp_path) == ["latest"]
+
+
+def test_list_tags_orders_by_steps_and_skips_staging(tmp_path):
+    for name, steps in [("a", 1), ("b", 5), ("c", 3)]:
+        d = tmp_path / name
+        (d / "state").mkdir(parents=True)
+        (d / "metadata.json").write_text(json.dumps({"global_steps": steps}))
+    (tmp_path / ".tmp.d" / "state").mkdir(parents=True)  # staged: invisible
+    (tmp_path / "not_a_tag").mkdir()  # no state/ or manifest: ignored
+    assert M.list_checkpoint_tags(str(tmp_path)) == ["b", "c", "a"]
+
+
+def test_sweep_stale_staging(tmp_path):
+    (tmp_path / ".tmp.x" / "state").mkdir(parents=True)
+    (tmp_path / "keep").mkdir()
+    M.sweep_stale_staging(str(tmp_path))
+    assert sorted(os.listdir(tmp_path)) == ["keep"]
+
+
+def test_sweep_excludes_in_flight_staging(tmp_path):
+    (tmp_path / ".tmp.live").mkdir()
+    (tmp_path / ".tmp.dead").mkdir()
+    M.sweep_stale_staging(str(tmp_path), exclude=str(tmp_path / ".tmp.live"))
+    assert os.listdir(tmp_path) == [".tmp.live"]
+
+
+def test_sweep_restores_displaced_copy_from_crashed_overwrite(tmp_path):
+    """Publish crashed between displacing the old tag and renaming the new
+    one in: the displaced dir holds the ONLY intact copy — the sweep must
+    restore it to the tag name, not delete it."""
+    d = tmp_path / ".tmp.best.old.4242"
+    (d / "state").mkdir(parents=True)
+    (d / "state" / "data.bin").write_bytes(b"intact")
+    (tmp_path / ".tmp.best").mkdir()  # the partial new write: swept
+    M.sweep_stale_staging(str(tmp_path))
+    assert os.listdir(tmp_path) == ["best"]
+    assert (tmp_path / "best" / "state" / "data.bin").read_bytes() == b"intact"
+    # once the overwrite COMPLETED (tag exists), a displaced leftover is junk
+    (tmp_path / ".tmp.best.old.5555").mkdir()
+    M.sweep_stale_staging(str(tmp_path))
+    assert os.listdir(tmp_path) == ["best"]

@@ -1,0 +1,67 @@
+"""The resume contract, proven at the bit level on CPU (ROADMAP item 4
+applied to restart):
+
+* two-run bit-determinism — the same config in two FRESH processes
+  produces identical loss bits over 8 full ``train_batch`` steps (the
+  foundation: without it, resume parity is unfalsifiable);
+* kill-and-resume parity — train k steps, SIGKILL at the step boundary
+  (via the deterministic fault injector, under in-process
+  ``DSElasticAgent`` supervision), auto-restart, ``engine.resume()``,
+  train the remaining N−k: the stitched curve is bit-identical to the
+  uninterrupted reference.
+
+Losses cross process boundaries as exact float hex — equality here IS
+bit equality. Children reuse the repo ``.jax_cache`` so each run costs a
+process start, not a compile."""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+for p in (REPO, os.path.join(REPO, "tools")):
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+import fault_bench  # noqa: E402 — shared supervised-run harness
+
+TOTAL_STEPS = 8
+KILL_AT = 3
+
+
+@pytest.fixture(scope="module")
+def reference_runs(tmp_path_factory):
+    """Two uninterrupted fresh-process runs of the same config (shared by
+    both tests below — the determinism pair doubles as the parity
+    reference)."""
+    wd = str(tmp_path_factory.mktemp("resume_refs"))
+    rc1, _, losses1 = fault_bench.run_supervised(wd, "ref1", TOTAL_STEPS, {})
+    rc2, _, losses2 = fault_bench.run_supervised(wd, "ref2", TOTAL_STEPS, {})
+    assert rc1 == 0 and rc2 == 0
+    return losses1, losses2
+
+
+def test_two_process_bit_determinism(reference_runs):
+    """Fresh process each run, identical loss bits over 8 steps on the full
+    train_batch path — the CPU determinism gate ROADMAP item 4 asks for,
+    catching reduction-order / rng regressions between chip windows."""
+    losses1, losses2 = reference_runs
+    assert sorted(losses1) == list(range(TOTAL_STEPS))
+    assert losses1 == losses2  # float-hex equality = bit equality
+
+
+def test_kill_and_resume_bit_exact(tmp_path, reference_runs):
+    """train k → SIGKILL → agent restart → resume() → N−k: bit-identical
+    to the uninterrupted run, with exactly one restart and no lost or
+    repeated steps."""
+    ref, _ = reference_runs
+    rc, agent, losses = fault_bench.run_supervised(
+        str(tmp_path), "faulted", TOTAL_STEPS,
+        {"DS_FAULT_SPEC": f"step=sigkill@{KILL_AT}"})
+    assert rc == 0, agent.history
+    assert agent.restart_count == 1, agent.history
+    # the first life died by SIGKILL, not a clean exit
+    assert agent.history[0]["rc"] == -9, agent.history
+    assert sorted(losses) == list(range(TOTAL_STEPS))
+    assert losses == ref  # bit-exact stitched curve

@@ -83,6 +83,36 @@ def probe_alive(timeout=90) -> bool:
         return False
 
 
+def probe_alive_with_retry(attempts=None, timeout=90):
+    """A dead-looking probe costs the rest of the window, so one flaky
+    tunnel round-trip must not be read as a dead chip: retry the probe
+    under the shared backoff policy (resilience/retry.py) and return
+    ``(alive, probe_evidence)`` — the attempt history lands in
+    CHIP_WINDOW.json next to the verdict it produced."""
+    from deepspeed_tpu.runtime.resilience.retry import RetryPolicy, heartbeat_sleep
+    policy = RetryPolicy(
+        max_attempts=int(attempts or os.environ.get("CHIP_WINDOW_PROBE_RETRIES", "3")),
+        base_delay=float(os.environ.get("CHIP_WINDOW_PROBE_BASE", "20")),
+        max_delay=120.0, jitter=0.25,
+        retry_on=lambda e: isinstance(e, _ProbeDead),  # every probe miss retries
+        sleep=heartbeat_sleep())
+
+    def once():
+        if not probe_alive(timeout=timeout):
+            raise _ProbeDead("chip probe returned dead/hung")
+        return True
+
+    try:
+        policy.call(once)
+        return True, policy.evidence()
+    except _ProbeDead:
+        return False, policy.evidence()
+
+
+class _ProbeDead(RuntimeError):
+    pass
+
+
 def main():
     from deepspeed_tpu.elasticity import DSElasticAgent
 
@@ -96,8 +126,10 @@ def main():
         with open(os.path.join(REPO, "CHIP_WINDOW.json"), "w") as f:
             json.dump(report, f, indent=1)
 
-    if not probe_alive():
+    alive, probe_ev = probe_alive_with_retry()
+    if not alive:
         report["aborted"] = "chip probe dead before stage 1 — window not open"
+        report["probe"] = probe_ev
         print(f"# {report['aborted']}", flush=True)
         save()
         return 1
@@ -114,8 +146,10 @@ def main():
         rc = agent.run(workdir=REPO)
         entry = {"stage": name, "rc": rc, "duration_s": round(time.time() - t0, 1),
                  "attempts": agent.history}
-        alive = probe_alive()
+        alive, probe_ev = probe_alive_with_retry()
         entry["chip_alive_after"] = alive
+        if probe_ev:
+            entry["probe"] = probe_ev  # retried probes show their history
         report["stages"].append(entry)
         save()
         print(f"# stage {name} rc={rc} alive_after={alive} "

@@ -1,0 +1,305 @@
+"""Fault-injection bench: inject each documented failure class and assert
+the documented recovery (runtime/resilience). CPU-only by design — the
+recovery *logic* is backend-independent, and proving it must never burn a
+chip window. One JSON row per scenario; exit 1 if any recovery contract
+fails.
+
+| fault class            | injection                                   | documented recovery                          |
+|------------------------|---------------------------------------------|----------------------------------------------|
+| torn save (crash)      | SIGKILL between staging and atomic rename   | partial tag invisible; previous tag loads    |
+| truncated checkpoint   | truncate largest manifest-listed file       | verified fallback to newest intact tag       |
+| bit-flipped checkpoint | flip one bit in array data                  | verified fallback to newest intact tag       |
+| persistent NaN grads   | inf loss boost through real overflow path   | abort after K consecutive skips (loud)       |
+| SIGKILL mid-run        | DS_FAULT_SPEC step=sigkill@N under agent    | restart + bit-exact resumed loss curve       |
+| transient HTTP 500     | compile-helper-500-shaped flaky call        | retried with backoff; attempts in evidence   |
+
+Run: python tools/fault_bench.py            (scenario subset: FAULT_SCENARIOS=...)
+Tests import the scenario functions directly (tests/unit/resilience/).
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+PY = sys.executable
+
+# -- shared tiny-engine builder (in-process scenarios) -----------------------
+
+def _tiny_engine(ds_extra=None, loss_fn=None):
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+    ds = {"train_batch_size": 8,
+          "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+          "steps_per_print": 1}
+    ds.update(ds_extra or {})
+    cfg = get_gpt2_config("test")
+    engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2LMHeadModel(cfg),
+                                               config=ds, loss_fn=loss_fn)
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (8, 16)).astype(np.int32)}
+    return engine, batch
+
+
+def _row(fault, expected, observed, ok, **extra):
+    return dict({"fault": fault, "expected": expected, "observed": observed,
+                 "ok": bool(ok)}, **extra)
+
+
+# -- corruption scenarios (in-process) ---------------------------------------
+
+def scenario_corrupt_checkpoint(workdir, mode="truncate"):
+    """Damage the newest tag; load must fall back to the previous intact one
+    — not crash, not silently load garbage."""
+    from deepspeed_tpu.runtime.resilience.faults import corrupt_checkpoint
+    ckpt = os.path.join(workdir, f"ckpt_{mode}")
+    engine, batch = _tiny_engine()
+    engine.train_batch(batch)
+    engine.save_checkpoint(ckpt, tag="t1")
+    engine.train_batch(batch)
+    engine.save_checkpoint(ckpt, tag="t2")
+    corrupt_checkpoint(ckpt, "t2", mode=mode)
+    fresh, _ = _tiny_engine()
+    fresh.initialize_state(batch)
+    fresh.load_checkpoint(ckpt)
+    loaded = getattr(fresh, "_loaded_checkpoint_tag", None)
+    return _row(f"{mode}_checkpoint", "fallback to t1", f"loaded {loaded}",
+                loaded == "t1" and fresh.global_steps == 1)
+
+
+def scenario_all_corrupt(workdir):
+    """Every tag damaged: the failure must be LOUD (CheckpointCorruptError),
+    never a silent load of garbage params."""
+    from deepspeed_tpu.runtime.resilience.faults import corrupt_checkpoint
+    from deepspeed_tpu.runtime.resilience.manifest import CheckpointCorruptError
+    ckpt = os.path.join(workdir, "ckpt_all_corrupt")
+    engine, batch = _tiny_engine()
+    engine.train_batch(batch)
+    engine.save_checkpoint(ckpt, tag="only")
+    corrupt_checkpoint(ckpt, "only", mode="bitflip")
+    fresh, _ = _tiny_engine()
+    fresh.initialize_state(batch)
+    try:
+        fresh.load_checkpoint(ckpt)
+        observed = "loaded silently"
+    except CheckpointCorruptError as e:
+        observed = f"raised CheckpointCorruptError: {str(e)[:80]}"
+    return _row("all_tags_corrupt", "loud CheckpointCorruptError",
+                observed, observed.startswith("raised"))
+
+
+# -- poisoned numerics -------------------------------------------------------
+
+def scenario_overflow_abort(workdir, abort_after=3):
+    """Persistent non-finite gradients: K consecutive overflow-skips must
+    abort the run (fail fast), through the REAL grad/overflow machinery."""
+    from deepspeed_tpu.runtime.fp16.loss_scaler import OverflowAbort
+    from deepspeed_tpu.runtime.resilience.faults import overflow_injected_loss, poison_batch
+    engine, batch = _tiny_engine(
+        ds_extra={"resilience": {"max_consecutive_overflows": abort_after}},
+        loss_fn=overflow_injected_loss())
+    engine.train_batch(batch)  # healthy step first: streak must start at the poison
+    poisoned = poison_batch(batch)
+    steps_survived = 0
+    observed = f"no abort after {abort_after + 2} poisoned steps"
+    try:
+        for _ in range(abort_after + 2):
+            engine.train_batch(poisoned)
+            steps_survived += 1
+    except OverflowAbort as e:
+        observed = f"OverflowAbort after {steps_survived + 1} poisoned steps: {str(e)[:60]}"
+    return _row("persistent_nan_grads", f"OverflowAbort after {abort_after} skips",
+                observed, steps_survived + 1 == abort_after and "OverflowAbort" in observed,
+                skipped_total=int(engine._skipped_steps))
+
+
+# -- transient infrastructure ------------------------------------------------
+
+def scenario_http500_retry(workdir, fails=2):
+    """Transient compile-helper 500s: retried with backoff, each attempt in
+    the evidence row (the exact message text the tunnel produces)."""
+    from deepspeed_tpu.runtime.resilience.faults import FlakyCall
+    from deepspeed_tpu.runtime.resilience.retry import COMPILE_HELPER_500, RetryPolicy
+    flaky = FlakyCall(lambda: "banked", fails=fails)
+    policy = RetryPolicy(max_attempts=fails + 1, base_delay=0.01, jitter=0.25,
+                         seed=0, sleep=lambda s: None)
+    result = policy.call(flaky)
+    ev = policy.evidence()
+    ok = (result == "banked" and flaky.calls == fails + 1
+          and ev.get("retries") == fails
+          and all(a["error_class"] == COMPILE_HELPER_500 for a in ev["retry_history"]))
+    return _row("transient_http500", f"success after {fails} retries, history recorded",
+                f"result={result!r} calls={flaky.calls}", ok, **ev)
+
+
+# -- process-death scenarios (subprocess) ------------------------------------
+
+_TORN_SAVE_CHILD = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir", os.path.join({repo!r}, ".jax_cache"))
+    import numpy as np, deepspeed_tpu
+    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+    cfg = get_gpt2_config("test")
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(cfg),
+        config={{"train_batch_size": 8,
+                 "optimizer": {{"type": "Adam", "params": {{"lr": 1e-3}}}}}})
+    batch = {{"input_ids": np.zeros((8, 16), np.int32)}}
+    eng.train_batch(batch)
+    eng.save_checkpoint({ckpt!r}, tag="good")
+    eng.train_batch(batch)
+    os.environ["DS_FAULT_SPEC"] = "ckpt_pre_rename=sigkill"   # die mid-publish
+    eng.save_checkpoint({ckpt!r}, tag="torn")
+    print("UNREACHABLE")
+""")
+
+
+def scenario_torn_save(workdir):
+    """SIGKILL between checkpoint staging and the atomic rename: the torn
+    tag must be INVISIBLE (staging dir only), 'latest' still names the
+    previous tag, and a fresh engine loads it cleanly."""
+    from envutil import cpu_subprocess_env
+    ckpt = os.path.join(workdir, "ckpt_torn")
+    p = subprocess.run([PY, "-c", _TORN_SAVE_CHILD.format(repo=REPO, ckpt=ckpt)],
+                       env=cpu_subprocess_env(), capture_output=True, text=True,
+                       timeout=420, cwd=REPO)
+    killed = p.returncode == -9 and "UNREACHABLE" not in p.stdout
+    entries = sorted(os.listdir(ckpt)) if os.path.isdir(ckpt) else []
+    torn_invisible = "torn" not in entries and ".tmp.torn" in entries
+    latest_ok = open(os.path.join(ckpt, "latest")).read().strip() == "good"
+    # recovery leg: a fresh engine resumes from 'good' and its next save
+    # sweeps the stale staging dir
+    fresh, batch = _tiny_engine()
+    fresh.initialize_state(batch)
+    fresh.load_checkpoint(ckpt)
+    resumed_ok = fresh._loaded_checkpoint_tag == "good" and fresh.global_steps == 1
+    fresh.save_checkpoint(ckpt, tag="after")
+    swept = ".tmp.torn" not in os.listdir(ckpt)
+    return _row("torn_save_sigkill",
+                "partial tag invisible; latest->good; resume ok; staging swept",
+                f"killed={killed} entries={entries} resumed={fresh._loaded_checkpoint_tag} "
+                f"swept={swept}",
+                killed and torn_invisible and latest_ok and resumed_ok and swept)
+
+
+_TRAIN_CHILD = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir", os.path.join({repo!r}, ".jax_cache"))
+    import numpy as np, jax.numpy as jnp, deepspeed_tpu
+    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+
+    restarted = os.environ.get("DS_ELASTIC_RESTART_COUNT", "0") != "0"
+    if restarted:
+        os.environ.pop("DS_FAULT_SPEC", None)   # fault fires on the first life only
+    cfg = get_gpt2_config("test", n_layer=2)
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(cfg),
+        config={{"train_batch_size": 8,
+                 "optimizer": {{"type": "Adam", "params": {{"lr": 1e-3}}}}}})
+    eng.initialize_state({{"input_ids": np.zeros((8, 16), np.int32)}})
+    eng.resume({ckpt!r})     # fresh start on the first life, verified resume after
+    while eng.global_steps < {total}:
+        step = eng.global_steps
+        rng = np.random.RandomState(1000 + step)
+        batch = {{"input_ids": rng.randint(0, cfg.vocab_size, (8, 16)).astype(np.int32)}}
+        loss = float(jnp.asarray(eng.train_batch(batch)))
+        with open({losses!r}, "a") as f:
+            f.write(json.dumps({{"step": step, "loss": loss.hex()}}) + chr(10))
+        eng.save_checkpoint({ckpt!r})
+        from deepspeed_tpu.elasticity.elastic_agent import touch_heartbeat
+        touch_heartbeat()
+    print("CHILD_DONE", eng.global_steps)
+""")
+
+
+def run_supervised(workdir, name, total, fault_env):
+    """One supervised training run (DSElasticAgent around a CPU child that
+    trains ``total`` steps with per-step deterministic data, checkpointing
+    and resuming via engine.resume). Returns ``(rc, agent, {step: loss_hex})``
+    — losses as exact float hex so comparisons are bit-level, not approx."""
+    from envutil import cpu_subprocess_env
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+
+    d = os.path.join(workdir, name)
+    os.makedirs(d, exist_ok=True)
+    losses = os.path.join(d, "losses.jsonl")
+    child = _TRAIN_CHILD.format(repo=REPO, ckpt=os.path.join(d, "ckpt"),
+                                losses=losses, total=total)
+    env = cpu_subprocess_env()
+    env.update(fault_env)
+    agent = DSElasticAgent([PY, "-c", child], world_sizes=[1],
+                           heartbeat_timeout=300.0, max_restarts=1, env=env)
+    rc = agent.run(workdir=d)
+    rows = [json.loads(l) for l in open(losses)] if os.path.exists(losses) else []
+    return rc, agent, {r["step"]: r["loss"] for r in rows}
+
+
+def scenario_sigkill_resume(workdir, kill_at=2, total=4):
+    """SIGKILL at a step boundary under DSElasticAgent: the agent restarts
+    the child, resume() restores the timeline, and the stitched loss curve
+    is BIT-identical to an uninterrupted run (losses compared as exact
+    float hex)."""
+    rc, agent, losses = run_supervised(workdir, "faulted", total,
+                                       {"DS_FAULT_SPEC": f"step=sigkill@{kill_at}"})
+    ref_rc, _, ref_losses = run_supervised(workdir, "reference", total, {})
+    bit_exact = (losses == ref_losses and len(ref_losses) == total)
+    return _row("sigkill_midrun_resume",
+                f"agent restart + bit-exact {total}-step curve",
+                f"rc={rc} restarts={agent.restart_count} steps={sorted(losses)} "
+                f"bit_exact={bit_exact}",
+                rc == 0 and ref_rc == 0 and agent.restart_count == 1 and bit_exact)
+
+
+SCENARIOS = {
+    "torn_save": scenario_torn_save,
+    "truncate": lambda wd: scenario_corrupt_checkpoint(wd, "truncate"),
+    "bitflip": lambda wd: scenario_corrupt_checkpoint(wd, "bitflip"),
+    "all_corrupt": scenario_all_corrupt,
+    "nan_grads": scenario_overflow_abort,
+    "sigkill_resume": scenario_sigkill_resume,
+    "http500": scenario_http500_retry,
+}
+
+
+def main():
+    from envutil import pin_cpu_in_process
+    pin_cpu_in_process(1)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir", os.path.join(REPO, ".jax_cache"))
+    want = [s for s in os.environ.get("FAULT_SCENARIOS",
+                                      ",".join(SCENARIOS)).split(",") if s]
+    workdir = tempfile.mkdtemp(prefix="fault_bench.")
+    print(f"# fault bench: {want} (workdir {workdir})", flush=True)
+    failed = 0
+    try:
+        for name in want:
+            try:
+                row = SCENARIOS[name](workdir)
+            except Exception as e:  # noqa: BLE001 — a crashed scenario is a failed contract
+                row = _row(name, "scenario completes", f"crashed: {type(e).__name__}: "
+                           f"{str(e)[:200]}", False)
+            failed += 0 if row["ok"] else 1
+            print(json.dumps(row), flush=True)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    print(f"# DONE ok={len(want) - failed}/{len(want)}", flush=True)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,16 @@ Run: python tools/perf_ladder.py            (background it; poll stdout)
 Env: LADDER=760m_mb4,760m_mb8,xl_offload_mb1  (comma list; default 760m)
      LADDER_DEADLINE=3600  (seconds; checked between rungs only)
      LADDER_FUSED=10       (steps per fused dispatch; lower = faster compile)
+     LADDER_RETRIES=3      (attempts per rung on transient tunnel failures —
+                            the remote-compile-helper HTTP 500 class; backoff
+                            base LADDER_RETRY_BASE=15s, heartbeat-aware)
+
+Transient-failure policy (resilience/retry.py): a rung that dies with a
+compile-helper 500 / connection flake is retried with backoff+jitter; the
+attempt history rides the rung's evidence row (``retries`` +
+``retry_history``) so banked numbers show what they survived. A rung whose
+retries exhaust emits a STRUCTURED row — ``blocked: compile_helper_500``
+with the full history — instead of a bare error (PERF.md §PR9 envelope).
 """
 import json
 import os
@@ -27,7 +37,7 @@ SEQ = 1024
 
 
 def run_rung(tag, model_name, mb, offload=False, steps=None, seq=None,
-             fused_xent=False, ds=None, cfg_overrides=None):
+             fused_xent=False, ds=None, cfg_overrides=None, retry_evidence=None):
     ds_overrides = dict(ds or {})
     if offload:
         # full ZeRO-Infinity single-chip recipe: params rest pinned-host and
@@ -62,7 +72,8 @@ def run_rung(tag, model_name, mb, offload=False, steps=None, seq=None,
     report(tag, mb, seq or SEQ, n_params, n_steps, dt, compile_s, cfg=cfg,
            **attn_geometry_evidence(cfg, mb, seq or SEQ),
            **moe_route_evidence(cfg),
-           **lint_evidence(engine, batch))
+           **lint_evidence(engine, batch),
+           **(retry_evidence or {}))
 
 
 def attn_geometry_evidence(cfg, mb, seq):
@@ -170,6 +181,18 @@ RUNGS = {
     "350m_seq2k": dict(model_name="350m", mb=4, seq=2048, fused_xent=True),
     "350m_seq4k": dict(model_name="350m", mb=2, seq=4096, fused_xent=True),
     "350m_seq8k": dict(model_name="350m", mb=1, seq=8192, fused_xent=True),
+    # compile-helper-500 bisect rungs (PERF.md §PR9): straddle each model
+    # family's known-good/known-bad boundary. Run at the next window as one
+    # stage; with LADDER_RETRIES active, each row's retry_history says
+    # whether the 500 is deterministic at that size or a helper-restart
+    # flake — the envelope falls out of one LADDER=bisect_* invocation.
+    "bisect_bert_mb160": dict(model_name="bert_large", mb=160, seq=128),
+    "bisect_bert_mb192": dict(model_name="bert_large", mb=192, seq=128),
+    "bisect_bert_mb224": dict(model_name="bert_large", mb=224, seq=128),
+    "bisect_350m_mb10": dict(model_name="350m", mb=10, fused_xent=True),
+    "bisect_350m_mb12": dict(model_name="350m", mb=12, fused_xent=True),
+    "bisect_760m_mb5": dict(model_name="760m", mb=5, fused_xent=True),
+    "bisect_760m_mb6": dict(model_name="760m", mb=6, fused_xent=True),
     # the reference's 64-TFLOPS headline workload: BERT-large pretrain at
     # seq 128 (BASELINE.md row 1) — direct apples-to-apples rung
     "bert_large_mb64": dict(model_name="bert_large", mb=64, seq=128),
@@ -186,8 +209,19 @@ RUNGS = {
 }
 
 
+def _rung_retry_policy():
+    from deepspeed_tpu.runtime.resilience.retry import RetryPolicy, heartbeat_sleep
+    return RetryPolicy(max_attempts=int(os.environ.get("LADDER_RETRIES", "3")),
+                       base_delay=float(os.environ.get("LADDER_RETRY_BASE", "15")),
+                       max_delay=300.0, jitter=0.25,
+                       # backoff naps keep the agent's heartbeat fresh: a rung
+                       # waiting out a helper restart must not read as hung
+                       sleep=heartbeat_sleep())
+
+
 def main():
     enable_compile_cache()
+    from deepspeed_tpu.runtime.resilience.retry import classify_failure
     deadline = time.time() + int(os.environ.get("LADDER_DEADLINE", "3600"))
     want = os.environ.get("LADDER", "760m_mb4,760m_mb8").split(",")
     print(f"# ladder seq={SEQ}: {want}", flush=True)
@@ -195,12 +229,29 @@ def main():
         if time.time() > deadline:
             print(f"# deadline reached, skipping {tag} onward", flush=True)
             break
-        try:
+        policy = _rung_retry_policy()
+        evidence = {}  # mutated before each attempt; report() reads it live
+
+        def attempt(i, history, _ev=evidence, _tag=tag):
             from deepspeed_tpu.elasticity import touch_heartbeat
-            touch_heartbeat()  # supervised runs: fresh clock before each rung
-            run_rung(tag, **RUNGS[tag.strip()])
+            touch_heartbeat()  # supervised runs: fresh clock before each attempt
+            _ev.clear()
+            _ev.update(policy.evidence())
+            if i > 1:
+                print(f"# {_tag}: retry attempt {i}/{policy.max_attempts} after "
+                      f"{history[-1]['error_class'] or 'transient failure'}", flush=True)
+
+        try:
+            policy.call(run_rung, tag, retry_evidence=evidence,
+                        before_attempt=attempt, **RUNGS[tag.strip()])
         except Exception as e:  # noqa: BLE001 — keep laddering past OOMs
             row = {"tag": tag, "error": f"{type(e).__name__}: {str(e)[:300]}"}
+            cls = classify_failure(e)
+            if cls is not None:
+                # structured blocked row: the failure class + full retry
+                # history, machine-readable for PERF.md's envelope table
+                row["blocked"] = cls
+            row.update(policy.evidence())
             cfg_ov = RUNGS.get(tag.strip(), {}).get("cfg_overrides", {})
             if cfg_ov.get("moe_num_experts"):
                 # MoE error rows still carry their route evidence (a failed
