@@ -18,21 +18,28 @@ migration calls)::
 """
 
 from deepspeed_tpu.analysis.core import (ERROR, INFO, RULES, WARN, Finding, Rule, Waiver,
-                                         apply_waivers, ast_rules, load_waivers,
-                                         program_rules)
+                                         apply_waivers, ast_rules, cost_rules,
+                                         load_waivers, program_rules)
 from deepspeed_tpu.analysis.program import (ProgramAnalyzer, ProgramInfo, aval_bytes,
                                             run_program_rules)
 from deepspeed_tpu.analysis import rules as _rules  # noqa: F401 — registers R001-R007
 from deepspeed_tpu.analysis import source_rules as _source_rules  # noqa: F401 — registers R008
+from deepspeed_tpu.analysis.memory import MemoryEstimate, estimate_memory
+from deepspeed_tpu.analysis.cost import (CostInfo, build_cost, cost_baseline_from,
+                                         cost_engine_program, load_cost_baseline,
+                                         r013_cost_ratchet, run_cost_rules)  # registers R009-R013
 from deepspeed_tpu.analysis.report import (baseline_from, build_report, load_baseline,
                                            matrix_signature, new_errors, summarize,
                                            write_report)
 
 __all__ = [
     "ERROR", "WARN", "INFO", "RULES", "Finding", "Rule", "Waiver",
-    "apply_waivers", "load_waivers", "program_rules", "ast_rules",
+    "apply_waivers", "load_waivers", "program_rules", "ast_rules", "cost_rules",
     "ProgramAnalyzer", "ProgramInfo", "aval_bytes", "run_program_rules",
     "check_program", "lint_engine_program",
+    "MemoryEstimate", "estimate_memory",
+    "CostInfo", "build_cost", "run_cost_rules", "r013_cost_ratchet",
+    "load_cost_baseline", "cost_baseline_from", "cost_engine_program",
     "baseline_from", "build_report", "load_baseline", "matrix_signature",
     "new_errors", "summarize", "write_report",
 ]
@@ -62,13 +69,15 @@ def _repo_waivers():
         return load_waivers(json.load(fh))
 
 
-def lint_engine_program(engine, example_batch, rules=None):
+def lint_engine_program(engine, example_batch, rules=None, programs=None):
     """Analyze a live engine's traced step program and return the compact
     evidence summary perf_ladder embeds in its rows: rule hit counts,
     waiver count, error count, clean flag. Chip-window rows carry this so
     a banked TFLOPS number provably came from a lint-clean program.
-    Applies the repo's waivers.json — the row must agree with the gate."""
-    programs = engine.traced_programs(example_batch)
+    Applies the repo's waivers.json — the row must agree with the gate.
+    Pass ``programs`` (a prior ``engine.traced_programs`` result) to
+    share one trace with the cost evidence instead of re-tracing."""
+    programs = programs or engine.traced_programs(example_batch)
     step = programs["train_step"]
     info = ProgramInfo(name="engine_train_step", jaxpr=step["jaxpr"],
                        hlo_text=step["hlo_text"], kind="train_step",

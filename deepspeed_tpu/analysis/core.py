@@ -35,6 +35,7 @@ _SEVERITIES = (ERROR, WARN, INFO)
 LAYER_JAXPR = "jaxpr"
 LAYER_HLO = "hlo"
 LAYER_AST = "ast"
+LAYER_COST = "cost"  # quantitative rules fed by the cost engine (analysis/cost.py)
 
 
 @dataclasses.dataclass
@@ -116,6 +117,13 @@ def ast_rules() -> List[Rule]:
     return [r for r in RULES.values() if r.layer == LAYER_AST]
 
 
+def cost_rules() -> List[Rule]:
+    """Cost-layer rules run only in the ``--cost`` pass: they need the
+    memory estimate + collective inventory a plain trace walk doesn't
+    build (R013 additionally needs the committed cost baseline)."""
+    return [r for r in RULES.values() if r.layer == LAYER_COST]
+
+
 @dataclasses.dataclass(frozen=True)
 class Waiver:
     """Acknowledge a finding without fixing it. ``scenario`` is an fnmatch
@@ -142,6 +150,17 @@ def apply_waivers(findings: Iterable[Finding], waivers: Iterable[Waiver]) -> Lis
                 f.waiver_reason = w.reason or f"waived by {w.rule}/{w.scenario}"
         out.append(f)
     return out
+
+
+def stale_config_waivers(findings: Iterable[Finding],
+                         waivers: Iterable[Waiver]) -> List[Waiver]:
+    """Waivers that cover no current finding. A waiver is an
+    acknowledged debt; once the debt is paid (or the rule/scenario
+    renamed) the entry keeps matching nothing forever — the CLI WARNs so
+    dead waivers get pruned instead of silently accumulating into a
+    blanket that could swallow a future real finding."""
+    findings = list(findings)
+    return [w for w in waivers if not any(w.covers(f) for f in findings)]
 
 
 def load_waivers(entries: Optional[Iterable[dict]]) -> List[Waiver]:

@@ -47,17 +47,36 @@ class EqnRecord(NamedTuple):
 
 
 class ProgramInfo:
-    """One traced program + everything a rule may need to judge it."""
+    """One traced program + everything a rule may need to judge it.
+
+    ``lower`` is an optional zero-arg thunk returning the program's
+    ``jax.stages.Lowered`` — the cost engine (``analysis/cost.py``) calls
+    it (then ``.compile()``) only in the ``--cost`` pass, so plain lint
+    runs stay trace-only. The compiled executable is cached: its
+    ``as_text()`` is the post-SPMD collective inventory and its
+    ``cost_analysis()``/``memory_analysis()`` cross-check the static
+    memory estimate."""
 
     def __init__(self, name: str, jaxpr: Optional[ClosedJaxpr] = None,
                  hlo_text: Optional[str] = None, kind: str = "fwd_bwd",
-                 metadata: Optional[Dict[str, Any]] = None):
+                 metadata: Optional[Dict[str, Any]] = None,
+                 lower=None):
         assert jaxpr is not None or hlo_text is not None, name
         self.name = name
         self.jaxpr = jaxpr
         self.hlo_text = hlo_text
         self.kind = kind  # fwd_bwd | train_step | layer | fixture
         self.metadata = dict(metadata or {})
+        self.lower = lower
+        self._compiled = None
+
+    def compiled(self):
+        """The compiled executable, or None when no lowering thunk was
+        attached. Exceptions propagate — the caller records them as the
+        program's ``compile_error`` evidence."""
+        if self._compiled is None and self.lower is not None:
+            self._compiled = self.lower().compile()
+        return self._compiled
 
 
 def aval_bytes(aval) -> int:
@@ -153,20 +172,22 @@ class ProgramAnalyzer:
         return False
 
 
-def run_program_rules(program: ProgramInfo, rules=None) -> Tuple[List, Dict[str, Any]]:
+def run_program_rules(program: ProgramInfo, rules=None,
+                      analyzer: Optional["ProgramAnalyzer"] = None) -> Tuple[List, Dict[str, Any]]:
     """Run every (or the given) jaxpr/hlo-layer rule against one program.
     Returns ``(findings, metrics)`` — metrics carry rule attributions
-    (e.g. R002's per-scope precision-upcast counts) into the report."""
+    (e.g. R002's per-scope precision-upcast counts) into the report.
+    Pass ``analyzer`` to share one cached walk with the cost pass."""
     from deepspeed_tpu.analysis import rules as _rules  # noqa: F401 — registers on import
     from deepspeed_tpu.analysis.core import RULES, program_rules
 
     selected = program_rules() if rules is None else [RULES[r] for r in rules]
     bad = [r.id for r in selected if r.layer not in ("jaxpr", "hlo")]
     if bad:
-        raise ValueError(f"{bad} are {'an ' if len(bad) == 1 else ''}ast-layer rule(s) — "
-                         f"they take source files, not traced programs "
-                         f"(run them through tools/graft_lint.py --ast-only)")
-    analyzer = ProgramAnalyzer(program)
+        raise ValueError(f"{bad} are {'an ' if len(bad) == 1 else ''}non-program-layer rule(s) — "
+                         f"ast rules take source files (tools/graft_lint.py --ast-only), "
+                         f"cost rules need the cost engine (tools/graft_lint.py --cost)")
+    analyzer = analyzer or ProgramAnalyzer(program)
     findings = []
     for r in selected:
         if r.layer == "jaxpr" and program.jaxpr is None:

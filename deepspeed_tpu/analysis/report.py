@@ -46,7 +46,9 @@ def summarize(findings: List[Finding]) -> Dict:
 def build_report(per_program: Dict[str, Tuple[List[Finding], Dict]],
                  ast_findings: List[Finding],
                  skipped: Optional[Dict[str, str]] = None,
-                 waivers_in_effect: Optional[List[dict]] = None) -> Dict:
+                 waivers_in_effect: Optional[List[dict]] = None,
+                 cost_by_program: Optional[Dict] = None,
+                 stale_waivers: Optional[List[dict]] = None) -> Dict:
     import jax
     all_findings = [f for fs, _ in per_program.values() for f in fs] + list(ast_findings)
     report = {
@@ -61,9 +63,17 @@ def build_report(per_program: Dict[str, Tuple[List[Finding], Dict]],
         "ast": {"summary": summarize(list(ast_findings))},
         "skipped_scenarios": dict(skipped or {}),
         "waivers_in_effect": list(waivers_in_effect or []),
+        # waivers that covered no current finding: dead acknowledgements
+        # to prune, surfaced as WARNs by the CLI (never gating)
+        "stale_waivers": list(stale_waivers or []),
         "summary": summarize(all_findings),
         "findings": [f.to_dict() for f in all_findings],
     }
+    if cost_by_program is not None:
+        # the --cost pass: per-program static memory estimate + collective
+        # inventory + backend cross-check (analysis/cost.py)
+        report["cost"] = {name: cost.to_dict()
+                          for name, cost in sorted(cost_by_program.items())}
     return report
 
 

@@ -42,6 +42,34 @@ def line_waivers(source: str):
     return out
 
 
+def _string_literal_lines(tree) -> set:
+    """Line numbers covered by string constants (docstrings): a waiver
+    pattern in there is documentation of the syntax, not a waiver."""
+    lines = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            end = getattr(node, "end_lineno", node.lineno)
+            lines.update(range(node.lineno, end + 1))
+    return lines
+
+
+def stale_inline_waivers(files, findings) -> List[dict]:
+    """Inline ``# graft-lint: waive`` comments that sit on a line no
+    current finding points at — the code they excused moved or was fixed,
+    and a stale comment on the wrong line could silently excuse the NEXT
+    edit. Reported as WARNs by the CLI (mirror of
+    :func:`core.stale_config_waivers` for the AST layer)."""
+    locations = {f.location for f in findings}
+    out = []
+    for rel, source, tree in files:
+        doc_lines = _string_literal_lines(tree)
+        for line, (rule_id, reason) in line_waivers(source).items():
+            if line not in doc_lines and f"{rel}:{line}" not in locations:
+                out.append({"kind": "inline", "file": rel, "line": line,
+                            "rule": rule_id, "reason": reason})
+    return out
+
+
 def _dotted(node) -> str:
     """'jax.device_put' for Attribute/Name chains, '' otherwise."""
     parts = []

@@ -99,3 +99,17 @@ def resolve_route(route: Optional[str] = None,
     if kernel is not None:
         k = _check(kernel, KERNEL_CHOICES, "kernel")
     return r, k, src
+
+
+def resolve_intended_route(route: Optional[str] = None) -> str:
+    """The route the *committed configuration* intends, skipping the env
+    layer. graft-audit's R009 pins each MoE scenario's collective
+    signature to this: a ``DS_MOE_ROUTE=dense`` override changes the
+    traced program (through :func:`resolve_route`, like any bench run)
+    but NOT the declared signature — which is exactly how the drift gate
+    catches a forced/leaked route before a chip window banks it."""
+    if route is not None:
+        return _check(route, ROUTE_CHOICES, "route")
+    if _config_route is not None:
+        return _config_route
+    return DEFAULT_ROUTE

@@ -631,16 +631,23 @@ class DeepSpeedEngine:
         traced = fn.trace(*args)
         # lower from the existing trace — fn.lower(*args) would re-trace
         # the whole step (seconds per call at real model sizes)
-        hlo_text = traced.lower().as_text()
+        lowered = traced.lower()
+        hlo_text = lowered.as_text()
         metadata = {
             # the offload paths intentionally do NOT donate params (host
             # masters / cross-memory-kind aliasing is illegal)
             "expect_donation": not self._offload_enabled,
             "multi_device": self.mesh.devices.size > 1,
+            # the cost pass (analysis/cost.py) attributes wire bytes per
+            # mesh axis and sizes replica groups from this
+            "mesh_axes": {str(a): int(s) for a, s in self.mesh.shape.items()},
         }
+        metadata.update(self.config.zero_config.cost_metadata(
+            fsdp_size=int(self.mesh.shape.get("fsdp", 1))))
         cfg_model = getattr(self.module, "config", None)
         moe_experts = getattr(cfg_model, "moe_num_experts", 0) if cfg_model is not None else 0
         if moe_experts:
+            from deepspeed_tpu.moe.routing import resolve_intended_route
             from deepspeed_tpu.moe.sharded_moe import _num_groups, sec_signature
             batch_leaf = np.asarray(jax.tree.leaves(example_batch)[0])
             micro = batch_leaf.shape[0] // self.config.gradient_accumulation_steps
@@ -651,8 +658,18 @@ class DeepSpeedEngine:
                 getattr(cfg_model, "moe_capacity_factor", 1.0),
                 getattr(cfg_model, "moe_min_capacity", 8),
                 k=getattr(cfg_model, "moe_k", 1))]
+            # the collective signature pins the *committed* route intent
+            # (config layers only — resolve_intended_route skips the env),
+            # so a DS_MOE_ROUTE=dense override drifts the program but not
+            # the signature and R009 catches it
+            if resolve_intended_route(getattr(cfg_model, "moe_route", None)) == "sorted":
+                sig = metadata.setdefault("collective_signature", [])
+                sig.append({"layer": "jaxpr", "kind": "dense_dispatch", "count": 0,
+                            "note": "sorted MoE route: the a2a endpoints are fed "
+                                    "by permutation, never an [S,E,C] einsum"})
         return {"train_step": {"jaxpr": traced.jaxpr, "hlo_text": hlo_text,
-                               "metadata": metadata}}
+                               "metadata": metadata,
+                               "lower": lambda: lowered}}
 
     # ------------------------------------------------------------------
     # ZeRO-Offload / ZeRO-Infinity: optimizer states off-device

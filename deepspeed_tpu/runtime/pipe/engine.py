@@ -23,6 +23,8 @@ Here the whole schedule collapses into ONE differentiable ``lax.scan``:
   ``gradient_accumulation_steps``.
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -275,6 +277,41 @@ class PipelineEngine(DeepSpeedEngine):
         # everything through train_batch, pipe/engine.py:286)
 
     # ------------------------------------------------------------------
+    def traced_programs(self, example_batch):
+        """Base metadata plus the pipeline schedule's static-cost
+        contract (graft-audit, analysis/cost.py):
+
+        * ``activation_budget_bytes`` — from ``pipeline.activation_budget_mb``
+          (or the ``DS_PIPE_ACT_BUDGET_MB`` env override, the seeded-
+          regression path mirroring ``DS_MOE_ROUTE``). When declared,
+          R010 gates the statically estimated transient peak against it:
+          the pre-wired CPU gate for the ROADMAP-2 1F1B refactor's
+          ``<=1F1B`` bound. No budget declared = inventoried, not gated.
+        * ``collective_signature`` — each scan tick hops one boundary
+          activation over ``ppermute``; fwd and its transpose share the
+          scan body, so the traced program carries exactly 2
+          ``collective_permute`` sites at the jaxpr layer regardless of
+          microbatch count. A third would mean a second boundary buffer
+          per tick — the drift 1F1B must not introduce.
+        """
+        programs = super().traced_programs(example_batch)
+        metadata = programs["train_step"]["metadata"]
+        pipe_cfg = self.config.raw_dict.get("pipeline", {})
+        budget_mb = os.environ.get("DS_PIPE_ACT_BUDGET_MB",
+                                   pipe_cfg.get("activation_budget_mb"))
+        if budget_mb is not None:
+            metadata["activation_budget_bytes"] = int(float(budget_mb) * 2**20)
+        metadata["pipe_schedule"] = {
+            "stages": self.pipeline.num_stages,
+            "micro_batches": self.micro_batches,
+            "chunk_microbatches": self.pipe_chunk,
+        }
+        sig = metadata.setdefault("collective_signature", [])
+        sig.append({"layer": "jaxpr", "kind": "collective_permute", "count": 2,
+                    "note": "one boundary-activation hop per scan tick "
+                            "(fwd + transposed bwd share the body)"})
+        return programs
+
     def train_batch(self, batch=None, data_iter=None):
         """Reference ``pipe/engine.py:286``: consume ``micro_batches``
         microbatches, return the aggregated loss."""

@@ -39,11 +39,37 @@ def matrix():
 def test_matrix_builds_expected_scenarios(matrix):
     programs, skipped = matrix
     expected = {"gpt2_fwd_bwd", "llama_fwd_bwd", "bert_fwd_bwd",
-                "moe_top1_route", "moe_top2_route", "train_batch_parity"}
+                "moe_top1_route", "moe_top2_route", "train_batch_parity",
+                "zero2_train_step", "zero3_train_step", "moe_ep_step",
+                "pipe_chunked_step"}
     assert expected <= set(programs) | set(skipped)
-    # the pipe scenario is allowed to skip on the 0.4.37 container (the
-    # known partial-manual shard_map gap), never to silently vanish
-    assert "pipe_scan_step" in set(programs) | set(skipped)
+    # the pipe pipe*data*fsdp scenario is allowed to skip on the 0.4.37
+    # container (the known partial-manual shard_map gap) and the
+    # 16-device composition on an 8-device runtime — never to silently
+    # vanish: the skip reasons inventory the gaps
+    for gap in ("pipe_scan_step", "composition_3d_ep_zeropp"):
+        assert gap in set(programs) | set(skipped)
+
+
+def test_cost_signature_metadata_armed(matrix):
+    """The cost-rule metadata must actually arrive — a typo would
+    silently disarm R009/R010 the same way a parity typo would disarm
+    R002/R005."""
+    programs, _ = matrix
+    if "pipe_chunked_step" in programs:
+        meta = programs["pipe_chunked_step"].metadata
+        assert meta.get("activation_budget_bytes", 0) > 0
+        assert any(e["kind"] == "collective_permute"
+                   for e in meta["collective_signature"])
+    for name in ("zero2_train_step", "zero3_train_step"):
+        if name in programs:
+            meta = programs[name].metadata
+            assert meta["zero_stage"] in (2, 3)
+            kinds = {e["kind"] for e in meta["collective_signature"]}
+            assert {"all_gather", "reduce_scatter"} <= kinds
+    if "moe_ep_step" in programs:
+        kinds = {e["kind"] for e in programs["moe_ep_step"].metadata["collective_signature"]}
+        assert {"dense_dispatch", "resharding"} <= kinds
 
 
 def test_clean_matrix_zero_false_positives(matrix):

@@ -9,8 +9,21 @@ NEW unwaived ERROR appears relative to the committed baseline
 the dense MoE dispatch with ``DS_MOE_ROUTE=dense`` — must fail this
 gate; that is the acceptance check.
 
+``--cost`` adds the graft-audit pass (deepspeed_tpu/analysis/cost.py):
+per program, a jaxpr-liveness static memory estimate + the three-layer
+collective inventory (jaxpr / stablehlo / compiled post-SPMD, with the
+backend's own cost/memory analysis as cross-check), rules R009-R012,
+and the R013 ratchet against ``analysis_results/cost_baseline.json``
+(peak bytes + wire bytes + collective counts per scenario; growth past
+tolerance gates). ``--cost --update-baseline`` banks the current costs
+(merge semantics — subset runs refresh only their own entries).
+Seeded cost regressions: ``DS_MOE_ROUTE=dense`` (R009 route-signature
+drift + the dense-einsum memory delta) and ``DS_PIPE_ACT_BUDGET_MB=1``
+(R010 activation budget on the chunked pipe schedule).
+
 Usage:
   python tools/graft_lint.py                         # full matrix + AST, gate vs baseline
+  python tools/graft_lint.py --cost                  # + memory/comms cost pass & ratchet
   python tools/graft_lint.py --scenarios moe_top1_route,moe_top2_route
   python tools/graft_lint.py --update-baseline       # acknowledge current ERRORs
   python tools/graft_lint.py --no-ast | --ast-only
@@ -19,7 +32,12 @@ Usage:
 Waivers: ``analysis_results/waivers.json`` — a list of
 ``{"rule": "R003", "scenario": "train_batch*", "match": "...", "reason": "..."}``
 entries — plus inline ``# graft-lint: waive R008 <reason>`` comments for
-the AST rule. Waived findings report but never gate.
+the AST rule. Waived findings report but never gate; waivers that match
+NO current finding are reported as stale (WARN) so dead entries get
+pruned.
+
+``GRAFT_LINT_DEVICES=16`` raises the forced host-device count so the
+16-virtual-device composition scenario can attempt its trace.
 """
 
 import argparse
@@ -28,13 +46,15 @@ import json
 import os
 import sys
 
-# CPU + an 8-device host mesh BEFORE jax initializes: the matrix includes
-# multi-device programs (same bootstrap as tests/conftest.py)
+# CPU + a multi-device host mesh BEFORE jax initializes: the matrix
+# includes multi-device programs (same bootstrap as tests/conftest.py).
+# GRAFT_LINT_DEVICES overrides the count for the 16-device composition.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+_n_dev = os.environ.get("GRAFT_LINT_DEVICES", "8")
 if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                               + " --xla_force_host_platform_device_count=8").strip()
+                               + f" --xla_force_host_platform_device_count={_n_dev}").strip()
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -72,7 +92,16 @@ def run(argv=None) -> int:
     ap.add_argument("--waivers", default=os.path.join(REPO, "analysis_results", "waivers.json"))
     ap.add_argument("--out", default=os.path.join(REPO, "analysis_results"))
     ap.add_argument("--update-baseline", action="store_true",
-                    help="acknowledge every current ERROR into the baseline and exit 0")
+                    help="acknowledge every current ERROR into the baseline and exit 0 "
+                         "(with --cost: also bank current costs into the cost baseline)")
+    ap.add_argument("--cost", action="store_true",
+                    help="run the graft-audit cost pass: static memory + collective "
+                         "inventory, rules R009-R013, ratchet vs the cost baseline")
+    ap.add_argument("--cost-baseline",
+                    default=os.path.join(REPO, "analysis_results", "cost_baseline.json"))
+    ap.add_argument("--no-compile", action="store_true",
+                    help="with --cost: skip compiling programs (no post-SPMD "
+                         "collective layer / backend cross-check; trace-only)")
     ap.add_argument("--no-ast", action="store_true", help="skip the source AST pass")
     ap.add_argument("--ast-only", action="store_true", help="run ONLY the source AST pass")
     ap.add_argument("--list", action="store_true", help="print rules + scenarios and exit")
@@ -97,20 +126,36 @@ def run(argv=None) -> int:
         print("scenarios:")
         for name in scen.SCENARIOS:
             print(f"  {name}")
+        print("cost metrics (per program, --cost):")
+        print("  peak_bytes / peak_transient_bytes  static liveness estimate (analysis/memory.py)")
+        print("  bytes_moved{jaxpr,stablehlo,compiled}  analytic wire bytes (analysis/hlo_cost.py)")
+        print("  collective counts per layer+kind   ratcheted by R013 vs cost_baseline.json")
         return 0
 
     # ---- program layer -------------------------------------------------
-    per_program, skipped = {}, {}
+    per_program, skipped, cost_by_program = {}, {}, {}
     if not args.ast_only:
         names = args.scenarios.split(",") if args.scenarios else None
         programs, skipped = scen.build(names)
         for info in programs:
-            findings, metrics = analysis.run_program_rules(info)
+            analyzer = analysis.ProgramAnalyzer(info)
+            findings, metrics = analysis.run_program_rules(info, analyzer=analyzer)
+            if args.cost:
+                cost = analysis.build_cost(info, analyzer=analyzer,
+                                           compile=not args.no_compile)
+                findings.extend(analysis.run_cost_rules(info, cost, analyzer))
+                cost_by_program[info.name] = cost
             per_program[info.name] = (findings, metrics)
             if not args.quiet:
                 s = analysis.summarize(findings)
-                print(f"  {info.name:24s} rules_hit={s['rule_hits'] or '{}'} "
-                      f"errors={s['errors']}")
+                line = (f"  {info.name:24s} rules_hit={s['rule_hits'] or '{}'} "
+                        f"errors={s['errors']}")
+                if args.cost:
+                    cost = cost_by_program[info.name]
+                    line += (f" peak={cost.memory.peak_bytes / 2**20:.1f}MiB "
+                             f"transient={cost.memory.peak_transient_bytes / 2**20:.1f}MiB "
+                             f"comms={cost.bytes_moved()}")
+                print(line)
         for name, reason in skipped.items():
             print(f"  {name:24s} SKIPPED: {reason}")
 
@@ -125,6 +170,16 @@ def run(argv=None) -> int:
             print(f"  {'<source AST>':24s} rules_hit={s['rule_hits'] or '{}'} "
                   f"errors={s['errors']} waived={s['waived']}")
 
+    # ---- cost ratchet (R013) -------------------------------------------
+    cost_baseline = None
+    if args.cost and not args.ast_only:
+        cost_baseline = analysis.load_cost_baseline(args.cost_baseline)
+        if not args.update_baseline:
+            ratchet = analysis.r013_cost_ratchet(cost_by_program, cost_baseline)
+            for f in ratchet:
+                fs, metrics = per_program.setdefault(f.scenario, ([], {}))
+                fs.append(f)
+
     # ---- waivers -------------------------------------------------------
     waiver_entries = []
     if os.path.exists(args.waivers):
@@ -134,10 +189,31 @@ def run(argv=None) -> int:
     all_findings = [f for fs, _ in per_program.values() for f in fs] + ast_findings
     analysis.apply_waivers(all_findings, waivers)
 
+    # ---- stale waivers (WARN, never gating) ----------------------------
+    # config waivers are judged only on full-matrix program runs (a subset
+    # run legitimately produces no findings for the scenarios it skipped);
+    # inline waivers are judged whenever the AST pass swept all files
+    stale = []
+    if not args.ast_only and args.scenarios is None:
+        from deepspeed_tpu.analysis.core import stale_config_waivers
+        for w in stale_config_waivers(all_findings, waivers):
+            stale.append({"kind": "config", "rule": w.rule, "scenario": w.scenario,
+                          "match": w.match, "reason": w.reason})
+    if not args.no_ast:
+        from deepspeed_tpu.analysis.source_rules import stale_inline_waivers
+        stale.extend(stale_inline_waivers(files, ast_findings))
+    for s in stale:
+        where = (f"{s['file']}:{s['line']}" if s["kind"] == "inline"
+                 else f"{s['rule']}/{s['scenario']}")
+        print(f"graft-lint: WARN stale waiver [{s['kind']}] {where} matches no "
+              f"current finding — prune it", file=sys.stderr)
+
     # ---- report --------------------------------------------------------
     sig = analysis.matrix_signature(list(per_program) + (["ast"] if not args.no_ast else []))
     report = analysis.build_report(per_program, ast_findings, skipped=skipped,
-                                   waivers_in_effect=waiver_entries)
+                                   waivers_in_effect=waiver_entries,
+                                   cost_by_program=cost_by_program if args.cost else None,
+                                   stale_waivers=stale)
     path = analysis.write_report(report, args.out, sig)
     if not args.quiet:
         print(f"report: {os.path.relpath(path, REPO)}")
@@ -151,6 +227,14 @@ def run(argv=None) -> int:
             fh.write("\n")
         print(f"baseline updated: {os.path.relpath(args.baseline, REPO)} "
               f"({len(baseline['fingerprints'])} acknowledged ERRORs)")
+        if args.cost and cost_by_program:
+            new_cost = analysis.cost_baseline_from(cost_by_program, prior=cost_baseline)
+            with open(args.cost_baseline, "w") as fh:
+                json.dump(new_cost, fh, indent=2)
+                fh.write("\n")
+            print(f"cost baseline updated: {os.path.relpath(args.cost_baseline, REPO)} "
+                  f"({len(cost_by_program)} program(s) refreshed, "
+                  f"{len(new_cost['programs'])} total)")
         return 0
 
     baseline = analysis.load_baseline(args.baseline)

@@ -111,12 +111,38 @@ def compare(curve_a, curve_b):
     }
 
 
+def precision_attribution():
+    """R002's per-(src->dst, scope) upcast tally for the parity program —
+    the graft-lint metric that tells the ROADMAP-4 ULP hunt *where* the
+    numerics widen. Surfacing it here means the hunt reads ONE report:
+    the curve and its attribution come from the same tool invocation
+    instead of cross-referencing a separate lint run. Trace-only (a
+    couple of seconds next to the training steps); any failure degrades
+    to an error string rather than killing the curve.
+    ``PARITY_ATTRIBUTION=0`` opts out."""
+    if os.environ.get("PARITY_ATTRIBUTION", "1") != "1":
+        return None
+    try:
+        from deepspeed_tpu.analysis import run_program_rules
+        from deepspeed_tpu.analysis import scenarios as scen
+
+        info = scen.SCENARIOS["train_batch_parity"]()
+        _, metrics = run_program_rules(info, rules=["R002"])
+        return metrics.get("precision_attribution", {})
+    except Exception as e:  # noqa: BLE001 — evidence must never kill the curve
+        return {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+
+
 def main():
     import jax
     vals = curve()
-    print(json.dumps({"backend": jax.default_backend(),
-                      "curve_hex": to_hex(vals),
-                      "curve": [round(v, 6) for v in vals]}))
+    out = {"backend": jax.default_backend(),
+           "curve_hex": to_hex(vals),
+           "curve": [round(v, 6) for v in vals]}
+    attribution = precision_attribution()
+    if attribution is not None:
+        out["precision_attribution"] = attribution
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

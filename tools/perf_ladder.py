@@ -69,11 +69,28 @@ def run_rung(tag, model_name, mb, offload=False, steps=None, seq=None,
     else:
         fused = int(os.environ.get("LADDER_FUSED", "10"))
         n_steps, dt, compile_s = time_fused(engine, batch, fused=fused)
+    # ONE trace shared by both static-evidence paths (tracing a real
+    # model's step costs seconds; lint and cost must not each pay it)
+    programs = _traced_programs_evidence(engine, batch)
     report(tag, mb, seq or SEQ, n_params, n_steps, dt, compile_s, cfg=cfg,
            **attn_geometry_evidence(cfg, mb, seq or SEQ),
            **moe_route_evidence(cfg),
-           **lint_evidence(engine, batch),
+           **lint_evidence(engine, batch, programs),
+           **cost_evidence(engine, batch, programs),
            **(retry_evidence or {}))
+
+
+def _traced_programs_evidence(engine, batch):
+    """The engine's traced step, computed once for every evidence helper
+    that needs it; None (with the evidence paths degrading to their own
+    error rows) when tracing itself fails or both paths are opted out."""
+    if (os.environ.get("LADDER_LINT", "1") != "1"
+            and os.environ.get("LADDER_COST", "1") != "1"):
+        return None
+    try:
+        return engine.traced_programs(batch)
+    except Exception:  # each evidence helper reports its own error row
+        return None
 
 
 def attn_geometry_evidence(cfg, mb, seq):
@@ -119,7 +136,7 @@ def moe_route_evidence(cfg):
                 "moe_route_source": "error"}
 
 
-def lint_evidence(engine, batch):
+def lint_evidence(engine, batch, programs=None):
     """graft-lint summary of the step program this rung actually measured
     (rule hit counts / waivers / clean flag — deepspeed_tpu/analysis): a
     banked TFLOPS row must prove the measured program passed the same
@@ -130,9 +147,29 @@ def lint_evidence(engine, batch):
         return {}
     try:
         from deepspeed_tpu.analysis import lint_engine_program
-        return lint_engine_program(engine, batch)
+        return lint_engine_program(engine, batch, programs=programs)
     except Exception as e:  # evidence must never kill a rung
         return {"lint_error": f"{type(e).__name__}: {str(e)[:120]}"}
+
+
+def cost_evidence(engine, batch, programs=None):
+    """graft-audit static-cost summary of the measured step program
+    (deepspeed_tpu/analysis/cost.py): predicted peak bytes (total +
+    transient) and analytic wire bytes per inventory layer, so every
+    banked TFLOPS number carries its predicted memory/comms cost next to
+    the measured one — the window-to-window sanity check that a faster
+    rung didn't buy its speed with a fatter schedule. Trace-only (the
+    rung's own compile is never repeated for evidence); the compiled
+    collective layer therefore appears only where the trace carries
+    explicit collectives (shard_map programs) or reshard sites.
+    LADDER_COST=0 opts out."""
+    if os.environ.get("LADDER_COST", "1") != "1":
+        return {}
+    try:
+        from deepspeed_tpu.analysis import cost_engine_program
+        return cost_engine_program(engine, batch, programs=programs)
+    except Exception as e:  # evidence must never kill a rung
+        return {"cost_error": f"{type(e).__name__}: {str(e)[:120]}"}
 
 
 RUNGS = {
