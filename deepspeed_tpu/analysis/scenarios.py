@@ -312,15 +312,23 @@ def moe_ep_step() -> ProgramInfo:
         set_topology(None)
 
 
-@scenario("pipe_chunked_step")
-def pipe_chunked_step() -> ProgramInfo:
-    """The chunked-wave pipeline schedule on a pipe=2-only mesh (every
-    auto axis size 1 folds to full-manual, so this traces on the 0.4.37
-    container where ``pipe_scan_step``'s pipe x data x fsdp mesh cannot).
-    The pipe engine stamps ``activation_budget_bytes`` + the 2-ppermute
-    signature; ``DS_PIPE_ACT_BUDGET_MB`` below the schedule's static
-    estimate is the seeded R010 regression — the same gate the ROADMAP-2
-    1F1B refactor must pass with a tighter budget."""
+#: the committed 1F1B activation budget (MiB) for the pipe=2 scenario
+#: mesh below. Formula (README "Pipeline parallelism"): stash ring
+#: ``2(S-1)`` boundary slots + 2 in transit (S=2: 4 x 16 KiB) + the fp32
+#: grad accumulators (~0.6 MiB params) + one tick's recompute transient
+#: (block internals + [mb, seq, vocab] epilogue logits) + the optimizer
+#: update's own temporaries — measured 1.90 MiB static transient on the
+#: pinned container, committed at 2.0 MiB (~5% headroom). Strictly below
+#: the chunked schedule's 2.25 MiB measured transient (and its prior
+#: 4 MiB commit), so the SAME budget fails the chunked schedule — the
+#: ratchet with teeth (test_cost_gate).
+PIPE_1F1B_BUDGET_MB = 2.0
+
+
+def _pipe_engine_program(name: str, pipeline_cfg: dict) -> ProgramInfo:
+    """Shared pipe=2-only builder (every auto axis size 1 folds to
+    full-manual, so these trace on the 0.4.37 container where
+    ``pipe_scan_step``'s pipe x data x fsdp mesh cannot)."""
     import deepspeed_tpu
     from deepspeed_tpu.models import get_gpt2_config
     from deepspeed_tpu.models.gpt2 import gpt2_pipe_layers
@@ -328,7 +336,7 @@ def pipe_chunked_step() -> ProgramInfo:
     from deepspeed_tpu.runtime.pipe.module import PipelineModule
 
     if len(jax.devices()) < 2:
-        raise ScenarioSkipped("pipe_chunked_step needs >=2 devices")
+        raise ScenarioSkipped(f"{name} needs >=2 devices")
     set_topology(None)
     try:
         cfg = get_gpt2_config("test", n_layer=2)
@@ -337,20 +345,44 @@ def pipe_chunked_step() -> ProgramInfo:
         engine, _, _, _ = deepspeed_tpu.initialize(
             model=pipe, topology=topo,
             config={"train_batch_size": 8, "gradient_accumulation_steps": 4,
-                    # the committed budget: the chunked-wave schedule's
-                    # measured static transient peak (2.25 MiB on the
-                    # pinned container) + headroom. The 1F1B refactor's
-                    # done-criterion is ratcheting this DOWN to the S-slot
-                    # bound with R010 still green.
-                    "pipeline": {"chunk_microbatches": 2,
-                                 "activation_budget_mb": 4},
+                    "pipeline": pipeline_cfg,
                     "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}})
         batch = {"input_ids": np.zeros((8, 32), np.int32)}
-        return _engine_program("pipe_chunked_step", engine, batch)
+        return _engine_program(name, engine, batch)
     except NotImplementedError as e:  # partial-manual shard_map gap
         raise ScenarioSkipped(f"shard_map unsupported here: {e}") from e
     finally:
         set_topology(None)
+
+
+@scenario("pipe_chunked_step")
+def pipe_chunked_step() -> ProgramInfo:
+    """The chunked-wave pipeline schedule — kept as the A/B reference
+    against ``pipe_1f1b_step`` (same mesh, same model, same microbatch
+    count). Its committed budget is its own measured static transient
+    (2.25 MiB) + headroom — tightened from the pre-1F1B 4 MiB commit;
+    ``DS_PIPE_ACT_BUDGET_MB`` below the estimate (e.g. the 1F1B bound)
+    is the seeded R010 regression proving the chunked schedule cannot
+    pass the 1F1B budget."""
+    return _pipe_engine_program(
+        "pipe_chunked_step",
+        # measured 2.25 MiB static transient on the pinned container
+        {"chunk_microbatches": 2, "activation_budget_mb": 2.5})
+
+
+@scenario("pipe_1f1b_step")
+def pipe_1f1b_step() -> ProgramInfo:
+    """The 1F1B schedule (the default) under its committed activation
+    bound (:data:`PIPE_1F1B_BUDGET_MB` — formula in the constant's
+    docstring). R010 gates the manual-vjp program's static transient
+    against it; R009 pins the 4-``collective_permute`` signature (2 per
+    tick boundary across the 3 phase bodies). Any schedule regression —
+    an extra stash slot, autodiff residuals sneaking back in, a third
+    boundary buffer — fails lint on CPU before a chip window pays for
+    it."""
+    return _pipe_engine_program(
+        "pipe_1f1b_step",
+        {"schedule": "1f1b", "activation_budget_mb": PIPE_1F1B_BUDGET_MB})
 
 
 @scenario("composition_3d_ep_zeropp")

@@ -145,8 +145,10 @@ def profile_engine_step(engine, device_batch, rng, step_latency_s=None,
         if hasattr(engine, "_ensure_params_resident"):
             engine._ensure_params_resident()
         if getattr(engine, "_host_opt", None) is not None:
+            import jax.numpy as jnp
             train_compiled = engine._grads_only_fn.lower(
-                engine.state.params, device_batch, rng).compile()
+                engine.state.params, device_batch, rng,
+                jnp.float32(1.0)).compile()
             notes.append("offload path: profiled program is the device fwd+bwd "
                          "(grads-only); the optimizer update runs on host")
         elif (engine._onebit_cfg is not None and engine._onebit_step_fn is not None

@@ -2,12 +2,24 @@
 ``data_pipeline/data_sampling/indexed_dataset.py:617`` ``MMapIndexedDataset``).
 
 Same capability — O(1) random access to variable-length token sequences from
-two flat files without loading them — but a fresh, minimal format rather
-than the Megatron binary layout the reference inherits:
+two flat files without loading them — in two on-disk layouts:
 
-``<prefix>.bin``  raw tokens, back to back.
-``<prefix>.idx``  header (magic, version, dtype code, count) + ``sizes``
-                  (u32 per sequence) + ``pointers`` (u64 element offsets).
+* **native** (this repo's minimal format):
+  ``<prefix>.bin``  raw tokens, back to back.
+  ``<prefix>.idx``  header (magic ``DSTPUIDX``, version, dtype code,
+                    count) + ``sizes`` (u32 per sequence) + ``pointers``
+                    (u64 *element* offsets, count+1 of them).
+* **megatron** (the Megatron-LM binary layout the reference inherits,
+  ``indexed_dataset.py:617`` — magic ``MMIDIDX\\x00\\x00``): header
+  (version u64, dtype code u8, sequence count u64, document count u64) +
+  ``sizes`` (i32 per sequence) + ``pointers`` (i64 *byte* offsets, one
+  per sequence) + ``doc_idx`` (i64 sequence indices of document starts).
+  Reading it directly means corpora tokenized by Megatron/DeepSpeed
+  preprocessing pipelines feed this engine without a conversion pass.
+
+``MMapIndexedDataset`` sniffs the magic and reads either;
+``MMapIndexedDatasetBuilder(..., fmt="megatron")`` writes the Megatron
+layout (with ``end_document`` tracking) for round-trips and export.
 
 Reads are ``np.memmap`` slices — the OS page cache is the shard buffer,
 which is the right model for a TPU host feeding ``device_put``.
@@ -22,10 +34,18 @@ import numpy as np
 _MAGIC = b"DSTPUIDX"
 _VERSION = 1
 
+#: Megatron-LM index magic + version (reference ``_HDR_MAGIC``)
+_MEGATRON_MAGIC = b"MMIDIDX\x00\x00"
+_MEGATRON_VERSION = 1
+
 # stable on-disk dtype codes (reference ``dtypes`` table indexed_dataset.py:117)
 _DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32, 5: np.int64,
            6: np.float32, 7: np.float64, 8: np.uint16, 9: np.uint32}
 _DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+#: the Megatron table stops at uint16 (its vocab-size-driven pick)
+_MEGATRON_DTYPES = {k: v for k, v in _DTYPES.items() if k <= 8}
+_MEGATRON_DTYPE_CODES = {np.dtype(v): k for k, v in _MEGATRON_DTYPES.items()}
 
 
 def find_fit_int_dtype(low: int, high: int):
@@ -50,14 +70,24 @@ def index_file_path(prefix: str) -> str:
 
 class MMapIndexedDatasetBuilder:
     """Streaming writer (reference ``MMapIndexedDatasetBuilder``
-    indexed_dataset.py:570)."""
+    indexed_dataset.py:570). ``fmt="megatron"`` emits the Megatron-LM
+    binary layout instead of the native one — byte pointers + a
+    ``doc_idx`` built from :meth:`end_document` calls. A builder that
+    never calls ``end_document`` writes ONE document spanning the whole
+    corpus (``doc_idx=[0, N]``) — call it per sequence for per-sequence
+    documents."""
 
-    def __init__(self, out_file_prefix: str, dtype=np.int32):
+    def __init__(self, out_file_prefix: str, dtype=np.int32, fmt: str = "native"):
+        if fmt not in ("native", "megatron"):
+            raise ValueError(f"fmt must be 'native' or 'megatron', got {fmt!r}")
         self._prefix = out_file_prefix
+        self._fmt = fmt
         self._dtype = np.dtype(dtype)
-        assert self._dtype in _DTYPE_CODES, f"unsupported dtype {dtype}"
+        codes = _MEGATRON_DTYPE_CODES if fmt == "megatron" else _DTYPE_CODES
+        assert self._dtype in codes, f"unsupported dtype {dtype} for fmt={fmt}"
         self._bin = open(data_file_path(out_file_prefix), "wb")
         self._sizes = []
+        self._doc_idx = [0]
 
     def add_item(self, tokens: Union[Sequence[int], np.ndarray]) -> None:
         arr = np.asarray(tokens, dtype=self._dtype)
@@ -65,10 +95,23 @@ class MMapIndexedDatasetBuilder:
         self._bin.write(arr.tobytes(order="C"))
         self._sizes.append(len(arr))
 
+    def end_document(self) -> None:
+        """Mark a document boundary (reference ``end_document``): the
+        sequences added since the previous boundary form one document in
+        the Megatron ``doc_idx``. No-op for the native layout."""
+        self._doc_idx.append(len(self._sizes))
+
     def merge_file_(self, other_prefix: str) -> None:
-        """Append another dataset with the same dtype (reference :595)."""
+        """Append another dataset with the same dtype (reference :595).
+        In megatron format the other dataset's document boundaries are
+        carried over (shifted by the current sequence count; an open
+        document is closed first so shards never fuse across the seam) —
+        a native-layout source contributes per-sequence documents."""
         other = MMapIndexedDataset(other_prefix)
         assert other._dtype == self._dtype, "dtype mismatch in merge"
+        if self._fmt == "megatron" and self._doc_idx[-1] != len(self._sizes):
+            self.end_document()
+        base = len(self._sizes)
         with open(data_file_path(other_prefix), "rb") as f:
             while True:
                 chunk = f.read(1 << 22)
@@ -76,9 +119,14 @@ class MMapIndexedDatasetBuilder:
                     break
                 self._bin.write(chunk)
         self._sizes.extend(other.sizes.tolist())
+        if self._fmt == "megatron":
+            # other.doc_idx[0] is always 0 (the seam just closed above)
+            self._doc_idx.extend(base + int(d) for d in other.doc_idx[1:])
 
     def finalize(self) -> None:
         self._bin.close()
+        if self._fmt == "megatron":
+            return self._finalize_megatron()
         sizes = np.asarray(self._sizes, dtype=np.uint32)
         pointers = np.zeros(len(sizes) + 1, dtype=np.uint64)
         np.cumsum(sizes, out=pointers[1:])
@@ -88,24 +136,52 @@ class MMapIndexedDatasetBuilder:
             f.write(sizes.tobytes(order="C"))
             f.write(pointers.tobytes(order="C"))
 
+    def _finalize_megatron(self) -> None:
+        sizes = np.asarray(self._sizes, dtype=np.int32)
+        # byte offsets, one per sequence (reference ``_get_pointers``)
+        pointers = np.zeros(len(sizes), dtype=np.int64)
+        if len(sizes) > 1:
+            np.cumsum(sizes[:-1] * self._dtype.itemsize, out=pointers[1:])
+        doc_idx = self._doc_idx
+        if doc_idx[-1] != len(sizes):
+            # close a still-open document (no trailing end_document());
+            # with no end_document calls at all this yields [0, N] — one
+            # document spanning the corpus (class docstring)
+            doc_idx = doc_idx + [len(sizes)]
+        doc_idx = np.asarray(doc_idx, dtype=np.int64)
+        with open(index_file_path(self._prefix), "wb") as f:
+            f.write(_MEGATRON_MAGIC)
+            f.write(struct.pack("<Q", _MEGATRON_VERSION))
+            f.write(struct.pack("<B", _MEGATRON_DTYPE_CODES[self._dtype]))
+            f.write(struct.pack("<Q", len(sizes)))
+            f.write(struct.pack("<Q", len(doc_idx)))
+            f.write(sizes.tobytes(order="C"))
+            f.write(pointers.tobytes(order="C"))
+            f.write(doc_idx.tobytes(order="C"))
+
 
 class MMapIndexedDataset:
     """Zero-copy random-access reader (reference ``MMapIndexedDataset``
-    indexed_dataset.py:420)."""
+    indexed_dataset.py:420). Sniffs the index magic: reads the native
+    layout AND the Megatron-LM ``MMIDIDX`` layout (byte pointers +
+    ``doc_idx``) — both normalize to *element*-offset ``pointers``
+    internally, so ``__getitem__``/``get`` are layout-blind."""
 
     def __init__(self, path_prefix: str):
         self._prefix = path_prefix
-        with open(index_file_path(path_prefix), "rb") as f:
-            magic = f.read(len(_MAGIC))
-            assert magic == _MAGIC, f"{index_file_path(path_prefix)}: bad magic {magic!r}"
-            version, code, count = struct.unpack("<IBQ", f.read(13))
-            assert version == _VERSION, f"unsupported index version {version}"
-            self._dtype = np.dtype(_DTYPES[code])
-            offset = f.tell()
-        self._sizes = np.memmap(index_file_path(path_prefix), dtype=np.uint32,
-                                mode="r", offset=offset, shape=(count,))
-        self._pointers = np.memmap(index_file_path(path_prefix), dtype=np.uint64,
-                                   mode="r", offset=offset + 4 * count, shape=(count + 1,))
+        idx_path = index_file_path(path_prefix)
+        with open(idx_path, "rb") as f:
+            magic = f.read(len(_MEGATRON_MAGIC))
+        if magic == _MEGATRON_MAGIC:
+            self._fmt = "megatron"
+            self._read_megatron_index(idx_path)
+        elif magic[:len(_MAGIC)] == _MAGIC:
+            self._fmt = "native"
+            self._read_native_index(idx_path)
+        else:
+            raise AssertionError(f"{idx_path}: bad magic {magic[:len(_MAGIC)]!r} "
+                                 f"(neither {_MAGIC!r} nor Megatron "
+                                 f"{_MEGATRON_MAGIC!r})")
         if os.path.getsize(data_file_path(path_prefix)) == 0:
             # a legitimately empty dataset (e.g. a metric with no samples):
             # mmap rejects zero-byte files
@@ -113,12 +189,69 @@ class MMapIndexedDataset:
         else:
             self._data = np.memmap(data_file_path(path_prefix), dtype=self._dtype, mode="r")
 
+    def _read_native_index(self, idx_path: str) -> None:
+        with open(idx_path, "rb") as f:
+            f.read(len(_MAGIC))
+            version, code, count = struct.unpack("<IBQ", f.read(13))
+            assert version == _VERSION, f"unsupported index version {version}"
+            self._dtype = np.dtype(_DTYPES[code])
+            offset = f.tell()
+        self._sizes = np.memmap(idx_path, dtype=np.uint32,
+                                mode="r", offset=offset, shape=(count,))
+        self._pointers = np.memmap(idx_path, dtype=np.uint64,
+                                   mode="r", offset=offset + 4 * count, shape=(count + 1,))
+        self._doc_idx = np.arange(count + 1, dtype=np.int64)
+
+    def _read_megatron_index(self, idx_path: str) -> None:
+        """The reference layout (indexed_dataset.py:617 ``Index``):
+        version u64 | dtype u8 | seq count u64 | doc count u64 | sizes
+        i32[count] | pointers i64[count] (BYTE offsets) | doc_idx
+        i64[doc_count]."""
+        with open(idx_path, "rb") as f:
+            f.read(len(_MEGATRON_MAGIC))
+            (version,) = struct.unpack("<Q", f.read(8))
+            assert version == _MEGATRON_VERSION, \
+                f"unsupported Megatron index version {version}"
+            (code,) = struct.unpack("<B", f.read(1))
+            assert code in _MEGATRON_DTYPES, f"unknown Megatron dtype code {code}"
+            self._dtype = np.dtype(_MEGATRON_DTYPES[code])
+            count, doc_count = struct.unpack("<QQ", f.read(16))
+            offset = f.tell()
+        self._sizes = np.memmap(idx_path, dtype=np.int32, mode="r",
+                                offset=offset, shape=(count,))
+        byte_pointers = np.memmap(idx_path, dtype=np.int64, mode="r",
+                                  offset=offset + 4 * count, shape=(count,))
+        self._doc_idx = np.memmap(idx_path, dtype=np.int64, mode="r",
+                                  offset=offset + 4 * count + 8 * count,
+                                  shape=(doc_count,))
+        # normalize byte offsets -> element offsets (+ the final sentinel
+        # the native layout stores explicitly)
+        item = self._dtype.itemsize
+        if count and (byte_pointers % item).any():
+            raise AssertionError(f"{idx_path}: byte pointers not aligned to "
+                                 f"dtype {self._dtype} (itemsize {item})")
+        pointers = np.empty(count + 1, dtype=np.uint64)
+        pointers[:count] = byte_pointers // item
+        pointers[count] = (0 if not count
+                           else pointers[count - 1] + np.uint64(self._sizes[-1]))
+        self._pointers = pointers
+
     def __len__(self) -> int:
         return len(self._sizes)
 
     @property
     def sizes(self) -> np.ndarray:
         return self._sizes
+
+    @property
+    def doc_idx(self) -> np.ndarray:
+        """Document boundaries as sequence indices (Megatron semantics;
+        the native layout reports one document per sequence)."""
+        return self._doc_idx
+
+    @property
+    def fmt(self) -> str:
+        return self._fmt
 
     @property
     def dtype(self):

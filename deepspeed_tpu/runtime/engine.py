@@ -484,10 +484,13 @@ class DeepSpeedEngine:
         off = self.config.zero_config.offload_optimizer
         self._offload_enabled = off is not None and getattr(off, "device", "none") not in (None, "none")
         if self._offload_enabled:
-            # moments live off-device (host RAM / NVMe): no optax state
-            if self._fp16_mode:
-                raise NotImplementedError("offload_optimizer with fp16 loss scaling is not "
-                                          "supported; use bf16 or fp32")
+            # moments live off-device (host RAM / NVMe): no optax state.
+            # fp16 composes: the grads-only device program scales the loss
+            # and unscales the gradients BEFORE they leave the chip
+            # (reference stage_1_and_2.py:1086 — unscale-and-clip on
+            # device, fp32 master update on host), so the host Adam only
+            # ever sees unscaled fp32 gradients and overflow steps skip
+            # the host update entirely (_offload_train_batch).
             aopt, opt_shardings = {}, {}
         else:
             aopt = jax.eval_shape(self.optimizer.init, aparams)
@@ -601,7 +604,8 @@ class DeepSpeedEngine:
             # offload_optimizer: the device program is the grads-only pass
             # (the update runs on host) — its memory_analysis IS the
             # candidate's HBM footprint, which is what the autotuner prunes on
-            return self._grads_only_fn, (abstract.params, abatch, arng)
+            ascale = jax.ShapeDtypeStruct((), jnp.float32)
+            return self._grads_only_fn, (abstract.params, abatch, arng, ascale)
         if getattr(self, "_param_offload_enabled", False):
             # the offload step fn splits (params, rest) so the device-resident
             # rest can be donated; memory_analysis() of this lowering is the
@@ -877,17 +881,50 @@ class DeepSpeedEngine:
 
         self._onebit_step_fn = jax.jit(step, donate_argnums=(0, 1))
 
+    def _jit_train_steps(self, train_step):
+        """N optimizer steps per dispatch: scan ``train_step`` over a
+        leading steps axis of device-resident batches. The idiomatic TPU
+        training loop (host dispatch + per-step host sync cost amortizes
+        over N) — the reference has no analog because torch re-enters
+        Python every step by construction. Shared by the fused engine and
+        the pipeline engine (``train_batches`` contract: per-step RNG
+        derives from one split; metrics stack along the steps axis)."""
+        mesh = self.mesh
+
+        def train_steps(state: TrainState, batches, rng):
+            keys = jax.random.split(rng, jax.tree.leaves(batches)[0].shape[0])
+
+            def body(st, xs):
+                b, key = xs
+                return train_step(st, b, key)
+
+            return jax.lax.scan(body, state, (batches, keys))
+
+        return jax.jit(
+            train_steps,
+            in_shardings=(self.state_shardings, None, NamedSharding(mesh, P())),
+            out_shardings=(self.state_shardings, NamedSharding(mesh, P())),
+            donate_argnums=(0,),
+        )
+
     def _build_offload_step_fns(self, grad_shardings):
-        """Device side of the offload path: fwd+bwd+clip only; the update
-        happens on host."""
+        """Device side of the offload path: fwd+bwd+unscale+clip only; the
+        fp32 master update happens on host. Under fp16 the live dynamic
+        loss scale rides in as an argument — ``_accumulate_grads`` scales
+        the loss and divides the gradients back down ON DEVICE (reference
+        ``stage_1_and_2.py:1086`` unscale-and-clip), so host masters never
+        see a scaled gradient and the overflow flag travels with the
+        grads."""
         gas = self.config.gradient_accumulation_steps
         clip = self.config.gradient_clipping
         mesh = self.mesh
+        fp16 = self._fp16_mode
 
-        def grads_only(params, batch, rng):
-            return self._accumulate_grads(params, batch, rng, jnp.float32(1.0), grad_shardings,
-                                          gas, clip, fp16=False)
+        def grads_only(params, batch, rng, scale):
+            return self._accumulate_grads(params, batch, rng, scale, grad_shardings,
+                                          gas, clip, fp16=fp16)
 
+        repl = NamedSharding(mesh, P())
         if getattr(self, "_param_offload_enabled", False):
             # ZeRO-Infinity full combo (param + optimizer offload): params
             # rest on host and stream through the grads pass; outputs keep
@@ -896,13 +933,12 @@ class DeepSpeedEngine:
             # the grads in-graph)
             self._grads_only_fn = jax.jit(
                 grads_only,
-                in_shardings=(self.state_shardings.params, None, NamedSharding(mesh, P())))
+                in_shardings=(self.state_shardings.params, None, repl, repl))
         else:
             self._grads_only_fn = jax.jit(
                 grads_only,
-                in_shardings=(self.state_shardings.params, None, NamedSharding(mesh, P())),
-                out_shardings=(NamedSharding(mesh, P()), grad_shardings, NamedSharding(mesh, P()),
-                               NamedSharding(mesh, P())))
+                in_shardings=(self.state_shardings.params, None, repl, repl),
+                out_shardings=(repl, grad_shardings, repl, repl))
 
     def _setup_offload_optimizer(self):
         off = self.config.zero_config.offload_optimizer
@@ -960,9 +996,15 @@ class DeepSpeedEngine:
     def _offload_train_batch(self, device_batch, rng):
         """fwd+bwd on device (jitted), optimizer update on host via the C++
         kernel (reference async_accumulate_grad_in_cpu_via_gpu +
-        cpu_adam path, stage_1_and_2.py:1086)."""
+        cpu_adam path, stage_1_and_2.py:1086). fp16: the device program
+        consumed the live dynamic scale and already unscaled the grads;
+        an overflow step skips the host update and cuts the scale through
+        the same loss-scaler state machine as the fused path."""
         self._ensure_params_resident()
-        loss, grads, gnorm, overflow = self._grads_only_fn(self.state.params, device_batch, rng)
+        scale = (self.state.loss_scale.loss_scale if self._fp16_mode
+                 else jnp.float32(1.0))
+        loss, grads, gnorm, overflow = self._grads_only_fn(
+            self.state.params, device_batch, rng, scale)
         if bool(overflow):
             new_ls = self._ls_update(self.state.loss_scale, jnp.asarray(True))
             self.state = self.state._replace(loss_scale=new_ls, step=self.state.step + 1)
@@ -1756,32 +1798,13 @@ class DeepSpeedEngine:
                 donate_argnums=(0,),
             )
 
-        # N optimizer steps per dispatch: scan train_step over a leading
-        # steps axis of device-resident batches. The idiomatic TPU training
-        # loop (host dispatch + per-step host sync cost amortizes over N) —
-        # the reference has no analog because torch re-enters Python every
-        # step by construction.
-        def train_steps(state: TrainState, batches, rng):
-            keys = jax.random.split(rng, jax.tree.leaves(batches)[0].shape[0])
-
-            def body(st, xs):
-                b, key = xs
-                return train_step(st, b, key)
-
-            return jax.lax.scan(body, state, (batches, keys))
-
         if getattr(self, "_param_offload_enabled", False):
             # a scanned multi-step would carry params on device across the
             # whole scan — exactly the residency offload removes. train_batches
             # falls back to per-step dispatch (the host round-trip IS the point).
             self._train_steps_fn = None
         else:
-            self._train_steps_fn = jax.jit(
-                train_steps,
-                in_shardings=(self.state_shardings, None, NamedSharding(mesh, P())),
-                out_shardings=(self.state_shardings, NamedSharding(mesh, P())),
-                donate_argnums=(0,),
-            )
+            self._train_steps_fn = self._jit_train_steps(train_step)
 
         def eval_step(params, mb, step):
             # eval must score the same network training optimizes: the

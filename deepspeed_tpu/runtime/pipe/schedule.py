@@ -177,6 +177,46 @@ class TrainSchedule(PipeSchedule):
         return (step_id - 1) // 2 - self.stages + 1 + self.stage_id // 2
 
 
+def one_f_one_b_table(micro_batches, stages):
+    """The combined-tick 1F1B schedule as data: per tick, per stage,
+    ``(fwd_micro | None, bwd_micro | None)``.
+
+    This is the static specification of the scan executed by
+    ``PipelineEngine._pipeline_1f1b_grads_fn``: stage ``s`` forwards micro
+    ``m`` at tick ``m + s`` (the GPipe fill wave) and backwards it at tick
+    ``m + 2(S-1) - s`` (the gradient arrives one tick per stage after the
+    last stage seeds it from the loss — at the last stage fwd and bwd of
+    the same micro share a tick). Structure:
+
+    * ticks ``[0, S-1)``          — warmup: forward-only, no stage has a
+      valid backward;
+    * ticks ``[S-1, M+S-1)``      — steady 1F1B: every tick carries one
+      forward and one backward per active stage;
+    * ticks ``[M+S-1, M+2S-2)``   — cooldown: backward-only drain.
+
+    The forward→backward lag at stage ``s`` is ``2(S-1-s)`` ticks, so the
+    per-stage in-flight forward stash is bounded by ``2(S-1)`` slots
+    (attained at stage 0) — constant in ``M``, the bound the committed
+    ``pipeline.activation_budget_mb`` prices. The reference even/odd
+    half-tick interleave (``TrainSchedule``, reference ``schedule.py:189``)
+    bounds stage ``s`` at ``S-s`` buffers by issuing forwards every other
+    half-tick; the combined-tick form trades ≤2x that bound (still
+    constant in M) for a body XLA executes without per-stage branch
+    divergence — under SPMD every stage runs the same tick program.
+    """
+    total = micro_batches + 2 * stages - 2
+    table = []
+    for t in range(total):
+        row = []
+        for s in range(stages):
+            f = t - s
+            b = t - 2 * (stages - 1) + s
+            row.append((f if 0 <= f < micro_batches else None,
+                        b if 0 <= b < micro_batches else None))
+        table.append(row)
+    return table
+
+
 class DataParallelSchedule(PipeSchedule):
     """Plain gradient-accumulation DP expressed as a schedule (reference
     ``schedule.py:301``)."""

@@ -30,12 +30,12 @@ def graft_lint():
 
 @pytest.fixture(autouse=True)
 def _clean():
-    for env in (routing.ENV_ROUTE, "DS_PIPE_ACT_BUDGET_MB"):
+    for env in (routing.ENV_ROUTE, "DS_PIPE_ACT_BUDGET_MB", "DS_PIPE_SCHEDULE"):
         os.environ.pop(env, None)
     set_topology(None)
     routing.set_default_route(None, None)
     yield
-    for env in (routing.ENV_ROUTE, "DS_PIPE_ACT_BUDGET_MB"):
+    for env in (routing.ENV_ROUTE, "DS_PIPE_ACT_BUDGET_MB", "DS_PIPE_SCHEDULE"):
         os.environ.pop(env, None)
     set_topology(None)
     routing.set_default_route(None, None)
@@ -52,19 +52,32 @@ def test_committed_cost_baseline_covers_the_matrix():
     assert baseline["version"] == 1
     programs = baseline["programs"]
     # the gate scenarios must be banked or the ratchet has no teeth
-    for name in ("moe_ep_step", "pipe_chunked_step", "zero3_train_step",
-                 "train_batch_parity"):
+    for name in ("moe_ep_step", "pipe_chunked_step", "pipe_1f1b_step",
+                 "zero3_train_step", "train_batch_parity"):
         assert name in programs, name
         assert programs[name]["peak_bytes"] > 0
         assert "collective_counts" in programs[name]
+    # the banked 1F1B transient must sit strictly below both the chunked
+    # schedule's transient AND its own committed budget — the ratchet-DOWN
+    # this PR's schedule refactor banked (PERF.md §PR11)
+    from deepspeed_tpu.analysis.scenarios import PIPE_1F1B_BUDGET_MB
+    t_1f1b = programs["pipe_1f1b_step"]["peak_transient_bytes"]
+    t_chunked = programs["pipe_chunked_step"]["peak_transient_bytes"]
+    assert t_1f1b < t_chunked
+    assert t_1f1b <= PIPE_1F1B_BUDGET_MB * 2**20 < t_chunked
+    # 2 boundary hops per tick boundary across the 3 phase bodies
+    assert programs["pipe_1f1b_step"]["collective_counts"]["jaxpr"][
+        "collective_permute"] == 4
 
 
 def test_cost_gate_passes_clean_subset(graft_lint, tmp_path):
-    rc = graft_lint.run(["--cost", "--scenarios", "moe_ep_step,pipe_chunked_step",
+    rc = graft_lint.run(["--cost", "--scenarios",
+                         "moe_ep_step,pipe_chunked_step,pipe_1f1b_step",
                          "--no-ast", "--out", str(tmp_path), "-q"])
     assert rc == 0
     report = _report(tmp_path)
-    assert set(report["cost"]) == {"moe_ep_step", "pipe_chunked_step"}
+    assert set(report["cost"]) == {"moe_ep_step", "pipe_chunked_step",
+                                   "pipe_1f1b_step"}
     for name, cost in report["cost"].items():
         assert cost["memory"]["peak_bytes"] > 0
         assert cost["memory"]["peak_transient_bytes"] > 0
@@ -101,11 +114,13 @@ def test_dense_route_regression_exits_1_with_cost_delta(graft_lint, tmp_path,
     assert counts.get("dense_dispatch", 0) >= 1
 
 
-def test_pipe_activation_budget_regression_exits_1(graft_lint, tmp_path,
-                                                   monkeypatch):
-    """The ROADMAP-2 pre-wired gate: a declared activation budget below
-    the chunked-wave schedule's static estimate must fail the run."""
-    monkeypatch.setenv("DS_PIPE_ACT_BUDGET_MB", "1")
+def test_chunked_schedule_fails_under_the_1f1b_budget(graft_lint, tmp_path,
+                                                      monkeypatch):
+    """The ROADMAP-2 gate, cashed in: the chunked-wave schedule forced
+    under the SAME activation budget the 1F1B scenario passes must fail
+    the run — the tightened bound bites."""
+    from deepspeed_tpu.analysis.scenarios import PIPE_1F1B_BUDGET_MB
+    monkeypatch.setenv("DS_PIPE_ACT_BUDGET_MB", str(PIPE_1F1B_BUDGET_MB))
     rc = graft_lint.run(["--cost", "--scenarios", "pipe_chunked_step",
                          "--no-ast", "--out", str(tmp_path), "-q"])
     assert rc == 1
@@ -113,6 +128,20 @@ def test_pipe_activation_budget_regression_exits_1(graft_lint, tmp_path,
     assert report["programs"]["pipe_chunked_step"]["summary"]["rule_hits"].get("R010")
     budget_msgs = [f for f in report["findings"] if f["rule"] == "R010"]
     assert budget_msgs and "budget" in budget_msgs[0]["message"]
+
+
+def test_pipe_schedule_env_drift_exits_1(graft_lint, tmp_path, monkeypatch):
+    """DS_PIPE_SCHEDULE=chunked against the committed-1f1b scenario: the
+    traced program drifts but the stamped signature pins the config
+    intent (the DS_MOE_ROUTE pattern), so R009 fires on the permute
+    count — and the chunked program also busts the 1F1B budget (R010)."""
+    monkeypatch.setenv("DS_PIPE_SCHEDULE", "chunked")
+    rc = graft_lint.run(["--cost", "--scenarios", "pipe_1f1b_step",
+                         "--no-ast", "--out", str(tmp_path), "-q"])
+    assert rc == 1
+    report = _report(tmp_path)
+    hits = report["programs"]["pipe_1f1b_step"]["summary"]["rule_hits"]
+    assert hits.get("R009") and hits.get("R010")
 
 
 def test_cost_update_baseline_roundtrip(graft_lint, tmp_path, monkeypatch):

@@ -41,7 +41,7 @@ def test_matrix_builds_expected_scenarios(matrix):
     expected = {"gpt2_fwd_bwd", "llama_fwd_bwd", "bert_fwd_bwd",
                 "moe_top1_route", "moe_top2_route", "train_batch_parity",
                 "zero2_train_step", "zero3_train_step", "moe_ep_step",
-                "pipe_chunked_step"}
+                "pipe_chunked_step", "pipe_1f1b_step"}
     assert expected <= set(programs) | set(skipped)
     # the pipe pipe*data*fsdp scenario is allowed to skip on the 0.4.37
     # container (the known partial-manual shard_map gap) and the
@@ -60,6 +60,17 @@ def test_cost_signature_metadata_armed(matrix):
         meta = programs["pipe_chunked_step"].metadata
         assert meta.get("activation_budget_bytes", 0) > 0
         assert any(e["kind"] == "collective_permute"
+                   for e in meta["collective_signature"])
+    if "pipe_1f1b_step" in programs:
+        meta = programs["pipe_1f1b_step"].metadata
+        assert meta["pipe_schedule"]["schedule"] == "1f1b"
+        assert meta["pipe_schedule"]["stash_slots"] == 2
+        assert meta.get("activation_budget_bytes", 0) > 0
+        # the tightened bound must undercut the chunked scenario's budget
+        if "pipe_chunked_step" in programs:
+            assert (meta["activation_budget_bytes"]
+                    < programs["pipe_chunked_step"].metadata["activation_budget_bytes"])
+        assert any(e["kind"] == "collective_permute" and e["count"] == 4
                    for e in meta["collective_signature"])
     for name in ("zero2_train_step", "zero3_train_step"):
         if name in programs:

@@ -181,6 +181,81 @@ def test_engine_cpu_offload_matches_gpu_path():
     np.testing.assert_allclose(losses["cpu"], losses["device"], rtol=2e-3)
 
 
+def test_engine_cpu_offload_fp16_trains_and_skips_on_overflow():
+    """fp16 loss scaling + offloaded optimizer (the refusal lifted this
+    PR): gradients are unscaled ON DEVICE before the host master update
+    (reference stage_1_and_2.py:1086), training converges, and a
+    poisoned batch flows through the REAL loss-scaler path — the host
+    update is skipped, params hold still, the dynamic scale cuts, and
+    the skip lands in ``skipped_steps``."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+    from deepspeed_tpu.parallel.topology import set_topology
+    from deepspeed_tpu.runtime.resilience.faults import (overflow_injected_loss,
+                                                         poison_batch)
+
+    set_topology(None)
+    cfg = get_gpt2_config("test")
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(cfg), loss_fn=overflow_injected_loss(),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "fp16": {"enabled": True, "loss_scale": 0,
+                         "initial_scale_power": 8, "hysteresis": 1},
+                "zero_optimization": {"stage": 2,
+                                      "offload_optimizer": {"device": "cpu"}}})
+    rng = np.random.default_rng(3)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (8, 32)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch)) for _ in range(4)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+    assert engine.skipped_steps == 0
+
+    # inf-boosted loss -> non-finite fp16 grads -> device overflow flag ->
+    # host update skipped + scale cut, nothing mocked
+    wte_before = np.asarray(jax.device_get(engine.state.params["wte"]))
+    scale_before = float(engine.state.loss_scale.loss_scale)
+    engine.train_batch(poison_batch(batch))
+    np.testing.assert_array_equal(
+        wte_before, np.asarray(jax.device_get(engine.state.params["wte"])))
+    assert float(engine.state.loss_scale.loss_scale) < scale_before
+    assert engine.skipped_steps == 1
+
+    # recovery: clean batches train on from the held params
+    more = [float(engine.train_batch(batch)) for _ in range(2)]
+    assert np.isfinite(more).all()
+    set_topology(None)
+
+
+def test_engine_cpu_offload_fp16_matches_fused_fp16_path():
+    """Same model, same data, same fp16 config: the offloaded host-Adam
+    step and the fused on-device step produce matching loss curves — the
+    device-side unscale feeds the host masters the same gradients optax
+    sees."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+    from deepspeed_tpu.parallel.topology import set_topology
+
+    cfg = get_gpt2_config("test")
+    rng = np.random.default_rng(5)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (8, 32)).astype(np.int32)}
+    losses = {}
+    for mode in ("device", "cpu"):
+        set_topology(None)
+        zero = {"stage": 0 if mode == "device" else 2}
+        if mode == "cpu":
+            zero["offload_optimizer"] = {"device": "cpu"}
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT2LMHeadModel(cfg),
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "fp16": {"enabled": True, "loss_scale": 0,
+                             "initial_scale_power": 8},
+                    "zero_optimization": zero})
+        losses[mode] = [float(engine.train_batch(batch)) for _ in range(4)]
+    set_topology(None)
+    np.testing.assert_allclose(losses["cpu"], losses["device"], rtol=5e-3)
+
+
 def test_engine_nvme_offload_trains(tmp_path):
     """ZeRO-Infinity: optimizer states on 'NVMe' (tmp dir), training works
     and state files appear."""

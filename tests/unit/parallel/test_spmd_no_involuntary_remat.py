@@ -29,7 +29,19 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(os.path.a
 WARNING = "Involuntary full rematerialization"
 
 
-@pytest.mark.parametrize("mesh_fn", ["_dryrun_tp_sp_fsdp", "_dryrun_pipe", "_dryrun_moe"])
+def _pipe_mesh_supported():
+    from deepspeed_tpu.utils.jax_compat import PARTIAL_MANUAL_OK
+    return PARTIAL_MANUAL_OK
+
+
+@pytest.mark.parametrize("mesh_fn", [
+    "_dryrun_tp_sp_fsdp",
+    pytest.param("_dryrun_pipe", marks=pytest.mark.skipif(
+        not _pipe_mesh_supported(),
+        reason="jax-0.4.37 partial-manual shard_map gap: the pipe dryrun "
+               "mesh has live auto axes (utils/jax_compat.py docstring; "
+               "sentinel: tests/unit/runtime/pipe/test_pipe.py)")),
+    "_dryrun_moe"])
 def test_dryrun_mesh_compiles_without_involuntary_remat(mesh_fn):
     if REPO not in sys.path:
         sys.path.insert(0, REPO)

@@ -86,11 +86,16 @@ def enable_compile_cache():
         pass
 
 
-def build_engine(model_name, mb, seq, ds_overrides=None, **cfg_overrides):
+def build_engine(model_name, mb, seq, ds_overrides=None, pipe_stages=0,
+                 **cfg_overrides):
     """Engine + batch at the bench methodology's defaults (bf16, flash
     attention, remat). ``model_name`` picks the family: ``bert_<preset>``
     builds a BERT MLM engine (the reference's 64-TFLOPS headline workload,
     BERT-large pretrain); anything else is a GPT-2 causal-LM preset.
+    ``pipe_stages>0`` builds the GPT-2 preset as a PipelineModule on a
+    pipe-only mesh (``mb`` is then the GLOBAL batch; pass
+    ``gradient_accumulation_steps`` in ``ds_overrides`` for the
+    microbatch count, ``pipeline.schedule`` for the tick schedule).
     Returns (engine, batch, n_params, cfg)."""
     import deepspeed_tpu
 
@@ -122,7 +127,19 @@ def build_engine(model_name, mb, seq, ds_overrides=None, **cfg_overrides):
         cfg = get_gpt2_config(model_name, n_positions=seq, remat=True,
                               attention_backend="flash", dtype=jnp.bfloat16,
                               **cfg_overrides)
-        engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2LMHeadModel(cfg), config=ds)
+        if pipe_stages:
+            from deepspeed_tpu.models.gpt2 import gpt2_pipe_layers
+            from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
+            from deepspeed_tpu.runtime.pipe.module import PipelineModule
+
+            set_topology(None)
+            topo = MeshTopology(pipe=pipe_stages, data=1,
+                                devices=jax.devices()[:pipe_stages])
+            module = PipelineModule(layers=gpt2_pipe_layers(cfg), topology=topo)
+            engine, _, _, _ = deepspeed_tpu.initialize(model=module, config=ds,
+                                                       topology=topo)
+        else:
+            engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2LMHeadModel(cfg), config=ds)
         batch = {"input_ids": rng.integers(0, cfg.vocab_size, (mb, seq)).astype(np.int32)}
     engine.initialize_state(batch)
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(engine.state.params))

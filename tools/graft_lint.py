@@ -18,8 +18,12 @@ and the R013 ratchet against ``analysis_results/cost_baseline.json``
 tolerance gates). ``--cost --update-baseline`` banks the current costs
 (merge semantics — subset runs refresh only their own entries).
 Seeded cost regressions: ``DS_MOE_ROUTE=dense`` (R009 route-signature
-drift + the dense-einsum memory delta) and ``DS_PIPE_ACT_BUDGET_MB=1``
-(R010 activation budget on the chunked pipe schedule).
+drift + the dense-einsum memory delta), ``DS_PIPE_ACT_BUDGET_MB=2``
+on ``pipe_chunked_step`` (R010: the chunked schedule cannot fit the
+1F1B activation budget the ``pipe_1f1b_step`` scenario passes), and
+``DS_PIPE_SCHEDULE=chunked`` on ``pipe_1f1b_step`` (R009: the program
+drifts but the stamped collective signature pins the config-committed
+schedule intent — 4 ``collective_permute`` sites vs the drifted 2).
 
 Usage:
   python tools/graft_lint.py                         # full matrix + AST, gate vs baseline

@@ -17,11 +17,27 @@ BENCH_MOE_CF); routes from BENCH_MOE_ROUTES (default "dense,sorted").
 Each route row also reports ``dispatch_peak_bytes`` — the routing
 metadata + dispatch buffers the route materializes (the dense route's
 [S,E,C] tensors vs the sorted route's [S*k] index vectors).
+
+Pipe mode (``BENCH_PIPE=1``): the pipeline-schedule A/B — times
+``train_batch`` per schedule (BENCH_PIPE_SCHEDULES, default
+"1f1b,chunked,gpipe") on a pipe-only mesh (BENCH_PIPE_STAGES=4,
+BENCH_PIPE_MICROS=16, BENCH_MICRO_BS=2, BENCH_SEQ=128,
+BENCH_PIPE_EMBD=128, BENCH_PIPE_MODEL=test) and stamps each row with the
+schedule's STATIC transient-bytes estimate (analysis.cost_engine_program,
+trace-only) so the measured step time rides next to the activation bound
+R010 gates — the PERF.md §PR11 table regenerates from these rows.
 """
 import json
 import os
 import sys
 import time
+
+# BENCH_DEVICES=N forces a virtual host-device count (the pipe A/B needs
+# a pipe mesh on CPU); must land in XLA_FLAGS before jax imports.
+_n_dev = os.environ.get("BENCH_DEVICES")
+if _n_dev and "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + f" --xla_force_host_platform_device_count={_n_dev}").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -178,9 +194,76 @@ def moe_sections():
         }), flush=True)
 
 
+def pipe_schedule_ab():
+    """Per-schedule pipeline A/B: measured step time + static transient
+    bytes per schedule on the same mesh/model/microbatch count. CPU-safe
+    (pipe-only mesh folds to full-manual shard_map on jax 0.4.37)."""
+    from deepspeed_tpu.analysis import cost_engine_program
+    from deepspeed_tpu.models.gpt2 import gpt2_pipe_layers
+    from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
+    from deepspeed_tpu.runtime.pipe.module import PipelineModule
+
+    stages = int(os.environ.get("BENCH_PIPE_STAGES", "4"))
+    micros = int(os.environ.get("BENCH_PIPE_MICROS", "16"))
+    mb = int(os.environ.get("BENCH_MICRO_BS", "2"))
+    seq = int(os.environ.get("BENCH_SEQ", "128"))
+    embd = int(os.environ.get("BENCH_PIPE_EMBD", "128"))
+    model = os.environ.get("BENCH_PIPE_MODEL", "test")
+    schedules = os.environ.get("BENCH_PIPE_SCHEDULES", "1f1b,chunked,gpipe").split(",")
+    steps = int(os.environ.get("BENCH_PIPE_STEPS", "5"))
+    if len(jax.devices()) < stages:
+        print(json.dumps({"tag": "pipe_ab", "error":
+                          f"needs {stages} devices, have {len(jax.devices())}"}))
+        return
+    print(f"# pipe schedule A/B S={stages} M={micros} mb={mb} seq={seq} "
+          f"embd={embd} model={model}", flush=True)
+    rng = np.random.default_rng(0)
+    for schedule in schedules:
+        schedule = schedule.strip()
+        set_topology(None)
+        cfg = get_gpt2_config(model, n_layer=stages, n_embd=embd,
+                              n_head=max(2, embd // 32), n_positions=seq)
+        topo = MeshTopology(pipe=stages, data=1, devices=jax.devices()[:stages])
+        pipe = PipelineModule(layers=gpt2_pipe_layers(cfg), topology=topo)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=pipe, topology=topo,
+            config={"train_batch_size": micros * mb,
+                    "gradient_accumulation_steps": micros,
+                    "pipeline": {"schedule": schedule},
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                    "steps_per_print": 10**9})
+        batch = {"input_ids": rng.integers(0, cfg.vocab_size,
+                                           (micros * mb, seq)).astype(np.int32)}
+        t0 = time.time()
+        engine.train_batch(batch)
+        jax.block_until_ready(engine.state.params)
+        compile_s = time.time() - t0
+        t0 = time.time()
+        for _ in range(steps):
+            engine.train_batch(batch)
+        jax.block_until_ready(engine.state.params)
+        dt = (time.time() - t0) / steps
+        row = {"tag": f"pipe_{schedule}", "pipe_schedule": engine.pipe_schedule,
+               "stages": stages, "micro_batches": micros,
+               "chunk_microbatches": engine.pipe_chunk,
+               "step_ms": round(dt * 1e3, 1),
+               "compile_s": round(compile_s, 1),
+               "loss": round(float(engine.train_batch(batch)), 4)}
+        try:  # static evidence next to the measured number (trace-only)
+            row.update(cost_engine_program(engine, batch))
+        except Exception as e:  # evidence must never kill a row
+            row["cost_error"] = f"{type(e).__name__}: {str(e)[:120]}"
+        print(json.dumps(row), flush=True)
+    set_topology(None)
+
+
 def main():
     if os.environ.get("BENCH_MOE", "0") == "1":
         moe_sections()
+        print("# DONE", flush=True)
+        return
+    if os.environ.get("BENCH_PIPE", "0") == "1":
+        pipe_schedule_ab()
         print("# DONE", flush=True)
         return
     cfg = get_gpt2_config(MODEL, n_positions=SEQ, remat=True,

@@ -28,6 +28,14 @@ import sys
 import time
 import traceback
 
+# multi-device CPU smoke (pipe rungs need a pipe mesh): LADDER_DEVICES=N
+# forces a virtual host-device count, same contract as GRAFT_LINT_DEVICES.
+# Must land in XLA_FLAGS before bench_core imports jax.
+_n_dev = os.environ.get("LADDER_DEVICES")
+if _n_dev and "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + f" --xla_force_host_platform_device_count={_n_dev}").strip()
+
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from bench_core import (build_engine, enable_compile_cache, report,
@@ -37,7 +45,8 @@ SEQ = 1024
 
 
 def run_rung(tag, model_name, mb, offload=False, steps=None, seq=None,
-             fused_xent=False, ds=None, cfg_overrides=None, retry_evidence=None):
+             fused_xent=False, ds=None, cfg_overrides=None, pipe_stages=0,
+             retry_evidence=None):
     ds_overrides = dict(ds or {})
     if offload:
         # full ZeRO-Infinity single-chip recipe: params rest pinned-host and
@@ -62,7 +71,8 @@ def run_rung(tag, model_name, mb, offload=False, steps=None, seq=None,
             overrides["fused_head_loss_chunk"] = 1024
     overrides.update(cfg_overrides or {})  # rung-specific model-config knobs (MoE, ...)
     engine, batch, n_params, cfg = build_engine(
-        model_name, mb, seq or SEQ, ds_overrides=ds_overrides, **overrides)
+        model_name, mb, seq or SEQ, ds_overrides=ds_overrides,
+        pipe_stages=pipe_stages, **overrides)
     if offload:
         # host-driven schedule: per-step dispatch is the real path here
         n_steps, dt, compile_s = time_per_dispatch(engine, batch, steps or 3)
@@ -210,6 +220,23 @@ RUNGS = {
                                 cfg_overrides=dict(moe_num_experts=8,
                                                    moe_layer_freq=2, moe_k=1,
                                                    moe_route="dense")),
+    # pipeline-schedule A/B at the 350m judged config (PR 11): same mesh,
+    # same 16-microbatch global batch, only the tick schedule differs.
+    # 1f1b holds the constant 2(S-1)-slot activation stash with per-tick
+    # fwd/bwd interleave; chunked pays a fill/drain bubble per C=4 wave
+    # and ~2x the activation bound (CPU A/B: 1f1b 1.19x faster at the
+    # M=16/S=4 test shape, PERF.md §PR11 — the chip window prices the
+    # same pair at real scale, where the freed HBM also buys microbatch)
+    "350m_pipe4_1f1b": dict(model_name="350m", mb=16, pipe_stages=4,
+                            ds={"gradient_accumulation_steps": 16,
+                                "pipeline": {"schedule": "1f1b"}}),
+    "350m_pipe4_chunked": dict(model_name="350m", mb=16, pipe_stages=4,
+                               ds={"gradient_accumulation_steps": 16,
+                                   "pipeline": {"schedule": "chunked",
+                                                "chunk_microbatches": 4}}),
+    "smoke_pipe": dict(model_name="test", mb=8, seq=64, pipe_stages=2,
+                       ds={"gradient_accumulation_steps": 4,
+                           "pipeline": {"schedule": "1f1b"}}),
     # long-context rungs: the gridded flash kernel streams K/V blocks, so
     # VMEM no longer caps sequence length; fused xent keeps the logits
     # buffers off the OOM line at long L. Rows report the chosen attention
