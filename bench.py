@@ -244,9 +244,61 @@ def _parity_report(timeout):
         envelope = int(os.environ.get("PARITY_MAX_ULP", "0"))
         rep["within_envelope"] = rep["max_ulp"] <= envelope or rep["bit_identical"]
         rep["envelope_ulp"] = envelope
+        # the accelerator curve's per-(src->dst, scope) upcast inventory
+        # (R002 via tools/parity_check) rides along so a refused bank
+        # carries its own ULP-hunt evidence
+        if a.get("precision_attribution") is not None:
+            rep["precision_attribution"] = a["precision_attribution"]
         return rep
     except Exception as e:  # noqa: BLE001
         return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _attribution_by_scope(attribution):
+    """Collapse R002's ``"src->dst @ scope": count`` tally to per-scope
+    totals — the compact summary a refused bank records (which scopes
+    widen, not every op instance)."""
+    by_scope = {}
+    for key, count in (attribution or {}).items():
+        if not isinstance(count, int):
+            continue  # error dicts degrade to empty
+        scope = key.split("@", 1)[1].strip() if "@" in key else key
+        by_scope[scope] = by_scope.get(scope, 0) + count
+    return dict(sorted(by_scope.items(), key=lambda kv: -kv[1]))
+
+
+def _apply_parity_bank_gate(result, banked_path):
+    """ROADMAP item 4, last clause: a round whose parity phase reports
+    ``within_envelope: false`` must not bank its throughput number
+    silently. The refusal (or the explicit ``PARITY_BANK_ANYWAY=1``
+    override) and a per-scope ``precision_attribution`` summary are
+    recorded in the bench JSON either way, so every banked number carries
+    its parity verdict. Returns True when the banked number survives."""
+    par = result.get("parity") or {}
+    if par.get("within_envelope") is not False:
+        return True
+    gate = {
+        "within_envelope": False,
+        "max_ulp": par.get("max_ulp"),
+        "envelope_ulp": par.get("envelope_ulp"),
+        "precision_attribution_by_scope":
+            _attribution_by_scope(par.get("precision_attribution")),
+    }
+    if os.environ.get("PARITY_BANK_ANYWAY", "0") == "1":
+        gate["banked_anyway"] = True
+        result["parity_bank"] = gate
+        print("# parity outside envelope; banking anyway (PARITY_BANK_ANYWAY=1)",
+              flush=True)
+        return True
+    gate["refused"] = ("parity within_envelope=false — throughput number not "
+                       "banked; set PARITY_BANK_ANYWAY=1 to override")
+    result["parity_bank"] = gate
+    try:
+        os.unlink(banked_path)
+    except OSError:
+        pass
+    print(f"# BANK REFUSED: {gate['refused']}", flush=True)
+    return False
 
 
 def _last_json_line(text):
@@ -311,6 +363,10 @@ def main():
             if os.environ.get("BENCH_PARITY", "1") == "1":
                 result["parity"] = _parity_report(
                     int(os.environ.get("BENCH_PARITY_TIMEOUT", "600")))
+                # an out-of-envelope round un-banks the pre-parity number
+                # (ROADMAP 4: determinism is a product feature, not a
+                # footnote) — the JSON line still reports everything
+                _apply_parity_bank_gate(result, banked)
             print(json.dumps(result))
             return
         errors.append(f"accel bench: rc={rc} "

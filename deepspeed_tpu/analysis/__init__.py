@@ -28,9 +28,16 @@ from deepspeed_tpu.analysis.memory import MemoryEstimate, estimate_memory
 from deepspeed_tpu.analysis.cost import (CostInfo, build_cost, cost_baseline_from,
                                          cost_engine_program, load_cost_baseline,
                                          r013_cost_ratchet, run_cost_rules)  # registers R009-R013
+from deepspeed_tpu.analysis.search import (SPACES, Candidate, SearchSpace,
+                                           enumerate_candidates, flops_proxy,
+                                           gate_space_names, load_search_artifact,
+                                           pareto, price_candidate,
+                                           r014_search_frontier, run_space,
+                                           search_artifact_from,
+                                           verify_spaces)  # registers R014
 from deepspeed_tpu.analysis.report import (baseline_from, build_report, load_baseline,
-                                           matrix_signature, new_errors, summarize,
-                                           write_report)
+                                           matrix_signature, new_errors, rules_markdown,
+                                           summarize, write_report)
 
 __all__ = [
     "ERROR", "WARN", "INFO", "RULES", "Finding", "Rule", "Waiver",
@@ -40,8 +47,11 @@ __all__ = [
     "MemoryEstimate", "estimate_memory",
     "CostInfo", "build_cost", "run_cost_rules", "r013_cost_ratchet",
     "load_cost_baseline", "cost_baseline_from", "cost_engine_program",
+    "SPACES", "Candidate", "SearchSpace", "enumerate_candidates", "flops_proxy",
+    "gate_space_names", "load_search_artifact", "pareto", "price_candidate",
+    "r014_search_frontier", "run_space", "search_artifact_from", "verify_spaces",
     "baseline_from", "build_report", "load_baseline", "matrix_signature",
-    "new_errors", "summarize", "write_report",
+    "new_errors", "rules_markdown", "summarize", "write_report",
 ]
 
 

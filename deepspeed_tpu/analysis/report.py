@@ -61,6 +61,10 @@ def build_report(per_program: Dict[str, Tuple[List[Finding], Dict]],
             for name, (fs, metrics) in per_program.items()
         },
         "ast": {"summary": summarize(list(ast_findings))},
+        # structured blocking gaps ({"kind", "detail"} per skipped
+        # scenario, scenarios.ScenarioSkipped.kind): the composition
+        # scenario's first blocking gap is a ratchetable metric here, not
+        # a prose string (ROADMAP-5 burn-down)
         "skipped_scenarios": dict(skipped or {}),
         "waivers_in_effect": list(waivers_in_effect or []),
         # waivers that covered no current finding: dead acknowledgements
@@ -84,6 +88,19 @@ def write_report(report: Dict, out_dir: str, sig: str) -> str:
         json.dump(report, fh, indent=2, sort_keys=False)
         fh.write("\n")
     return path
+
+
+def rules_markdown() -> str:
+    """The README rule table, generated FROM the registry (``graft_lint
+    --rules-md``). The README embeds this output verbatim and a tier-1
+    test asserts every registry row is present, so a new rule can never
+    ship with stale docs again (the R013 drift this replaced)."""
+    from deepspeed_tpu.analysis.core import RULES
+    lines = ["| rule | severity | layer | what it gates |",
+             "|------|----------|-------|---------------|"]
+    for r in sorted(RULES.values(), key=lambda r: r.id):
+        lines.append(f"| {r.id} | {r.severity} | {r.layer} | {r.title} |")
+    return "\n".join(lines)
 
 
 # ---------------------------------------------------------------------------

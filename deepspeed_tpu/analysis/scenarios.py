@@ -35,7 +35,42 @@ SCENARIOS: Dict[str, Callable[[], ProgramInfo]] = {}
 
 class ScenarioSkipped(Exception):
     """Raised by a builder when its program cannot trace on this runtime
-    (e.g. partial-manual shard_map on jax 0.4.37) — reported, not fatal."""
+    (e.g. partial-manual shard_map on jax 0.4.37) — reported, not fatal.
+    ``kind`` is a stable machine-readable gap class so reports carry a
+    structured ``blocking_gap: {kind, detail}`` instead of a prose string
+    (the ROADMAP-5 burn-down reads the kind, not the wording)."""
+
+    def __init__(self, detail: str, kind: str = "other"):
+        super().__init__(detail)
+        self.kind = kind
+
+
+#: the composition scenario's gap burn-down order (ROADMAP item 5): each
+#: entry blocks the ones after it, so progress is strictly monotone in
+#: this list and the ratchet test (tests/unit/analysis/test_scenarios.py)
+#: asserts the current gap's rank never moves backward.
+COMPOSITION_GAP_ORDER = ("device_count", "partial_manual", "moe_in_pipe", "none")
+
+
+def composition_gap_rank(kind: str) -> int:
+    """Rank of a gap kind in the burn-down order; unknown kinds rank -1
+    (strictly behind every known gap — a regression by definition)."""
+    try:
+        return COMPOSITION_GAP_ORDER.index(kind)
+    except ValueError:
+        return -1
+
+
+def composition_blocking_gap() -> Dict[str, str]:
+    """Build the ROADMAP-5 composition scenario and report its FIRST
+    blocking gap as structured data: ``{"kind", "detail"}``, with kind
+    ``"none"`` once the full pipe x expert x tensor x fsdp + qgZ program
+    traces clean."""
+    try:
+        SCENARIOS["composition_3d_ep_zeropp"]()
+    except ScenarioSkipped as e:
+        return {"kind": e.kind, "detail": str(e)}
+    return {"kind": "none", "detail": "composition traces clean"}
 
 
 def scenario(name: str):
@@ -407,12 +442,13 @@ def composition_3d_ep_zeropp() -> ProgramInfo:
         raise ScenarioSkipped(
             f"needs 16 virtual devices for pipe=2 x expert=2 x tensor=2 x "
             f"fsdp=2 (have {len(jax.devices())}; run tools/graft_lint.py "
-            f"with GRAFT_LINT_DEVICES=16)")
+            f"with GRAFT_LINT_DEVICES=16)", kind="device_count")
     if not PARTIAL_MANUAL_OK:
         raise ScenarioSkipped(
             "jax-0.4.37 partial-manual shard_map gap: the pipe axis is "
             "manual while expert/tensor/fsdp stay auto at size 2 "
-            "(utils/jax_compat.py) — the composition traces on jax>=0.5")
+            "(utils/jax_compat.py) — the composition traces on jax>=0.5",
+            kind="partial_manual")
     set_topology(None)
     try:
         cfg = get_gpt2_config("test", n_layer=4, moe_num_experts=2,
@@ -422,7 +458,8 @@ def composition_3d_ep_zeropp() -> ProgramInfo:
         try:
             layers = gpt2_pipe_layers(cfg)
         except ValueError as e:  # MoE-in-pipe unsupported (aux-loss drop)
-            raise ScenarioSkipped(f"MoE blocks in the pipelined GPT-2: {e}") from e
+            raise ScenarioSkipped(f"MoE blocks in the pipelined GPT-2: {e}",
+                                  kind="moe_in_pipe") from e
         pipe = PipelineModule(layers=layers, topology=topo)
         engine, _, _, _ = deepspeed_tpu.initialize(
             model=pipe, topology=topo,
@@ -433,7 +470,8 @@ def composition_3d_ep_zeropp() -> ProgramInfo:
         batch = {"input_ids": np.zeros((8, 32), np.int32)}
         return _engine_program("composition_3d_ep_zeropp", engine, batch)
     except NotImplementedError as e:
-        raise ScenarioSkipped(f"composition untraceable here: {e}") from e
+        raise ScenarioSkipped(f"composition untraceable here: {e}",
+                              kind="partial_manual") from e
     finally:
         set_topology(None)
 
@@ -441,7 +479,10 @@ def composition_3d_ep_zeropp() -> ProgramInfo:
 # ---------------------------------------------------------------------------
 def build(names: Optional[List[str]] = None):
     """Build the matrix. Returns ``(programs, skipped)`` where ``skipped``
-    is ``{name: reason}`` for scenarios this runtime cannot trace."""
+    maps each scenario this runtime cannot trace to its structured
+    blocking gap ``{"kind", "detail"}`` (``ScenarioSkipped.kind``) — the
+    shape the report commits as ``skipped_scenarios`` so gap burn-down is
+    a metric, not a prose diff."""
     unknown = [n for n in names or [] if n not in SCENARIOS]
     if unknown:
         raise ValueError(f"unknown scenario(s) {unknown}; valid: {sorted(SCENARIOS)}")
@@ -453,5 +494,5 @@ def build(names: Optional[List[str]] = None):
                 info.metadata["multi_device"] = info.kind == "train_step"
             programs.append(info)
         except ScenarioSkipped as e:
-            skipped[name] = str(e)
+            skipped[name] = {"kind": e.kind, "detail": str(e)}
     return programs, skipped

@@ -1,0 +1,586 @@
+"""graft-search: static cost-model-driven program search over engine knobs.
+
+PR 7 (graft-lint) and PR 10 (graft-audit) built a static stack that can
+*price* a traced program — liveness-walk peak/transient bytes plus the
+per-participant bytes-moved collective model — in seconds on CPU, but
+until now it only gated. This module turns the gate into a *search*
+(ROADMAP item 3): a deterministic enumerator over a declared candidate
+space — remat policy at block boundaries (none / every-block / every-k /
+save-dot variants), LM-head loss/grad chunk sizes, QKV & attention-output
+projection fusion, and optimizer-fusion variants — that traces every
+candidate through the real engine knobs (the "program" config block +
+``optimizer.legacy_fusion``), prices it statically, and commits only the
+Pareto frontier to ``analysis_results/search_pareto.json``. The next chip
+window measures exactly the statically-surviving set instead of burning
+chip minutes on dominated losers (the DeepSpeed-autotuner move, executed
+on CPU).
+
+Pricing is **jaxpr-only** by design: ``engine.traced_programs(batch,
+lower=False)`` skips the StableHLO lowering that dominates a full
+``--cost`` pass at real model sizes (the 350M step traces in ~7 s but
+lowers in ~40 s on the 1-core rig), so the whole judged-config space
+prices inside a chip window's coffee break. Objectives per candidate:
+
+* ``peak_transient_bytes`` — the liveness walk's schedule-controlled
+  activation peak (``analysis/memory.py``), what remat/chunking buy;
+* ``flops_proxy`` — a trip-count-weighted ``dot_general`` FLOP walk over
+  the jaxpr (scan bodies multiplied by their length, cond branches taken
+  at the max), what remat *costs*. Pinned against the backend's own
+  ``cost_analysis()`` in ``tests/unit/analysis/test_search.py``;
+* ``bytes_moved`` — total analytic wire bytes over the jaxpr-layer
+  collective inventory (``analysis/hlo_cost.py``). Always recorded, but
+  an *objective* only on multi-device spaces (both committed spaces pin
+  a 1-device topology, where it is zero for every candidate).
+
+Rule **R014** ratchets the committed frontier: on ``tools/graft_lint.py
+--cost`` every ``gate=True`` space is re-enumerated and re-priced, and
+the run fails when the candidate set drifts, a committed winner's price
+drifts beyond tolerance (default 5%), or a committed winner is now
+dominated — the drift that would silently invalidate the Pareto set a
+chip window is about to spend minutes measuring. Improvements (a new
+frontier entrant) report as INFO to bank explicitly with
+``tools/graft_search.py --update``, never silently.
+"""
+
+import dataclasses
+import hashlib
+import itertools
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from deepspeed_tpu.analysis import hlo_cost
+from deepspeed_tpu.analysis.core import ERROR, INFO, LAYER_COST, WARN, Finding, rule
+from deepspeed_tpu.analysis.memory import estimate_memory
+from deepspeed_tpu.analysis.program import ProgramAnalyzer, ProgramInfo, _iter_sub_jaxprs
+
+SEARCH_ARTIFACT_VERSION = 1
+DEFAULT_TOLERANCE = 0.05  # winner price drift allowed before R014 gates
+_MAX_FINDINGS_PER_SPACE = 8
+
+_ARTIFACT_TOP_KEYS = {"version", "tolerance", "jax_version", "spaces"}
+
+
+# ---------------------------------------------------------------------------
+# candidate grammar
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One point of the search space. ``remat`` grammar:
+    ``"none" | "every_<k>[:<policy>]"`` — ``every_1`` checkpoints every
+    block (plain ``jax.checkpoint``, full recompute), ``every_2`` every
+    second block, ``:dots_saveable`` etc. select a
+    ``runtime/activation_checkpointing`` save policy (the save-dot
+    variants). ``lm_head_chunk`` is tokens per fused LM-head loss chunk
+    (0 = the unfused ``[B, L, V]`` logits head). ``optimizer`` is
+    ``"fused"`` (the single tree-map chain) or ``"chained"``
+    (``optimizer.legacy_fusion``: optax's staged composition)."""
+
+    remat: str
+    lm_head_chunk: int
+    fused_qkv: bool = True
+    fused_attn_out: bool = True
+    optimizer: str = "fused"
+
+    def __post_init__(self):
+        mode, _, _ = self.remat.partition(":")
+        if mode != "none":
+            stride = mode[len("every_"):] if mode.startswith("every_") else ""
+            if not stride.isdigit() or int(stride) < 1:
+                raise ValueError(f"bad remat spec {self.remat!r}: "
+                                 f"'none' or 'every_<k>[:<policy>]' with k >= 1")
+        if self.optimizer not in ("fused", "chained"):
+            raise ValueError(f"bad optimizer variant {self.optimizer!r}")
+
+    @property
+    def cid(self) -> str:
+        return (f"remat={self.remat}|head={self.lm_head_chunk}"
+                f"|qkv={'fused' if self.fused_qkv else 'split'}"
+                f"|out={'fused' if self.fused_attn_out else 'reshape'}"
+                f"|opt={self.optimizer}")
+
+    def program_block(self) -> dict:
+        """The engine "program" config block realizing this candidate —
+        the same knobs a production JSON would set (runtime/config.py
+        ``ProgramConfig``), so the priced program IS the runnable one."""
+        mode, _, policy = self.remat.partition(":")
+        if mode == "none":
+            block = {"remat": False}
+        else:
+            block = {"remat": True, "remat_every": int(mode[len("every_"):]),
+                     "remat_policy": policy or "none"}
+        block["lm_head_chunk"] = int(self.lm_head_chunk)
+        block["fused_qkv"] = bool(self.fused_qkv)
+        block["fused_attn_out"] = bool(self.fused_attn_out)
+        return block
+
+
+_AXIS_ORDER = ("remat", "lm_head_chunk", "fused_qkv", "fused_attn_out", "optimizer")
+_AXIS_DEFAULTS = {"remat": ("none",), "lm_head_chunk": (0,),
+                  "fused_qkv": (True,), "fused_attn_out": (True,),
+                  "optimizer": ("fused",)}
+
+
+@dataclasses.dataclass
+class SearchSpace:
+    """A declared candidate space over one judged engine config. ``axes``
+    maps axis name -> value tuple (unlisted axes stay at their default);
+    ``probes`` appends explicit off-product candidates (e.g. one
+    optimizer-fusion A/B at the expected winner) without squaring the
+    product. ``gate=True`` spaces are re-priced and ratcheted by R014 on
+    every ``graft_lint --cost`` run — keep those small and CPU-fast."""
+
+    name: str
+    model_name: str
+    micro_bs: int
+    seq: int
+    dtype: str = "float32"
+    model_overrides: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    ds_base: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    axes: Dict[str, tuple] = dataclasses.field(default_factory=dict)
+    probes: Tuple[Candidate, ...] = ()
+    #: Pareto objectives, declared PER SPACE. ``bytes_moved`` is always
+    #: recorded as a metric but only belongs in the objective tuple on
+    #: multi-device spaces — on the 1-device topologies both committed
+    #: spaces pin, it is structurally zero for every candidate and would
+    #: be a dead dimension masquerading as a live one.
+    objectives: Tuple[str, ...] = ("peak_transient_bytes", "flops_proxy")
+    gate: bool = False
+
+    def signature(self) -> str:
+        raw = json.dumps({"model": self.model_name, "mb": self.micro_bs,
+                          "seq": self.seq, "dtype": self.dtype,
+                          "overrides": dict(sorted(self.model_overrides.items())),
+                          "ds": self.ds_base,
+                          "axes": {k: list(v) for k, v in sorted(self.axes.items())},
+                          "probes": [p.cid for p in self.probes],
+                          "objectives": list(self.objectives)},
+                         sort_keys=True, default=str)
+        return hashlib.sha1(raw.encode()).hexdigest()[:12]
+
+
+def enumerate_candidates(space: SearchSpace) -> List[Candidate]:
+    """The deterministic enumeration: full product over the declared axes
+    (fixed axis order, declared value order) followed by the probes,
+    deduped by candidate id preserving first occurrence."""
+    unknown = sorted(set(space.axes) - set(_AXIS_ORDER))
+    if unknown:
+        raise ValueError(f"space {space.name!r} declares unknown axes {unknown}; "
+                         f"valid: {list(_AXIS_ORDER)}")
+    values = [tuple(space.axes.get(a, _AXIS_DEFAULTS[a])) for a in _AXIS_ORDER]
+    out, seen = [], set()
+    for combo in itertools.product(*values):
+        cand = Candidate(**dict(zip(_AXIS_ORDER, combo)))
+        if cand.cid not in seen:
+            seen.add(cand.cid)
+            out.append(cand)
+    for cand in space.probes:
+        if cand.cid not in seen:
+            seen.add(cand.cid)
+            out.append(cand)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the declared spaces
+# ---------------------------------------------------------------------------
+def _ds_base(bf16: bool) -> dict:
+    ds = {"optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.01}},
+          "gradient_clipping": 1.0,
+          "zero_optimization": {"stage": 0},
+          "steps_per_print": 10**9}
+    if bf16:
+        ds["bf16"] = {"enabled": True}
+    return ds
+
+
+#: the registry. ``350m_judged`` mirrors the bench methodology's judged
+#: single-chip operating point (bench.py: mb8 / seq1024 / bf16 / padded
+#: vocab / one-hot embedding backward); attention stays on the XLA
+#: backend so pricing is backend-reproducible — flash block geometry has
+#: its own tuner (tools/attn_tune.py). ``gpt2_test_gate`` is the small
+#: CPU-fast space R014 re-prices on every ``graft_lint --cost`` run.
+SPACES: Dict[str, SearchSpace] = {
+    "350m_judged": SearchSpace(
+        name="350m_judged",
+        model_name="350m", micro_bs=8, seq=1024, dtype="bfloat16",
+        model_overrides={"vocab_size": 50304, "embed_onehot_grad": True},
+        ds_base=_ds_base(bf16=True),
+        axes={"remat": ("none", "every_1", "every_1:dots_saveable",
+                        "every_2:dots_saveable"),
+              "lm_head_chunk": (0, 512, 1024),
+              "fused_qkv": (True, False)},
+        probes=(Candidate(remat="every_1:dots_saveable", lm_head_chunk=1024,
+                          fused_attn_out=False),
+                Candidate(remat="every_1:dots_saveable", lm_head_chunk=1024,
+                          optimizer="chained")),
+        gate=False),
+    "gpt2_test_gate": SearchSpace(
+        name="gpt2_test_gate",
+        model_name="test", micro_bs=4, seq=64, dtype="float32",
+        # vocab 512: the test preset's 256 collides with 4*n_embd, which
+        # would confound the [*, V]-shaped LM-head trace evidence with MLP
+        # dots (and flatten the chunk-vs-full memory spread the gate's
+        # drift check needs)
+        model_overrides={"vocab_size": 512},
+        ds_base=_ds_base(bf16=False),
+        axes={"remat": ("none", "every_1:dots_saveable", "every_2"),
+              "lm_head_chunk": (0, 32)},
+        probes=(Candidate(remat="every_1", lm_head_chunk=32, fused_qkv=False),
+                Candidate(remat="every_1", lm_head_chunk=32, optimizer="chained")),
+        gate=True),
+}
+
+
+# ---------------------------------------------------------------------------
+# pricing
+# ---------------------------------------------------------------------------
+def build_candidate_engine(space: SearchSpace, cand: Candidate):
+    """Engine + example batch for one candidate, every knob routed through
+    the engine surface (the "program" block + ``optimizer.legacy_fusion``)
+    — the priced program is exactly what ``deepspeed_tpu.initialize`` with
+    this JSON would dispatch. Topology is pinned to ONE device so prices
+    never depend on the host's virtual-device count."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+    from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
+
+    set_topology(None)
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[space.dtype]
+    cfg = get_gpt2_config(space.model_name, n_positions=space.seq, dtype=dtype,
+                          **space.model_overrides)
+    ds = json.loads(json.dumps(space.ds_base))  # deep copy, JSON-shaped by contract
+    ds["train_batch_size"] = space.micro_bs
+    ds["program"] = cand.program_block()
+    if cand.optimizer == "chained":
+        ds.setdefault("optimizer", {"type": "AdamW", "params": {"lr": 1e-4}})
+        ds["optimizer"]["legacy_fusion"] = True
+    topo = MeshTopology(data=1, devices=jax.devices()[:1])
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(cfg), topology=topo, config=ds)
+    batch = {"input_ids": np.zeros((space.micro_bs, space.seq), np.int32)}
+    return engine, batch, engine.module.config
+
+
+def _dot_flops(eqn) -> int:
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs = tuple(eqn.invars[0].aval.shape)
+    rhs = tuple(eqn.invars[1].aval.shape)
+    batch = k = m = n = 1
+    for i in lb:
+        batch *= lhs[i]
+    for i in lc:
+        k *= lhs[i]
+    for i, d in enumerate(lhs):
+        if i not in lc and i not in lb:
+            m *= d
+    for i, d in enumerate(rhs):
+        if i not in rc and i not in rb:
+            n *= d
+    return 2 * batch * m * n * k
+
+
+def flops_proxy(closed_jaxpr) -> int:
+    """Trip-count-weighted ``dot_general`` FLOPs over the whole jaxpr:
+    scan bodies multiply by their ``length``, ``cond`` branches take the
+    max (alternatives), ``while`` bodies count once (trip count is not
+    static — a documented underestimate; no step program in this repo
+    carries a while-loop matmul). A grad jaxpr naturally contains the
+    forward, backward AND remat-recompute dots, so the proxy prices
+    exactly what remat trades: transient bytes for recompute FLOPs."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+
+    def walk(j, mult: int) -> int:
+        total = 0
+        for eqn in j.eqns:
+            name = eqn.primitive.name
+            if name == "dot_general":
+                total += mult * _dot_flops(eqn)
+                continue
+            sub_mult = mult
+            if name == "scan":
+                sub_mult = mult * max(int(eqn.params.get("length") or 1), 1)
+            subs = [sub for value in eqn.params.values()
+                    for sub, _ in _iter_sub_jaxprs(value)]
+            if not subs:
+                continue
+            if name == "cond":
+                total += sub_mult * max(walk(s, 1) for s in subs)
+            else:
+                for s in subs:
+                    total += walk(s, sub_mult)
+        return total
+
+    return walk(jaxpr, 1)
+
+
+def _trace_evidence(analyzer: ProgramAnalyzer, model_cfg) -> dict:
+    """Trace-level proof that each knob actually landed in the program:
+    remat2 coverage (+ whether a save policy is attached), the LM-head
+    chunk visible as ``[chunk, V]`` logits dots (vs the full-rank
+    ``[B, L, V]`` einsum), and the projection-fusion dot shapes."""
+    vocab = int(model_cfg.vocab_size)
+    n_head, head_dim, n_embd = (int(model_cfg.n_head), int(model_cfg.head_dim),
+                                int(model_cfg.n_embd))
+    remat_eqns, policy_saved = set(), False
+    head_chunks, full_logits = set(), False
+    qkv_fused = qkv_split = out_fused = out_reshaped = 0
+    for rec in analyzer.records():
+        if rec.primitive == "remat2":
+            remat_eqns.add(id(rec.eqn))
+            if rec.eqn.params.get("policy") is not None:
+                policy_saved = True
+        if rec.primitive != "dot_general":
+            continue
+        out_aval = getattr(rec.eqn.outvars[0], "aval", None)
+        shape = tuple(getattr(out_aval, "shape", ()))
+        if shape and shape[-1] == vocab:
+            # a dot emitting logits: [chunk, V] = the fused-head scan body,
+            # rank>=3 [..., V] = the unfused whole-sequence head
+            if len(shape) == 2:
+                head_chunks.add(int(shape[0]))
+            else:
+                full_logits = True
+        rhs = getattr(rec.eqn.invars[1], "aval", None)
+        rhs_shape = tuple(getattr(rhs, "shape", ()))
+        if rhs_shape == (n_embd, 3, n_head, head_dim):
+            qkv_fused += 1
+        elif rhs_shape == (n_embd, n_head, head_dim):
+            qkv_split += 1
+        if rhs_shape == (n_head, head_dim, n_embd):
+            out_fused += 1
+        elif rhs_shape == (n_head * head_dim, n_embd):
+            out_reshaped += 1
+    return {"remat2_sites": len(remat_eqns),
+            "remat_policy_saved": policy_saved,
+            "lm_head_chunks": sorted(head_chunks),
+            "full_logits": full_logits,
+            "qkv_fused_dots": qkv_fused,
+            "qkv_split_dots": qkv_split,
+            "attn_out_fused_dots": out_fused,
+            "attn_out_reshaped_dots": out_reshaped}
+
+
+def price_candidate(space: SearchSpace, cand: Candidate) -> dict:
+    """Build + trace + statically price one candidate. Deterministic by
+    construction: same code + same knobs -> same jaxpr -> same numbers
+    (the property the two-run determinism test pins)."""
+    from deepspeed_tpu.parallel.topology import set_topology
+
+    engine, batch, model_cfg = build_candidate_engine(space, cand)
+    try:
+        programs = engine.traced_programs(batch, lower=False)
+    finally:
+        set_topology(None)
+    step = programs["train_step"]
+    info = ProgramInfo(name=cand.cid, jaxpr=step["jaxpr"], kind="train_step",
+                       metadata=step["metadata"])
+    analyzer = ProgramAnalyzer(info)
+    mem = estimate_memory(info)
+    ops = hlo_cost.jaxpr_collectives(analyzer, step["metadata"].get("mesh_axes"))
+    inventory = hlo_cost.inventory(ops)
+    bytes_moved = sum(inv["bytes_moved"] for inv in inventory.values())
+    metrics = {"peak_bytes": mem.peak_bytes,
+               "peak_transient_bytes": mem.peak_transient_bytes,
+               "bytes_moved": int(bytes_moved),
+               "flops_proxy": flops_proxy(step["jaxpr"]),
+               "eqns": mem.eqns}
+    return {"knobs": dataclasses.asdict(cand),
+            "metrics": metrics,
+            "evidence": _trace_evidence(analyzer, model_cfg)}
+
+
+# ---------------------------------------------------------------------------
+# Pareto
+# ---------------------------------------------------------------------------
+def _dominates(a: dict, b: dict, objectives) -> bool:
+    return (all(a[o] <= b[o] for o in objectives)
+            and any(a[o] < b[o] for o in objectives))
+
+
+def pareto(candidates: Dict[str, dict], objectives) -> Tuple[List[str], Dict[str, List[str]]]:
+    """(frontier ids in enumeration order, dominated-candidate provenance:
+    id -> the frontier ids that dominate it)."""
+    ids = list(candidates)
+    frontier = [cid for cid in ids
+                if not any(_dominates(candidates[o]["metrics"],
+                                      candidates[cid]["metrics"], objectives)
+                           for o in ids if o != cid)]
+    dominated_by = {}
+    for cid in ids:
+        if cid in frontier:
+            continue
+        dominated_by[cid] = [f for f in frontier
+                             if _dominates(candidates[f]["metrics"],
+                                           candidates[cid]["metrics"], objectives)]
+    return frontier, dominated_by
+
+
+def run_space(space_or_name, log=None) -> dict:
+    """Enumerate + price + frontier one space. The returned dict is the
+    committed artifact's per-space entry — pure data, no timestamps, so
+    two runs of unchanged code compare equal (the determinism contract)."""
+    space = SPACES[space_or_name] if isinstance(space_or_name, str) else space_or_name
+    candidates = {}
+    for i, cand in enumerate(enumerate_candidates(space)):
+        if log:
+            log(f"  [{i + 1}] pricing {cand.cid}")
+        candidates[cand.cid] = price_candidate(space, cand)
+    frontier, dominated_by = pareto(candidates, space.objectives)
+    for cid, doms in dominated_by.items():
+        candidates[cid]["dominated_by"] = doms
+    return {"space_sig": space.signature(),
+            "model": {"name": space.model_name, "micro_bs": space.micro_bs,
+                      "seq": space.seq, "dtype": space.dtype},
+            "axes": {k: list(v) for k, v in space.axes.items()},
+            "objectives": list(space.objectives),
+            "gate": space.gate,
+            "candidates": candidates,
+            "frontier": frontier}
+
+
+# ---------------------------------------------------------------------------
+# artifact IO (merge semantics, like the cost baseline)
+# ---------------------------------------------------------------------------
+def load_search_artifact(path: str) -> dict:
+    if not os.path.exists(path):
+        return {"version": SEARCH_ARTIFACT_VERSION, "tolerance": DEFAULT_TOLERANCE,
+                "spaces": {}}
+    with open(path) as fh:
+        artifact = json.load(fh)
+    if artifact.get("version") != SEARCH_ARTIFACT_VERSION:
+        raise ValueError(f"search artifact {path} has version "
+                         f"{artifact.get('version')}, expected "
+                         f"{SEARCH_ARTIFACT_VERSION} — regenerate with "
+                         f"tools/graft_search.py --update")
+    unknown = set(artifact) - _ARTIFACT_TOP_KEYS
+    if unknown:
+        raise ValueError(f"search artifact {path} has unknown top-level keys "
+                         f"{sorted(unknown)}")
+    artifact.setdefault("tolerance", DEFAULT_TOLERANCE)
+    artifact.setdefault("spaces", {})
+    return artifact
+
+
+def search_artifact_from(results: Dict[str, dict], prior: Optional[dict] = None) -> dict:
+    """Bank current space results. MERGE semantics: a single-space
+    ``--update`` refreshes only its own entry — dropping another space's
+    entry would silently un-gate it."""
+    import jax
+    spaces = dict((prior or {}).get("spaces", {}))
+    spaces.update(results)
+    return {"version": SEARCH_ARTIFACT_VERSION,
+            "tolerance": (prior or {}).get("tolerance", DEFAULT_TOLERANCE),
+            "jax_version": jax.__version__,
+            "spaces": dict(sorted(spaces.items()))}
+
+
+# ---------------------------------------------------------------------------
+# R014 — the frontier ratchet
+# ---------------------------------------------------------------------------
+@rule("R014", "the committed search frontier must not regress", ERROR, LAYER_COST)
+def r014_search_frontier(artifact: dict, current_by_space: Dict[str, dict],
+                         tolerance: Optional[float] = None) -> List[Finding]:
+    """Re-priced gate spaces vs the committed
+    ``analysis_results/search_pareto.json``: ERROR when the enumerated
+    candidate set or declared space drifts without re-banking, when a
+    committed frontier winner's static price drifts beyond tolerance on
+    any objective, or when a committed winner is now dominated (the
+    frontier regressed — or improved past its commit; either way the
+    Pareto set a chip window would consume is stale). New frontier
+    entrants and un-banked spaces report as INFO so improvements are
+    banked explicitly with ``tools/graft_search.py --update``."""
+    tol = float(tolerance if tolerance is not None
+                else artifact.get("tolerance", DEFAULT_TOLERANCE))
+    findings: List[Finding] = []
+    for name, cur in sorted(current_by_space.items()):
+        scenario = f"search:{name}"
+        space_findings: List[Finding] = []
+        base = artifact.get("spaces", {}).get(name)
+        if base is None:
+            findings.append(Finding(
+                rule="R014", severity=INFO, scenario=scenario,
+                message="no committed search entry for this space — bank with "
+                        "tools/graft_search.py --update"))
+            continue
+        if base.get("space_sig") != cur.get("space_sig"):
+            findings.append(Finding(
+                rule="R014", severity=ERROR, scenario=scenario,
+                message=f"declared candidate space drifted (sig "
+                        f"{base.get('space_sig')} -> {cur.get('space_sig')}) — "
+                        f"re-bank with tools/graft_search.py --update",
+                location="space_sig"))
+            continue
+        base_c, cur_c = base["candidates"], cur["candidates"]
+        if set(base_c) != set(cur_c):
+            added = sorted(set(cur_c) - set(base_c))[:4]
+            gone = sorted(set(base_c) - set(cur_c))[:4]
+            findings.append(Finding(
+                rule="R014", severity=ERROR, scenario=scenario,
+                message=f"enumerated candidates drifted from the committed set "
+                        f"(+{added} -{gone}) — re-bank with "
+                        f"tools/graft_search.py --update",
+                location="candidates"))
+            continue
+        objectives = base.get("objectives", list(cur.get("objectives", ())))
+        for cid in base["frontier"]:
+            for obj in objectives:
+                b = base_c[cid]["metrics"].get(obj)
+                c = cur_c[cid]["metrics"].get(obj)
+                if b is None or c is None:
+                    continue
+                drift = abs(c - b) / b if b else (1.0 if c else 0.0)
+                if drift > tol:
+                    space_findings.append(Finding(
+                        rule="R014", severity=ERROR, scenario=scenario,
+                        message=f"winner price drift: {cid} {obj} {b} -> {c} "
+                                f"({drift:+.1%} vs {tol:.0%} tolerance)",
+                        location=f"{cid}:{obj}"))
+        cur_frontier = set(cur["frontier"])
+        for cid in base["frontier"]:
+            if cid not in cur_frontier:
+                doms = cur_c[cid].get("dominated_by", [])
+                space_findings.append(Finding(
+                    rule="R014", severity=ERROR, scenario=scenario,
+                    message=f"committed winner {cid} regresses the frontier — "
+                            f"now dominated by {doms[:3]}; re-bank or fix",
+                    location=cid))
+        for cid in sorted(cur_frontier - set(base["frontier"])):
+            space_findings.append(Finding(
+                rule="R014", severity=INFO, scenario=scenario,
+                message=f"frontier improvement: {cid} now survives — bank with "
+                        f"tools/graft_search.py --update",
+                location=cid))
+        # non-winner drift: diagnostic, never gating (the frontier is the
+        # contract; dominated candidates may drift freely inside it)
+        for cid in sorted(set(base_c) - set(base["frontier"])):
+            for obj in objectives:
+                b, c = base_c[cid]["metrics"].get(obj), cur_c[cid]["metrics"].get(obj)
+                if b and c is not None and abs(c - b) / b > tol:
+                    space_findings.append(Finding(
+                        rule="R014", severity=WARN, scenario=scenario,
+                        message=f"dominated-candidate price drift: {cid} {obj} "
+                                f"{b} -> {c}",
+                        location=f"{cid}:{obj}"))
+                    break
+        findings.extend(space_findings[:_MAX_FINDINGS_PER_SPACE])
+    return findings
+
+
+def gate_space_names() -> List[str]:
+    return [name for name, space in SPACES.items() if space.gate]
+
+
+def verify_spaces(artifact_path: str, names: Optional[List[str]] = None,
+                  log=None) -> List[Finding]:
+    """Re-price ``names`` (default: every gate space) and judge them with
+    R014 against the committed artifact — the shared entry point for the
+    lint CLI and tools/graft_search.py's verify mode."""
+    artifact = load_search_artifact(artifact_path)
+    names = list(names if names is not None else gate_space_names())
+    current = {name: run_space(name, log=log) for name in names}
+    return r014_search_frontier(artifact, current)

@@ -50,6 +50,15 @@ class GPT2Config:
     # ("block_q=256,block_k=512,policy=recompute", see models/common.py
     # attention_geometry_kwargs); None = resolve via env/config/autotune
     attention_blocks: Optional[str] = None
+    # QKV projection as ONE fused [E,3,H,D] GEMM (default, the historical
+    # program) vs three sliced GEMMs over the SAME parameter — a program-
+    # shape dimension graft-search enumerates (analysis/search.py; engine
+    # "program" config block). Checkpoint layout is identical either way.
+    attn_fused_qkv: bool = True
+    # attention-output projection contracting (heads, kv) directly off the
+    # [B,L,H,D] attention output (default) vs an explicit [B,L,H*D]
+    # reshape then a 2D GEMM — same parameter, different program shape
+    attn_fused_out: bool = True
     # backward of the token-embedding gather as a one-hot matmul instead of
     # a scatter-add. Default ON: scatter serializes on TPU (measured +10%
     # with the matmul form, PERF.md r3 session 3) AND the scatter-add's
@@ -107,6 +116,75 @@ def _dense_init(scale=0.02):
     return dense_init(scale)
 
 
+class QKVProj(nn.Module):
+    """QKV projection over ONE fused ``[E, 3, H, D]`` parameter (the exact
+    layout/init ``nn.DenseGeneral(features=(3, H, D))`` declared here
+    historically, so checkpoints are unchanged) with two program forms:
+    ``attn_fused_qkv=True`` emits the single fused GEMM; ``False`` emits
+    three sliced GEMMs — identical math, different program shape for the
+    scheduler/partitioner, the fusion dimension graft-search prices."""
+
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        unbox = lambda p: p.value if isinstance(p, nn.meta.AxisMetadata) else p
+        kernel = unbox(self.param(
+            "kernel", nn.with_logical_partitioning(_dense_init(), ("embed", None, "heads", "kv")),
+            (cfg.n_embd, 3, cfg.n_head, cfg.head_dim), cfg.param_dtype))
+        bias = unbox(self.param(
+            "bias", nn.with_logical_partitioning(nn.initializers.zeros, (None, "heads", "kv")),
+            (3, cfg.n_head, cfg.head_dim), cfg.param_dtype))
+        x = x.astype(cfg.dtype)
+        kernel = kernel.astype(cfg.dtype)
+        bias = bias.astype(cfg.dtype)
+        contract = ((x.ndim - 1,), (0,))
+        if cfg.attn_fused_qkv:
+            qkv = jax.lax.dot_general(x, kernel, (contract, ((), ())))
+            qkv = qkv + jnp.reshape(bias, (1,) * (qkv.ndim - bias.ndim) + bias.shape)
+            return qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        outs = []
+        for i in range(3):
+            o = jax.lax.dot_general(x, kernel[:, i], (contract, ((), ())))
+            outs.append(o + jnp.reshape(bias[i], (1,) * (o.ndim - 2) + bias[i].shape))
+        return tuple(outs)
+
+
+class AttnOutProj(nn.Module):
+    """Attention-output projection over the ``[H, D, E]`` parameter
+    ``nn.DenseGeneral(features=E, axis=(-2, -1))`` declared here
+    historically. ``attn_fused_out=True`` contracts (heads, kv) directly
+    off the ``[B, L, H, D]`` attention output; ``False`` reshapes to
+    ``[B, L, H*D]`` first and runs a 2D GEMM — same parameter, the second
+    fusion dimension graft-search prices."""
+
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        unbox = lambda p: p.value if isinstance(p, nn.meta.AxisMetadata) else p
+        kernel = unbox(self.param(
+            "kernel", nn.with_logical_partitioning(_dense_init(), ("heads", "kv", "embed")),
+            (cfg.n_head, cfg.head_dim, cfg.n_embd), cfg.param_dtype))
+        bias = unbox(self.param(
+            "bias", nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
+            (cfg.n_embd,), cfg.param_dtype))
+        x = x.astype(cfg.dtype)
+        kernel = kernel.astype(cfg.dtype)
+        bias = bias.astype(cfg.dtype)
+        if cfg.attn_fused_out:
+            out = jax.lax.dot_general(
+                x, kernel, (((x.ndim - 2, x.ndim - 1), (0, 1)), ((), ())))
+        else:
+            flat = x.reshape(x.shape[:-2] + (cfg.n_head * cfg.head_dim,))
+            out = jax.lax.dot_general(
+                flat, kernel.reshape(cfg.n_head * cfg.head_dim, cfg.n_embd),
+                (((flat.ndim - 1,), (0,)), ((), ())))
+        return out + bias
+
+
 class SelfAttention(nn.Module):
     config: GPT2Config
     decode: bool = False
@@ -114,15 +192,7 @@ class SelfAttention(nn.Module):
     @nn.compact
     def __call__(self, x, *, deterministic: bool = True):
         cfg = self.config
-        qkv_proj = nn.DenseGeneral(features=(3, cfg.n_head, cfg.head_dim),
-                                   axis=-1,
-                                   dtype=cfg.dtype,
-                                   param_dtype=cfg.param_dtype,
-                                   kernel_init=nn.with_logical_partitioning(_dense_init(), ("embed", None, "heads", "kv")),
-                                   bias_init=nn.with_logical_partitioning(nn.initializers.zeros, (None, "heads", "kv")),
-                                   name="c_attn")
-        qkv = qkv_proj(x)
-        q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        q, k, v = QKVProj(cfg, name="c_attn")(x)
         dropout_rng = None
         if not deterministic and cfg.dropout > 0.0:
             dropout_rng = self.make_rng("dropout")
@@ -156,13 +226,7 @@ class SelfAttention(nn.Module):
                                          dropout_rate=0.0 if deterministic else cfg.dropout,
                                          dropout_rng=dropout_rng,
                                          **attention_geometry_kwargs(cfg))
-        out = nn.DenseGeneral(features=cfg.n_embd,
-                              axis=(-2, -1),
-                              dtype=cfg.dtype,
-                              param_dtype=cfg.param_dtype,
-                              kernel_init=nn.with_logical_partitioning(_dense_init(), ("heads", "kv", "embed")),
-                              bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
-                              name="c_proj")(attn_out)
+        out = AttnOutProj(cfg, name="c_proj")(attn_out)
         if not deterministic and cfg.dropout > 0.0:
             out = nn.Dropout(rate=cfg.dropout)(out, deterministic=False)
         return out

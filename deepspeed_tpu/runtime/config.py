@@ -106,6 +106,75 @@ class MoEConfig(DeepSpeedConfigModel):
     kernel: Optional[str] = None     # "auto" | "xla" | "pallas"
 
 
+#: env overrides for the program block (the ``DS_MOE_ROUTE`` idiom: an A/B
+#: lever that drifts the traced program without editing configs — and whose
+#: drift is CAUGHT, here by the committed search frontier, rule R014)
+ENV_REMAT_POLICY = "DS_REMAT_POLICY"
+ENV_LMHEAD_CHUNK = "DS_LMHEAD_CHUNK"
+
+#: program-block field -> model-config field it lands on (``lm_head_chunk``
+#: maps onto the zoo's ``fused_head_loss_chunk``; the rest share names)
+PROGRAM_MODEL_FIELDS = {
+    "remat": "remat",
+    "remat_every": "remat_every",
+    "remat_policy": "remat_policy",
+    "lm_head_chunk": "fused_head_loss_chunk",
+    "fused_qkv": "attn_fused_qkv",
+    "fused_attn_out": "attn_fused_out",
+}
+
+
+class ProgramConfig(DeepSpeedConfigModel):
+    """Traced-program shape knobs ("program" config block, TPU-native; the
+    reference scatters these across activation-checkpointing flags and
+    hand-fused CUDA ops).
+
+    Every field is optional: unset knobs leave the module's model config
+    untouched. Set knobs are applied by the engine onto the model config
+    (``dataclasses.replace`` + ``module.clone``), so one engine JSON picks
+    a program variant for any zoo family declaring the field — the
+    candidate dimensions graft-search (``analysis/search.py``) enumerates
+    and prices statically. ``remat_policy`` takes a
+    ``runtime/activation_checkpointing`` policy name or ``"none"``;
+    ``lm_head_chunk`` is tokens per chunk of the fused LM-head loss
+    (0 = the unfused ``[B, L, V]`` logits head)."""
+    remat: Optional[bool] = None
+    remat_every: Optional[int] = Field(None, ge=1)
+    remat_policy: Optional[str] = None
+    lm_head_chunk: Optional[int] = Field(None, ge=0)
+    fused_qkv: Optional[bool] = None
+    fused_attn_out: Optional[bool] = None
+
+    def model_updates(self) -> dict:
+        """Set fields as {model_config_field: value} (``remat_policy``
+        "none" normalizes to None — the unset-policy full-recompute)."""
+        out = {}
+        for field, model_field in PROGRAM_MODEL_FIELDS.items():
+            value = getattr(self, field)
+            if value is None:
+                continue
+            if field == "remat_policy" and value == "none":
+                value = None
+            out[model_field] = value
+        return out
+
+
+def program_env_updates() -> dict:
+    """The env layer of the program knobs ({model_field: value}): ambient
+    A/B levers that drift every engine built in the process. The drift is
+    caught — candidate prices move, and the committed search frontier
+    (R014) fails — exactly like ``DS_MOE_ROUTE``."""
+    out = {}
+    policy = os.environ.get(ENV_REMAT_POLICY)
+    if policy is not None:
+        out["remat_policy"] = None if policy in ("", "none") else policy
+        out["remat"] = True
+    chunk = os.environ.get(ENV_LMHEAD_CHUNK)
+    if chunk is not None:
+        out["fused_head_loss_chunk"] = int(chunk)
+    return out
+
+
 class MeshConfig(DeepSpeedConfigModel):
     """TPU-native parallel-topology block (replaces mpu/world-size plumbing).
 
@@ -271,6 +340,7 @@ class DeepSpeedConfig:
         self.comms_config = DeepSpeedCommsConfig(param_dict)
         self.attention_config = AttentionConfig(**param_dict.get(C.ATTENTION, {}))
         self.moe_config = MoEConfig(**param_dict.get(C.MOE, {}))
+        self.program_config = ProgramConfig(**param_dict.get(C.PROGRAM, {}))
         self.checkpoint_config = CheckpointConfig(**param_dict.get(C.CHECKPOINT, {}))
         self.nebula_config = NebulaConfig(**param_dict.get(C.NEBULA, {}))
         self.resilience_config = ResilienceConfig(**param_dict.get(C.RESILIENCE, {}))

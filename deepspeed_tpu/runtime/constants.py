@@ -122,6 +122,11 @@ ATTENTION = "attention"
 # MoE dispatch/combine route + permutation kernel (TPU-native; see
 # moe/routing.py for the resolution layering)
 MOE = "moe"
+# traced-program shape knobs — remat policy, LM-head chunking, projection
+# fusion — applied onto the module's model config by the engine; the
+# dimensions graft-search enumerates (TPU-native; runtime/config.py
+# ProgramConfig, analysis/search.py)
+PROGRAM = "program"
 COMMS_LOGGER = "comms_logger"
 MONITOR_TENSORBOARD = "tensorboard"
 MONITOR_WANDB = "wandb"

@@ -111,6 +111,48 @@ def _global_norm(tree):
     return global_norm_l2(tree)
 
 
+def _apply_program_knobs(module, program_config):
+    """Rebuild ``module`` around a model config carrying the "program"
+    block's knobs (remat policy / LM-head chunk / projection fusion) plus
+    the ``DS_REMAT_POLICY``/``DS_LMHEAD_CHUNK`` env layer — the engine
+    plumbing that makes program shape an *engine* dimension graft-search
+    can enumerate (analysis/search.py). A config-block knob the model
+    family doesn't declare raises (a silently dropped knob would price one
+    program and run another); the ambient env layer only warns, since it
+    may legitimately reach engines whose family lacks the field."""
+    import dataclasses
+
+    from deepspeed_tpu.runtime.config import program_env_updates
+
+    cfg_updates = program_config.model_updates()
+    env_updates = program_env_updates()
+    if not cfg_updates and not env_updates:
+        return module
+    mcfg = getattr(module, "config", None)
+    if mcfg is None or not dataclasses.is_dataclass(mcfg):
+        if cfg_updates:
+            raise ValueError(
+                f"'program' config block set but {type(module).__name__} carries no "
+                f"dataclass model config to apply it to")
+        logger.warning("program env override (%s) ignored: %s has no model config",
+                       sorted(env_updates), type(module).__name__)
+        return module
+    missing = sorted(f for f in cfg_updates if not hasattr(mcfg, f))
+    if missing:
+        raise ValueError(
+            f"'program' config block sets {missing} but {type(mcfg).__name__} does not "
+            f"declare those fields — the knob would silently not apply")
+    for f in sorted(set(env_updates) - set(mcfg.__dataclass_fields__)):
+        logger.warning("program env override %s ignored: %s does not declare it",
+                       f, type(mcfg).__name__)
+        env_updates.pop(f)
+    updates = {**cfg_updates, **env_updates}  # env wins: the A/B lever
+    changed = {f: v for f, v in updates.items() if getattr(mcfg, f) != v}
+    if not changed:
+        return module
+    return module.clone(config=dataclasses.replace(mcfg, **changed))
+
+
 class DeepSpeedEngine:
 
     def __init__(self,
@@ -177,6 +219,15 @@ class DeepSpeedEngine:
         from deepspeed_tpu.moe import routing as _moe_routing
         _moe_routing.set_default_route(config.moe_config.route,
                                        config.moe_config.kernel)
+
+        # -- traced-program shape knobs ("program" config block +
+        # DS_REMAT_POLICY/DS_LMHEAD_CHUNK env): rebuild the module around a
+        # replaced model config so remat policy, LM-head chunking and
+        # projection fusion are ENGINE dimensions — what graft-search
+        # enumerates and prices statically (analysis/search.py). Per-engine
+        # (module.clone), never process-wide: two engines in one process can
+        # trace two different program variants.
+        self.module = _apply_program_knobs(self.module, config.program_config)
 
         # -- precision (reference engine.py:1056-1069 half()/bfloat16())
         if config.bfloat16_enabled:
@@ -370,6 +421,27 @@ class DeepSpeedEngine:
             # torch_adam/fused flags are meaningless on TPU; accept & drop
             params.pop("torch_adam", None)
             params.pop("fused", None)
+            if self.config.optimizer_legacy_fusion:
+                # the UNFUSED Adam variant (``optimizer.legacy_fusion``):
+                # optax's chained composition — separate scale_by_adam /
+                # decay / lr stages with their own intermediate update
+                # trees, more eqns and transients than the single
+                # tree-map chain XLA fuses in fused_adam. Same math; a
+                # real optimizer-fusion dimension for graft-search, and
+                # the escape hatch when a client transform must compose
+                # with the moment updates.
+                b1, b2 = params.pop("betas", (0.9, 0.999))
+                eps = params.pop("eps", 1e-8)
+                wd = params.pop("weight_decay", 0.0)
+                params.pop("bias_correction", None)  # optax always corrects
+                if params:
+                    raise ValueError(f"legacy_fusion adam does not accept {sorted(params)}")
+                if adam_w_mode:
+                    return optax.adamw(learning_rate=lr, b1=b1, b2=b2, eps=eps,
+                                       weight_decay=wd)
+                pre = [optax.add_decayed_weights(wd)] if wd else []
+                return optax.chain(*pre, optax.scale_by_adam(b1=b1, b2=b2, eps=eps),
+                                   optax.scale_by_learning_rate(lr))
             return fused_adam(lr=lr, adam_w_mode=adam_w_mode, **params)
         if name in (C.ONEBIT_ADAM_OPTIMIZER, C.ZERO_ONE_ADAM_OPTIMIZER, C.ONEBIT_LAMB_OPTIMIZER):
             from deepspeed_tpu.runtime.fp16.onebit import get_onebit_optimizer
@@ -622,7 +694,7 @@ class DeepSpeedEngine:
         fn, args = self._step_program_args(example_batch)
         return fn.lower(*args)
 
-    def traced_programs(self, example_batch):
+    def traced_programs(self, example_batch, lower: bool = True):
         """Expose the engine's jitted step for static analysis
         (``deepspeed_tpu/analysis``, ``tools/graft_lint.py``): trace-only —
         no compilation, no device buffers. Returns ``{name: {"jaxpr":
@@ -630,13 +702,20 @@ class DeepSpeedEngine:
         metadata pre-declares what the rules should expect of THIS engine
         (donation on the non-offload step, the MoE [S,E,C] signature when
         the model routes through experts, mesh multiplicity for the
-        sharding-coverage rule)."""
+        sharding-coverage rule). ``lower=False`` skips the StableHLO
+        lowering entirely (``hlo_text``/``lower`` come back None) — at
+        real model sizes lowering dominates the trace by an order of
+        magnitude, and graft-search prices dozens of candidates from the
+        jaxpr alone (analysis/search.py)."""
         fn, args = self._step_program_args(example_batch)
         traced = fn.trace(*args)
-        # lower from the existing trace — fn.lower(*args) would re-trace
-        # the whole step (seconds per call at real model sizes)
-        lowered = traced.lower()
-        hlo_text = lowered.as_text()
+        if lower:
+            # lower from the existing trace — fn.lower(*args) would re-trace
+            # the whole step (seconds per call at real model sizes)
+            lowered = traced.lower()
+            hlo_text = lowered.as_text()
+        else:
+            lowered, hlo_text = None, None
         metadata = {
             # the offload paths intentionally do NOT donate params (host
             # masters / cross-memory-kind aliasing is illegal)
@@ -649,6 +728,18 @@ class DeepSpeedEngine:
         metadata.update(self.config.zero_config.cost_metadata(
             fsdp_size=int(self.mesh.shape.get("fsdp", 1))))
         cfg_model = getattr(self.module, "config", None)
+        # the program knobs THIS trace actually carried (post config-block
+        # + env resolution) — graft-search's candidate evidence, and the
+        # audit trail that a banked rung ran the variant it claims
+        from deepspeed_tpu.runtime.config import PROGRAM_MODEL_FIELDS
+        knobs = {field: getattr(cfg_model, mf)
+                 for field, mf in PROGRAM_MODEL_FIELDS.items()
+                 if cfg_model is not None and hasattr(cfg_model, mf)}
+        if knobs:
+            knobs["optimizer_fusion"] = (
+                "client" if self.client_optimizer is not None else
+                "chained" if self.config.optimizer_legacy_fusion else "fused")
+            metadata["program_knobs"] = knobs
         moe_experts = getattr(cfg_model, "moe_num_experts", 0) if cfg_model is not None else 0
         if moe_experts:
             from deepspeed_tpu.moe.routing import resolve_intended_route
@@ -673,7 +764,7 @@ class DeepSpeedEngine:
                                     "by permutation, never an [S,E,C] einsum"})
         return {"train_step": {"jaxpr": traced.jaxpr, "hlo_text": hlo_text,
                                "metadata": metadata,
-                               "lower": lambda: lowered}}
+                               "lower": (lambda: lowered) if lowered is not None else None}}
 
     # ------------------------------------------------------------------
     # ZeRO-Offload / ZeRO-Infinity: optimizer states off-device

@@ -541,7 +541,7 @@ class PipelineEngine(DeepSpeedEngine):
         # everything through train_batch, pipe/engine.py:286)
 
     # ------------------------------------------------------------------
-    def traced_programs(self, example_batch):
+    def traced_programs(self, example_batch, **kwargs):
         """Base metadata plus the pipeline schedule's static-cost
         contract (graft-audit, analysis/cost.py):
 
@@ -561,7 +561,7 @@ class PipelineEngine(DeepSpeedEngine):
           hop). More would mean a second boundary buffer per tick — the
           drift this signature exists to catch.
         """
-        programs = super().traced_programs(example_batch)
+        programs = super().traced_programs(example_batch, **kwargs)
         metadata = programs["train_step"]["metadata"]
         pipe_cfg = self.config.raw_dict.get("pipeline", {})
         budget_mb = os.environ.get("DS_PIPE_ACT_BUDGET_MB",

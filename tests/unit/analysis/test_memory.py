@@ -163,3 +163,31 @@ class TestBackendAgreement:
                      + ma.temp_size_in_bytes)
         ratio = est.peak_bytes / max(xla_total, 1)
         assert self.BAND[0] <= ratio <= self.BAND[1], ratio
+
+    @pytest.mark.parametrize("remat", ["none", "every_1",
+                                       "every_1:dots_saveable", "every_2"])
+    def test_searched_candidate_band_per_remat_family(self, remat):
+        """One SEARCHED candidate per remat-policy family, priced through
+        the real engine path graft-search uses, cross-checked against
+        XLA's own ``memory_analysis()`` of the same step — the search's
+        objective function stays pinned to the backend's numbers across
+        its most program-reshaping axis (ISSUE 12 satellite)."""
+        from deepspeed_tpu.analysis.search import SPACES, Candidate, build_candidate_engine
+        from deepspeed_tpu.parallel.topology import set_topology
+
+        cand = Candidate(remat=remat, lm_head_chunk=32)
+        engine, batch, _ = build_candidate_engine(SPACES["gpt2_test_gate"], cand)
+        try:
+            step = engine.traced_programs(batch, lower=False)["train_step"]
+            est = estimate_memory(step["jaxpr"])
+            ma = engine.lower_train_step(batch).compile().memory_analysis()
+        finally:
+            set_topology(None)
+        if ma is None:
+            pytest.skip("backend provides no memory_analysis()")
+        xla_total = (ma.argument_size_in_bytes + ma.output_size_in_bytes
+                     + ma.temp_size_in_bytes)
+        ratio = est.peak_bytes / max(xla_total, 1)
+        assert self.BAND[0] <= ratio <= self.BAND[1], (
+            f"{cand.cid}: static {est.peak_bytes} vs XLA {xla_total} "
+            f"(ratio {ratio:.2f})")

@@ -115,6 +115,43 @@ def test_moe_scenarios_declare_sec_signature(matrix):
         assert sigs and all(len(s) == 3 for s in sigs)
 
 
+def test_skipped_scenarios_are_structured_gaps(matrix):
+    """Every skip carries a machine-readable blocking gap {kind, detail}
+    — the shape the lint report commits, so burn-down is a metric."""
+    _, skipped = matrix
+    for name, gap in skipped.items():
+        assert set(gap) == {"kind", "detail"}, (name, gap)
+        assert gap["kind"] and gap["detail"]
+
+
+def test_composition_blocking_gap_ratchet():
+    """ROADMAP-5 burn-down, step 1: the composition scenario's first
+    blocking gap may only move FORWARD through the order
+    device-count -> partial-manual -> moe-in-pipe -> none. The floor is
+    environment-conditional (an 8-device tier-1 run legitimately blocks
+    on device count), but a backward move — e.g. a refactor that breaks
+    the 16-device build back into a device-count error on a capable
+    runtime — fails here."""
+    import jax
+
+    from deepspeed_tpu.analysis.scenarios import (COMPOSITION_GAP_ORDER,
+                                                  composition_blocking_gap,
+                                                  composition_gap_rank)
+    from deepspeed_tpu.utils.jax_compat import PARTIAL_MANUAL_OK
+
+    gap = composition_blocking_gap()
+    assert gap["kind"] in COMPOSITION_GAP_ORDER, gap
+    if len(jax.devices()) < 16:
+        floor = "device_count"
+    elif not PARTIAL_MANUAL_OK:
+        floor = "partial_manual"
+    else:
+        floor = "moe_in_pipe"
+    assert composition_gap_rank(gap["kind"]) >= composition_gap_rank(floor), (
+        f"composition gap regressed backward: {gap} (floor on this "
+        f"runtime: {floor})")
+
+
 def test_dense_env_route_fires_r001_through_scenarios(monkeypatch):
     """DS_MOE_ROUTE=dense — the seeded regression — must reach the traced
     scenario program through the same resolution layers as a bench run

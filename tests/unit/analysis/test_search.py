@@ -1,0 +1,171 @@
+"""graft-search units (analysis/search.py): the candidate grammar and
+enumeration, Pareto semantics with dominated-candidate provenance, the
+static dot-FLOP proxy (pinned against XLA's own ``cost_analysis()``),
+and — the PR's acceptance teeth — trace-level proof that each search
+dimension is a REAL engine knob: the chosen remat policy shows up as
+remat2 coverage in the traced jaxpr, the chosen LM-head chunk shows up
+in the program's logits shapes, the projection-fusion and optimizer
+variants reshape the program."""
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.analysis import flops_proxy
+from deepspeed_tpu.analysis.search import (SPACES, Candidate, enumerate_candidates,
+                                           pareto, price_candidate)
+from deepspeed_tpu.parallel.topology import set_topology
+
+GATE = SPACES["gpt2_test_gate"]
+
+
+@pytest.fixture(autouse=True)
+def _clean_topology():
+    set_topology(None)
+    yield
+    set_topology(None)
+
+
+def _price(cand):
+    return price_candidate(GATE, cand)
+
+
+# ---------------------------------------------------------------------------
+# grammar + enumeration
+# ---------------------------------------------------------------------------
+class TestEnumeration:
+    def test_product_plus_probes_deduped_and_ordered(self):
+        cands = enumerate_candidates(GATE)
+        ids = [c.cid for c in cands]
+        assert len(ids) == len(set(ids))
+        # 3 remat x 2 chunk + 2 probes
+        assert len(ids) == 8
+        assert enumerate_candidates(GATE) == cands  # deterministic order
+
+    def test_judged_350m_space_has_at_least_24_candidates(self):
+        assert len(enumerate_candidates(SPACES["350m_judged"])) >= 24
+
+    def test_bad_remat_spec_rejected(self):
+        with pytest.raises(ValueError, match="remat spec"):
+            Candidate(remat="sometimes", lm_head_chunk=0)
+        with pytest.raises(ValueError, match="optimizer variant"):
+            Candidate(remat="none", lm_head_chunk=0, optimizer="sgd")
+
+    def test_unknown_axis_rejected(self):
+        import dataclasses
+        bad = dataclasses.replace(GATE, axes={"warp_speed": (9,)})
+        with pytest.raises(ValueError, match="unknown axes"):
+            enumerate_candidates(bad)
+
+    def test_program_block_grammar(self):
+        blk = Candidate(remat="every_2:dots_saveable", lm_head_chunk=64).program_block()
+        assert blk == {"remat": True, "remat_every": 2,
+                       "remat_policy": "dots_saveable", "lm_head_chunk": 64,
+                       "fused_qkv": True, "fused_attn_out": True}
+        assert Candidate(remat="none", lm_head_chunk=0).program_block()["remat"] is False
+
+
+# ---------------------------------------------------------------------------
+# Pareto semantics
+# ---------------------------------------------------------------------------
+class TestPareto:
+    def _cands(self, rows):
+        return {cid: {"metrics": dict(zip(("a", "b"), m))} for cid, m in rows}
+
+    def test_frontier_and_provenance(self):
+        cands = self._cands([("w1", (1, 9)), ("w2", (9, 1)),
+                             ("mid", (5, 5)), ("loser", (9, 9))])
+        frontier, dominated_by = pareto(cands, ("a", "b"))
+        assert frontier == ["w1", "w2", "mid"]
+        assert dominated_by == {"loser": ["w1", "w2", "mid"]}
+
+    def test_ties_both_survive(self):
+        cands = self._cands([("x", (1, 1)), ("y", (1, 1))])
+        frontier, dominated_by = pareto(cands, ("a", "b"))
+        assert frontier == ["x", "y"] and not dominated_by
+
+
+# ---------------------------------------------------------------------------
+# the static FLOP proxy
+# ---------------------------------------------------------------------------
+class TestFlopsProxy:
+    def test_matches_cost_analysis_on_matmul_chain(self):
+        a = jnp.ones((128, 128), jnp.float32)
+        f = lambda x: jnp.tanh(x @ x) @ x
+        proxy = flops_proxy(jax.make_jaxpr(f)(a))
+        ca = jax.jit(f).lower(a).compile().cost_analysis()
+        entry = ca[0] if isinstance(ca, (list, tuple)) else ca
+        flops = float(entry.get("flops", 0.0)) if isinstance(entry, dict) else 0.0
+        if not flops:
+            pytest.skip("backend provides no cost_analysis flops")
+        assert 0.5 <= proxy / flops <= 2.0, (proxy, flops)
+
+    def test_scan_bodies_multiply_by_length(self):
+        w = jnp.ones((64, 64), jnp.float32)
+
+        def loop(w, length):
+            def body(c, _):
+                return jnp.tanh(c @ w), None
+            out, _ = jax.lax.scan(body, jnp.ones((8, 64)), None, length=length)
+            return out.sum()
+
+        one = flops_proxy(jax.make_jaxpr(lambda w: loop(w, 1))(w))
+        eight = flops_proxy(jax.make_jaxpr(lambda w: loop(w, 8))(w))
+        assert eight == 8 * one
+
+
+# ---------------------------------------------------------------------------
+# the acceptance teeth: knobs land in the traced program
+# ---------------------------------------------------------------------------
+class TestKnobTraceEvidence:
+    """Each search dimension is a real engine knob with trace-level
+    evidence (ISSUE 12 acceptance): remat policy as remat2 coverage, the
+    LM-head chunk in program shapes, fusion variants in the dot shapes."""
+
+    def test_remat_policy_families_visible_as_remat2_coverage(self):
+        by_remat = {r: _price(Candidate(remat=r, lm_head_chunk=32))["evidence"]
+                    for r in ("none", "every_1", "every_1:dots_saveable", "every_2")}
+        assert by_remat["none"]["remat2_sites"] == 0
+        # test model: 2 blocks -> every_1 covers both, every_2 covers one
+        assert by_remat["every_1"]["remat2_sites"] == 2
+        assert by_remat["every_2"]["remat2_sites"] == 1
+        assert by_remat["every_1:dots_saveable"]["remat2_sites"] == 2
+        assert by_remat["every_1:dots_saveable"]["remat_policy_saved"] is True
+        assert by_remat["every_1"]["remat_policy_saved"] is False
+
+    def test_remat_moves_the_objectives_the_right_way(self):
+        none = _price(Candidate(remat="none", lm_head_chunk=32))["metrics"]
+        full = _price(Candidate(remat="every_1", lm_head_chunk=32))["metrics"]
+        dots = _price(Candidate(remat="every_1:dots_saveable",
+                                lm_head_chunk=32))["metrics"]
+        # full recompute: less transient, more dot-FLOPs
+        assert full["peak_transient_bytes"] < none["peak_transient_bytes"]
+        assert full["flops_proxy"] > none["flops_proxy"]
+        # dots_saveable keeps matmul outputs: no dot recompute at all
+        assert dots["flops_proxy"] == none["flops_proxy"]
+
+    def test_lm_head_chunk_visible_in_program_shapes(self):
+        chunked = _price(Candidate(remat="none", lm_head_chunk=32))["evidence"]
+        unfused = _price(Candidate(remat="none", lm_head_chunk=0))["evidence"]
+        assert 32 in chunked["lm_head_chunks"] and not chunked["full_logits"]
+        assert unfused["full_logits"] and not unfused["lm_head_chunks"]
+
+    def test_qkv_and_attn_out_fusion_visible_in_dot_shapes(self):
+        fused = _price(Candidate(remat="none", lm_head_chunk=0))["evidence"]
+        split = _price(Candidate(remat="none", lm_head_chunk=0,
+                                 fused_qkv=False, fused_attn_out=False))["evidence"]
+        assert fused["qkv_fused_dots"] > 0 and fused["qkv_split_dots"] == 0
+        assert split["qkv_split_dots"] > 0 and split["qkv_fused_dots"] == 0
+        assert fused["attn_out_fused_dots"] > 0 and fused["attn_out_reshaped_dots"] == 0
+        assert split["attn_out_reshaped_dots"] > 0 and split["attn_out_fused_dots"] == 0
+
+    def test_optimizer_fusion_variant_reshapes_the_program(self):
+        fused = _price(Candidate(remat="none", lm_head_chunk=0))
+        chained = _price(Candidate(remat="none", lm_head_chunk=0,
+                                   optimizer="chained"))
+        # optax's staged composition traces more eqns than the single
+        # tree-map chain; identical model compute
+        assert chained["metrics"]["eqns"] != fused["metrics"]["eqns"]
+        assert chained["metrics"]["flops_proxy"] == fused["metrics"]["flops_proxy"]
+        assert chained["knobs"]["optimizer"] == "chained"

@@ -1,0 +1,80 @@
+"""bench.py parity bank gate (ROADMAP 4, last clause): a round whose
+parity phase reports ``within_envelope: false`` must refuse to bank its
+throughput number unless ``PARITY_BANK_ANYWAY=1``, and either way the
+bench JSON records the verdict plus a per-scope precision-attribution
+summary. Pure host-side logic — no jax, no subprocesses."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_gate_mod", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)  # top level imports no jax by design
+    return mod
+
+
+def _result(within, attribution=None):
+    return {"metric": "m", "value": 1.0,
+            "parity": {"within_envelope": within, "max_ulp": 47,
+                       "envelope_ulp": 0,
+                       "precision_attribution": attribution or {}}}
+
+
+def test_out_of_envelope_refuses_bank_and_records(bench, tmp_path, monkeypatch):
+    monkeypatch.delenv("PARITY_BANK_ANYWAY", raising=False)
+    banked = tmp_path / ".bench_banked.json"
+    banked.write_text(json.dumps({"value": 1.0}))
+    attribution = {"float16->float32 @ pjit:train_step/logsumexp": 3,
+                   "bfloat16->float32 @ pjit:train_step/ln_f": 2,
+                   "float16->float32 @ pjit:train_step/ln_f": 1}
+    result = _result(False, attribution)
+    assert bench._apply_parity_bank_gate(result, str(banked)) is False
+    assert not banked.exists(), "refusal must un-bank the pre-parity number"
+    gate = result["parity_bank"]
+    assert "refused" in gate and gate["within_envelope"] is False
+    assert gate["max_ulp"] == 47
+    # per-scope summary: counts collapse over (src->dst), sorted by weight
+    assert gate["precision_attribution_by_scope"] == {
+        "pjit:train_step/logsumexp": 3, "pjit:train_step/ln_f": 3}
+
+
+def test_bank_anyway_env_overrides_but_still_records(bench, tmp_path, monkeypatch):
+    monkeypatch.setenv("PARITY_BANK_ANYWAY", "1")
+    banked = tmp_path / ".bench_banked.json"
+    banked.write_text(json.dumps({"value": 1.0}))
+    result = _result(False)
+    assert bench._apply_parity_bank_gate(result, str(banked)) is True
+    assert banked.exists(), "the override keeps the banked number"
+    assert result["parity_bank"]["banked_anyway"] is True
+    assert "refused" not in result["parity_bank"]
+
+
+def test_within_envelope_is_untouched(bench, tmp_path, monkeypatch):
+    monkeypatch.delenv("PARITY_BANK_ANYWAY", raising=False)
+    banked = tmp_path / ".bench_banked.json"
+    banked.write_text("{}")
+    for parity in (_result(True)["parity"], {"error": "accel curve rc=1"}, None):
+        result = {"metric": "m", "value": 1.0, "parity": parity}
+        assert bench._apply_parity_bank_gate(result, str(banked)) is True
+        assert "parity_bank" not in result
+        assert banked.exists()
+
+
+def test_attribution_error_dict_degrades_to_empty_summary(bench, tmp_path,
+                                                          monkeypatch):
+    monkeypatch.delenv("PARITY_BANK_ANYWAY", raising=False)
+    banked = tmp_path / ".bench_banked.json"
+    banked.write_text("{}")
+    result = _result(False, {"error": "Timeout: trace failed"})
+    assert bench._apply_parity_bank_gate(result, str(banked)) is False
+    assert result["parity_bank"]["precision_attribution_by_scope"] == {}

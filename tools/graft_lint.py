@@ -17,6 +17,18 @@ and the R013 ratchet against ``analysis_results/cost_baseline.json``
 (peak bytes + wire bytes + collective counts per scenario; growth past
 tolerance gates). ``--cost --update-baseline`` banks the current costs
 (merge semantics — subset runs refresh only their own entries).
+
+Full-matrix ``--cost`` runs additionally re-price every ``gate=True``
+graft-search space (deepspeed_tpu/analysis/search.py) and ratchet it
+against the committed ``analysis_results/search_pareto.json`` (rule
+R014): a drifted candidate set, a committed Pareto winner whose static
+price moves >5%, or a winner that is now dominated fails the gate.
+``--search`` forces the pass on scenario subsets; ``--no-search`` skips
+it; seeded regression: ``DS_LMHEAD_CHUNK=16 python tools/graft_lint.py
+--cost`` (the env layer drifts every candidate's traced program, so the
+committed winners' prices move and R014 exits 1 — the DS_MOE_ROUTE
+pattern). Bank frontier changes with ``tools/graft_search.py --update``,
+never here.
 Seeded cost regressions: ``DS_MOE_ROUTE=dense`` (R009 route-signature
 drift + the dense-einsum memory delta), ``DS_PIPE_ACT_BUDGET_MB=2``
 on ``pipe_chunked_step`` (R010: the chunked schedule cannot fit the
@@ -108,7 +120,17 @@ def run(argv=None) -> int:
                          "collective layer / backend cross-check; trace-only)")
     ap.add_argument("--no-ast", action="store_true", help="skip the source AST pass")
     ap.add_argument("--ast-only", action="store_true", help="run ONLY the source AST pass")
+    ap.add_argument("--search", action="store_true",
+                    help="with --cost: run the R014 search-frontier gate even on a "
+                         "--scenarios subset (default: full-matrix runs only)")
+    ap.add_argument("--no-search", action="store_true",
+                    help="with --cost: skip the R014 search-frontier gate")
+    ap.add_argument("--search-pareto",
+                    default=os.path.join(REPO, "analysis_results", "search_pareto.json"))
     ap.add_argument("--list", action="store_true", help="print rules + scenarios and exit")
+    ap.add_argument("--rules-md", action="store_true",
+                    help="print the README rule table generated from the rule "
+                         "registry and exit (keeps docs from drifting behind new rules)")
     ap.add_argument("-q", "--quiet", action="store_true")
     args = ap.parse_args(argv)
 
@@ -123,17 +145,28 @@ def run(argv=None) -> int:
     from deepspeed_tpu import analysis
     from deepspeed_tpu.analysis import scenarios as scen
 
+    if args.rules_md:
+        print(analysis.rules_markdown())
+        return 0
+
     if args.list:
+        # generated from the registry — a newly registered rule (e.g. R014)
+        # appears here with zero doc edits; same source as --rules-md
         print("rules:")
-        for r in analysis.RULES.values():
+        for r in sorted(analysis.RULES.values(), key=lambda r: r.id):
             print(f"  {r.id}  [{r.severity:5s} {r.layer:5s}] {r.title}")
         print("scenarios:")
         for name in scen.SCENARIOS:
             print(f"  {name}")
+        print("search spaces (analysis/search.py; R014 gates gate=True spaces):")
+        for name, space in analysis.SPACES.items():
+            n = len(analysis.enumerate_candidates(space))
+            print(f"  {name}  [{n} candidates{' gate' if space.gate else ''}]")
         print("cost metrics (per program, --cost):")
         print("  peak_bytes / peak_transient_bytes  static liveness estimate (analysis/memory.py)")
         print("  bytes_moved{jaxpr,stablehlo,compiled}  analytic wire bytes (analysis/hlo_cost.py)")
         print("  collective counts per layer+kind   ratcheted by R013 vs cost_baseline.json")
+        print("  frontier winners + price drift     ratcheted by R014 vs search_pareto.json")
         return 0
 
     # ---- program layer -------------------------------------------------
@@ -160,8 +193,8 @@ def run(argv=None) -> int:
                              f"transient={cost.memory.peak_transient_bytes / 2**20:.1f}MiB "
                              f"comms={cost.bytes_moved()}")
                 print(line)
-        for name, reason in skipped.items():
-            print(f"  {name:24s} SKIPPED: {reason}")
+        for name, gap in skipped.items():
+            print(f"  {name:24s} SKIPPED [{gap['kind']}]: {gap['detail']}")
 
     # ---- source layer --------------------------------------------------
     ast_findings = []
@@ -183,6 +216,20 @@ def run(argv=None) -> int:
             for f in ratchet:
                 fs, metrics = per_program.setdefault(f.scenario, ([], {}))
                 fs.append(f)
+
+    # ---- search-frontier ratchet (R014) --------------------------------
+    # full-matrix --cost runs re-price the gate spaces against the
+    # committed Pareto artifact; subset runs skip (their scenario list was
+    # scoped on purpose) unless --search forces it. Banking happens in
+    # tools/graft_search.py --update, never via --update-baseline.
+    if (args.cost and not args.ast_only and not args.no_search
+            and not args.update_baseline
+            and (args.scenarios is None or args.search)):
+        for f in analysis.verify_spaces(
+                args.search_pareto,
+                log=(None if args.quiet else lambda s: print(f"  [search]{s}"))):
+            fs, metrics = per_program.setdefault(f.scenario, ([], {}))
+            fs.append(f)
 
     # ---- waivers -------------------------------------------------------
     waiver_entries = []

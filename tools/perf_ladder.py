@@ -46,7 +46,7 @@ SEQ = 1024
 
 def run_rung(tag, model_name, mb, offload=False, steps=None, seq=None,
              fused_xent=False, ds=None, cfg_overrides=None, pipe_stages=0,
-             retry_evidence=None):
+             retry_evidence=None, retry_evidence_extra=None):
     ds_overrides = dict(ds or {})
     if offload:
         # full ZeRO-Infinity single-chip recipe: params rest pinned-host and
@@ -87,6 +87,7 @@ def run_rung(tag, model_name, mb, offload=False, steps=None, seq=None,
            **moe_route_evidence(cfg),
            **lint_evidence(engine, batch, programs),
            **cost_evidence(engine, batch, programs),
+           **(retry_evidence_extra or {}),
            **(retry_evidence or {}))
 
 
@@ -271,6 +272,76 @@ RUNGS = {
                                        "optimizer": {"type": "Adam",
                                                      "params": {"lr": 1e-4}}}),
 }
+
+
+def _frontier_rungs():
+    """Rungs generated FROM the committed graft-search Pareto frontier
+    (analysis_results/search_pareto.json, 350m_judged space): the next
+    chip window measures exactly the statically-surviving candidate set —
+    never a dominated loser (ISSUE 12 / ROADMAP 3). Pareto-tied
+    candidates (identical static metrics, e.g. fused-vs-split QKV, which
+    the static model cannot distinguish — only the chip can) collapse to
+    their first enumerated representative so the window pays one rung per
+    distinct static price point; the skipped ties are listed in the
+    rung's ``search_ties`` evidence. The remat/chunk/fusion knobs route
+    through the engine "program" block + optimizer.legacy_fusion exactly
+    as priced; attention is the ONE deliberate delta — the frontier was
+    priced on the backend-reproducible XLA attention program while the
+    rung measures under the bench methodology's flash kernel, so each
+    rung stamps ``search_priced_backend: "xla"`` next to its candidate id
+    (the priced no-remat transients are dominated by XLA's materialized
+    scores; flash removes that term, which only WIDENS the frontier's
+    remat/chunk wins — the window verifies, it does not assume)."""
+    path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        "analysis_results", "search_pareto.json")
+    if not os.path.exists(path):
+        return {}
+    # the validated loader, not raw json: a version-bumped or corrupt
+    # artifact must refuse loudly here exactly as it does in graft_lint
+    from deepspeed_tpu.analysis.search import load_search_artifact
+    space = load_search_artifact(path).get("spaces", {}).get("350m_judged")
+    if not space:
+        return {}
+    rungs, seen_metrics = {}, {}
+    for cid in space["frontier"]:
+        entry = space["candidates"][cid]
+        knobs, metrics = entry["knobs"], entry["metrics"]
+        key = tuple(metrics.get(o) for o in space["objectives"])
+        if key in seen_metrics:
+            rungs[seen_metrics[key]].setdefault("retry_evidence_extra", {}) \
+                .setdefault("search_ties", []).append(cid)
+            continue
+        from deepspeed_tpu.analysis.search import Candidate
+        ds = {"program": Candidate(**knobs).program_block()}
+        if knobs.get("optimizer") == "chained":
+            ds["optimizer"] = {"type": "AdamW", "legacy_fusion": True,
+                               "params": {"lr": 1e-4, "weight_decay": 0.01}}
+        slug = (knobs["remat"].replace(":", "-").replace("_", "") +
+                f"_h{knobs['lm_head_chunk']}"
+                + ("" if knobs.get("fused_qkv", True) else "_qkvsplit")
+                + ("" if knobs.get("fused_attn_out", True) else "_outreshape")
+                + ("" if knobs.get("optimizer", "fused") == "fused" else "_optchained"))
+        tag = f"350m_search_{slug}"
+        seen_metrics[key] = tag
+        rungs[tag] = dict(
+            model_name="350m", mb=space["model"]["micro_bs"],
+            seq=space["model"]["seq"], ds=ds,
+            retry_evidence_extra={"search_candidate": cid,
+                                  "search_space": "350m_judged",
+                                  "search_priced_backend": "xla"})
+    return rungs
+
+
+def _install_frontier_rungs():
+    try:
+        for tag, spec in _frontier_rungs().items():
+            RUNGS.setdefault(tag, spec)
+    except Exception as e:  # a corrupt artifact must not kill the ladder
+        print(f"# frontier rungs unavailable: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
+
+_install_frontier_rungs()
 
 
 def _rung_retry_policy():
