@@ -27,7 +27,8 @@ from deepspeed_tpu.analysis import source_rules as _source_rules  # noqa: F401 â
 from deepspeed_tpu.analysis.memory import MemoryEstimate, estimate_memory
 from deepspeed_tpu.analysis.cost import (CostInfo, build_cost, cost_baseline_from,
                                          cost_engine_program, load_cost_baseline,
-                                         r013_cost_ratchet, run_cost_rules)  # registers R009-R013
+                                         r013_cost_ratchet, run_cost_rules,
+                                         static_price_from_programs)  # registers R009-R013
 from deepspeed_tpu.analysis.search import (SPACES, Candidate, SearchSpace,
                                            enumerate_candidates, flops_proxy,
                                            gate_space_names, load_search_artifact,
@@ -47,6 +48,7 @@ __all__ = [
     "MemoryEstimate", "estimate_memory",
     "CostInfo", "build_cost", "run_cost_rules", "r013_cost_ratchet",
     "load_cost_baseline", "cost_baseline_from", "cost_engine_program",
+    "static_price_from_programs",
     "SPACES", "Candidate", "SearchSpace", "enumerate_candidates", "flops_proxy",
     "gate_space_names", "load_search_artifact", "pareto", "price_candidate",
     "r014_search_frontier", "run_space", "search_artifact_from", "verify_spaces",

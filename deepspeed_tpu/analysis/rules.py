@@ -1,4 +1,4 @@
-"""Program-layer rules R001–R007.
+"""Program-layer rules R001–R007 and R015.
 
 Each rule converts one piece of this repo's accumulated perf/correctness
 folklore into an enforced check (ISSUE 7; the per-rule history is cited
@@ -301,3 +301,28 @@ def r007_sharding_coverage(program, analyzer):
                     f"in a multi-device program with no sharding constraints",
             location=rec.scope))
     return _cap(findings, "R007", program.name, suppressed)
+
+
+# ---------------------------------------------------------------------------
+@rule("R015", "telemetry must not enter the traced step program", ERROR, LAYER_JAXPR)
+def r015_telemetry_identity(program, analyzer):
+    """graft-trace (runtime/telemetry) instruments HOST phases only: spans
+    wrap staging/dispatch/wait around the jitted step, never inside it. A
+    single stray ``io_callback``/``debug_print``/eager sync traced into
+    the step would silently tax every dispatch (the R003 class) — so the
+    ``train_batch_telemetry`` scenario stamps ``expect_eqn_count``, the
+    recursive eqn count of the SAME engine program traced telemetry-off,
+    and this rule fails on any divergence. Zero tolerance on purpose: the
+    two traces differ only by the telemetry config block, so any eqn
+    delta IS instrumentation leaking into the compiled program."""
+    expected = program.metadata.get("expect_eqn_count")
+    if expected is None:
+        return []
+    actual = len(analyzer.records())
+    if actual != int(expected):
+        return [Finding(
+            rule="R015", severity=ERROR, scenario=program.name,
+            message=f"traced step has {actual} eqns but its telemetry-off twin "
+                    f"has {expected} — instrumentation entered the compiled program",
+            location="<jaxpr>")]
+    return []

@@ -238,6 +238,48 @@ def train_batch_parity() -> ProgramInfo:
         set_topology(None)
 
 
+@scenario("train_batch_telemetry")
+def train_batch_telemetry() -> ProgramInfo:
+    """The ``train_batch_parity`` engine config with the telemetry block
+    ON — the gate that graft-trace instrumentation can never silently
+    enter the compiled program. The builder traces the SAME engine twice
+    (telemetry-off first, jaxpr-only) and stamps the off-trace's
+    recursive eqn count as ``expect_eqn_count``; rule R015 fails on any
+    divergence, and R003 must stay clean on the telemetry-on program
+    (spans are host-side, so no callback can appear in the jaxpr)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.analysis.program import ProgramAnalyzer
+    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+    from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
+
+    base = {"train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 0}}
+    batch = {"input_ids": np.zeros((8, 32), np.int32)}
+
+    def build(extra):
+        topo = (MeshTopology(data=8, devices=jax.devices()[:8])
+                if len(jax.devices()) >= 8 else None)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT2LMHeadModel(get_gpt2_config("test")), topology=topo,
+            config={**base, **extra})
+        return engine
+
+    set_topology(None)
+    try:
+        off = build({}).traced_programs(batch, lower=False)["train_step"]
+        off_count = len(ProgramAnalyzer(ProgramInfo(
+            name="telemetry_off", jaxpr=off["jaxpr"], kind="train_step")).records())
+        # enabled telemetry, default output_path: tracing never writes, so
+        # no run dir is created (the sink is lazy; the header only lands on
+        # a real train_batch)
+        engine = build({"telemetry": {"enabled": True}})
+        return _engine_program("train_batch_telemetry", engine, batch,
+                               {"expect_eqn_count": off_count})
+    finally:
+        set_topology(None)
+
+
 @scenario("pipe_scan_step")
 def pipe_scan_step() -> ProgramInfo:
     """The pipeline engine's scan step on a pipe=2 mesh (auto axes size 1

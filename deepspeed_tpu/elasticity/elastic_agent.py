@@ -23,12 +23,13 @@ doubles as a bench/babysitter harness (a hung tunnel run gets killed and
 retried instead of wedging the session).
 """
 
+import json
 import os
 import signal
 import subprocess
 import sys
 import time
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 from deepspeed_tpu.utils.logging import logger
 
@@ -40,15 +41,26 @@ RESTART_ENV = "DS_ELASTIC_RESTART_COUNT"
 _LAST_TOUCH = {}  # path -> monotonic time of last touch (cadence throttle)
 
 
-def touch_heartbeat(path: Optional[str] = None, min_interval: float = 0.0) -> None:
+def touch_heartbeat(path: Optional[str] = None, min_interval: float = 0.0,
+                    payload: Optional[Dict] = None) -> None:
     """Called by the training loop (each step / each checkpoint): refreshes
     the supervisor's liveness signal. No-op when not under an agent.
 
     ``min_interval``: skip the filesystem touch if this path was refreshed
     less than that many seconds ago — the engine's per-step call site runs
     cadenced (``resilience.heartbeat_interval``) so liveness costs one
-    utime per interval, not one per step, off the hot path. Supervisors
-    must size ``heartbeat_timeout`` well above the producer's interval."""
+    write per interval, not one per step, off the hot path. Supervisors
+    must size ``heartbeat_timeout`` well above the producer's interval.
+
+    The file carries a small JSON payload (pid, monotonic clock, wall
+    time, plus caller fields — the engine sends ``global_step`` and the
+    last telemetry span name) so a supervisor or ``tools/fault_bench.py``
+    can report *how far* a child got, not just that it was alive; mtime
+    stays the liveness clock (:func:`read_heartbeat` for the payload).
+
+    A payload-less call on an existing file refreshes the mtime ONLY: a
+    supervisor's backoff sleeps and bench arm-touches share the child's
+    file and must not clobber the training process's progress record."""
     path = path or os.environ.get(HEARTBEAT_ENV)
     if not path:
         return
@@ -57,8 +69,51 @@ def touch_heartbeat(path: Optional[str] = None, min_interval: float = 0.0) -> No
         if now - _LAST_TOUCH.get(path, float("-inf")) < min_interval:
             return
         _LAST_TOUCH[path] = now
-    with open(path, "a"):
+    if payload is None and os.path.exists(path):
         os.utime(path, None)
+        return
+    data = {"pid": os.getpid(), "monotonic": time.monotonic(), "time": time.time()}
+    if payload:
+        data.update(payload)
+    try:
+        blob = json.dumps(data)
+    except (TypeError, ValueError):  # unserializable caller field
+        blob = json.dumps({k: data[k] for k in ("pid", "monotonic", "time")})
+    # atomic publish: a SIGKILL (or a supervisor read) landing mid-write
+    # must never see a truncated record — the post-mortem payload is the
+    # whole point of the file
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as fh:
+            fh.write(blob)
+        os.replace(tmp, path)
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+    os.utime(path, None)
+
+
+def read_heartbeat(path: Optional[str] = None) -> Optional[Dict]:
+    """The last heartbeat payload, or None (missing file / pre-payload
+    empty file / torn write — a reader must never crash on liveness
+    metadata)."""
+    path = path or os.environ.get(HEARTBEAT_ENV)
+    if not path:
+        return None
+    try:
+        with open(path) as fh:
+            blob = fh.read()
+    except OSError:
+        return None
+    if not blob.strip():
+        return None
+    try:
+        data = json.loads(blob)
+    except json.JSONDecodeError:
+        return None
+    return data if isinstance(data, dict) else None
 
 
 class DSElasticAgent:
@@ -117,6 +172,13 @@ class DSElasticAgent:
         env[WORLD_ENV] = str(world_size)
         env[HEARTBEAT_ENV] = heartbeat_path
         env[RESTART_ENV] = str(self.restart_count)
+        # drop the previous attempt's progress record so a child that dies
+        # before its first touch is not credited with the old payload; the
+        # fresh base record carries OUR pid, which _run filters out
+        try:
+            os.unlink(heartbeat_path)
+        except OSError:
+            pass
         touch_heartbeat(heartbeat_path)  # fresh clock for the new child
         return subprocess.Popen(self.cmd, env=env,
                                 start_new_session=True)  # own group: kill cleanly
@@ -196,8 +258,17 @@ class DSElasticAgent:
                     rc = proc.returncode if proc.returncode not in (None, 0) else -9
                     break
                 time.sleep(self.poll_interval)
+            # the payload says how far the child got (global_step + last
+            # telemetry span) — restart logs and post-mortems report
+            # progress, not just liveness
+            hb = read_heartbeat(heartbeat_path)
+            if hb and hb.get("pid") == os.getpid():
+                hb = None  # our own arm-touch record: the child never reported
+            progress = ({k: hb[k] for k in ("global_step", "last_span", "pid")
+                         if k in hb} if hb else None)
             self.history.append(dict(world_size=world, rc=rc, reason=reason,
-                                     duration_s=round(time.time() - t0, 2)))
+                                     duration_s=round(time.time() - t0, 2),
+                                     last_heartbeat=progress))
             if rc == 0:
                 logger.info(f"elastic agent: job finished at world size {world}")
                 return 0
@@ -207,8 +278,9 @@ class DSElasticAgent:
                 return rc if rc is not None else 1
             self.restart_count += 1
             next_world = self.world_sizes[min(self.restart_count, len(self.world_sizes) - 1)]
-            logger.info(f"elastic agent: attempt failed ({reason}); restarting at "
-                     f"world size {next_world}")
+            logger.info(f"elastic agent: attempt failed ({reason}"
+                        + (f"; last progress {progress}" if progress else "")
+                        + f"); restarting at world size {next_world}")
             if self.on_restart is not None:
                 self.on_restart(self.restart_count, next_world)
 

@@ -69,24 +69,56 @@ class WandbMonitor(Monitor):
 
 
 class csvMonitor(Monitor):
+    """CSV sink with cached writers: one open file per tag for the life of
+    the monitor (the original reopened — and ``os.path.getsize``-ed — the
+    file once per event, a syscall storm at MoE per-expert tag counts).
+    Rows are flushed once per ``write_events`` batch; files close at
+    interpreter exit / GC."""
 
     def __init__(self, csv_config):
         super().__init__(csv_config)
         self.filenames = {}
+        self._files = {}  # fname -> (file handle, csv writer)
         self.output_path = os.path.join(csv_config.output_path or "./csv_logs", csv_config.job_name)
         os.makedirs(self.output_path, exist_ok=True)
+        import atexit
+        import weakref
+        # weakref so the atexit hook never keeps a dead monitor alive
+        atexit.register(lambda ref=weakref.ref(self): ref() and ref().close())
+
+    def _writer(self, name):
+        import csv
+        fname = os.path.join(self.output_path, name.replace("/", "_") + ".csv")
+        entry = self._files.get(fname)
+        if entry is None:
+            header = not os.path.exists(fname) or os.path.getsize(fname) == 0
+            fh = open(fname, "a", newline="")
+            w = csv.writer(fh)
+            if header:
+                w.writerow(["step", name])
+            entry = self._files[fname] = (fh, w)
+            self.filenames[fname] = True
+        return entry
 
     def write_events(self, event_list):
-        import csv
+        touched = set()
         for name, value, step in event_list:
-            fname = os.path.join(self.output_path, name.replace("/", "_") + ".csv")
-            new = fname not in self.filenames
-            self.filenames[fname] = True
-            with open(fname, "a", newline="") as f:
-                w = csv.writer(f)
-                if new and os.path.getsize(fname) == 0:
-                    w.writerow(["step", name])
-                w.writerow([int(step), float(value)])
+            fh, w = self._writer(name)
+            w.writerow([int(step), float(value)])
+            touched.add(fh)
+        for fh in touched:  # one flush per batch, not per event
+            fh.flush()
+
+    def close(self):
+        files, self._files = self._files, {}
+        for fh, _ in files.values():
+            try:
+                fh.close()
+            except OSError:
+                pass
+
+    def __del__(self):
+        self.close()
 
 
 class MonitorMaster(Monitor):

@@ -241,6 +241,33 @@ class ResilienceConfig(DeepSpeedConfigModel):
     preempt_exit_code: int = 143
 
 
+class TelemetryConfig(DeepSpeedConfigModel):
+    """graft-trace runtime telemetry block (``runtime/telemetry/``) — the
+    TPU-native rebuild of the reference's observability surface
+    (``monitor/monitor.py`` + ``wall_clock_breakdown`` +
+    ``flops_profiler``): host-side step-phase spans, a schema-versioned
+    JSONL event log, and static-vs-measured drift reporting.
+
+    ``output_path``/``job_name``: the run directory
+    (``<output_path>/<job_name>/telemetry.jsonl``; the
+    ``DS_TRACE_STEPS`` XLA capture lands under ``xla_trace/`` next to it).
+    ``flush_interval_steps``: span/drift window cadence (0 = follow
+    ``steps_per_print``). ``static_price``: stamp the step program's
+    static price (flops_proxy + liveness bytes) into the run header —
+    one extra jaxpr-only trace at the first step. ``span_events``: write
+    the raw span timeline (``tools/trace_report.py`` input) in addition
+    to the per-window aggregates. Telemetry never enters the traced
+    step program (rule R015 + the ``train_batch_telemetry`` scenario)
+    and must stay within 2% step-time overhead (tier-1 gate)."""
+    enabled: bool = False
+    output_path: str = "./telemetry_logs"
+    job_name: str = "DeepSpeedJobName"
+    flush_interval_steps: int = Field(0, ge=0)
+    static_price: bool = True
+    span_events: bool = True
+    max_buffered_spans: int = Field(4096, ge=1)
+
+
 class DeepSpeedConfig:
     """Parses and validates the full config (reference ``DeepSpeedConfig``,
     ``runtime/config.py``)."""
@@ -344,6 +371,7 @@ class DeepSpeedConfig:
         self.checkpoint_config = CheckpointConfig(**param_dict.get(C.CHECKPOINT, {}))
         self.nebula_config = NebulaConfig(**param_dict.get(C.NEBULA, {}))
         self.resilience_config = ResilienceConfig(**param_dict.get(C.RESILIENCE, {}))
+        self.telemetry_config = TelemetryConfig(**param_dict.get(C.TELEMETRY, {}))
         self.hybrid_engine_config = HybridEngineConfig(**param_dict.get("hybrid_engine", {}))
         self.autotuning_config = param_dict.get(C.AUTOTUNING, {})
         self.elasticity_config = param_dict.get(C.ELASTICITY, {})

@@ -142,4 +142,5 @@ QUANTIZE_TRAINING = "quantize_training"
 CHECKPOINT = "checkpoint"
 NEBULA = "nebula"
 RESILIENCE = "resilience"
+TELEMETRY = "telemetry"
 DATA_TYPES = "data_types"

@@ -258,6 +258,35 @@ class DeepSpeedEngine:
         from deepspeed_tpu.monitor.monitor import MonitorMaster
         self.monitor = MonitorMaster(config.monitor_config)
 
+        # -- telemetry (runtime/telemetry, graft-trace): host-side step
+        # spans + JSONL event log + drift. The monitor is ONE subscriber of
+        # the event bus — TB/W&B/CSV keep working unchanged, and every
+        # published batch also lands durably in the JSONL when enabled.
+        # Instrumentation is host-only by construction: the traced step
+        # program must stay eqn-identical with telemetry on (rule R015,
+        # scenario train_batch_telemetry) and within 2% step time (tier-1).
+        from deepspeed_tpu.runtime.telemetry import RuntimeTelemetry, parse_trace_steps
+        self.telemetry = RuntimeTelemetry(config.telemetry_config,
+                                          flush_every=config.steps_per_print,
+                                          rank=dist.get_rank(),
+                                          run_info_fn=self._telemetry_run_info)
+        if self.monitor.enabled:
+            self.telemetry.subscribe(self.monitor.write_events)
+        # DS_TRACE_STEPS=<start>[:<count>]: cadenced XLA device-trace
+        # capture into the telemetry run dir (jax_compat.profiler_start_trace
+        # via _maybe_trace_window) — the env wins over any trace_profiler
+        # config block, the A/B lever for one-off captures
+        _trace_spec = parse_trace_steps(os.environ.get("DS_TRACE_STEPS"))
+        if _trace_spec is not None:
+            from deepspeed_tpu.profiling.config import DeepSpeedTraceProfilerConfig
+            _tc = config.trace_profiler_config
+            _out = (os.path.join(self.telemetry.run_dir, "xla_trace")
+                    if self.telemetry.run_dir else _tc.output_dir)
+            config.trace_profiler_config = DeepSpeedTraceProfilerConfig(
+                enabled=True, start_step=_trace_spec[0], num_steps=_trace_spec[1],
+                output_dir=_out, host_tracer_level=_tc.host_tracer_level,
+                python_tracer=_tc.python_tracer)
+
         # -- resilience (runtime/resilience): host mirror of the compiled
         #    overflow-skip state + preemption-to-checkpoint signal handling
         _rcfg = config.resilience_config
@@ -767,6 +796,53 @@ class DeepSpeedEngine:
                                "lower": (lambda: lowered) if lowered is not None else None}}
 
     # ------------------------------------------------------------------
+    # telemetry (runtime/telemetry): run-header provenance + static price
+    # ------------------------------------------------------------------
+    def _telemetry_run_info(self):
+        """What the JSONL run header stamps: enough provenance to tie every
+        drift ratio back to the exact program shape that produced it."""
+        import jaxlib
+
+        from deepspeed_tpu.runtime.telemetry import config_signature
+        info = {
+            "config_sig": config_signature(self.config.raw_dict or {}),
+            "pid": os.getpid(),
+            "jax_version": jax.__version__,
+            "jaxlib_version": getattr(jaxlib, "__version__", "unknown"),
+            "backend": jax.default_backend(),
+            "mesh_axes": {str(a): int(s) for a, s in self.mesh.shape.items()},
+            "world_size": dist.get_world_size(),
+            "model": type(self.module).__name__,
+            "dtype": self.compute_dtype.__name__,
+            "zero_stage": self.config.zero_optimization_stage,
+            "train_batch_size": self.config.train_batch_size,
+            "gradient_accumulation_steps": self.config.gradient_accumulation_steps,
+        }
+        info.update(self._telemetry_run_extra())
+        return info
+
+    def _telemetry_run_extra(self):
+        """Subclass hook (PipelineEngine adds its schedule block)."""
+        return {}
+
+    def _maybe_write_telemetry_header(self, batch):
+        """First-step lazy run header: the static price needs a traced
+        program, which needs a concrete batch shape. Jaxpr-only trace
+        (``lower=False`` — the graft-search fast path); priced once per
+        run, before the warm steps a bench would time. Pricing failure
+        degrades to an error field — observability never kills a step."""
+        if not self.telemetry.wants_run_header:
+            return
+        price = None
+        if getattr(self.config.telemetry_config, "static_price", True):
+            try:
+                from deepspeed_tpu.analysis import static_price_from_programs
+                price = static_price_from_programs(self.traced_programs(batch, lower=False))
+            except Exception as e:  # noqa: BLE001
+                price = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+        self.telemetry.write_run_header(static_price=price)  # run_info via run_info_fn
+
+    # ------------------------------------------------------------------
     # ZeRO-Offload / ZeRO-Infinity: optimizer states off-device
     # (reference stage_1_and_2 cpu_offload / stage3 + swap_tensor; SURVEY §7.3)
     # ------------------------------------------------------------------
@@ -1105,31 +1181,33 @@ class DeepSpeedEngine:
         shard_leaves = jax.tree.leaves(self.state_shardings.params)
         grad_dev = jax.tree.leaves(grads)
         if getattr(self, "_host_shard_mode", False):
-            return self._offload_step_sharded(loss, gnorm, leaves, treedef,
-                                              shard_leaves, grad_dev)
+            with self.telemetry.span("optimizer_host"):
+                return self._offload_step_sharded(loss, gnorm, leaves, treedef,
+                                                  shard_leaves, grad_dev)
         new_leaves = [None] * len(leaves)
-        if hasattr(self._host_opt, "step_single"):
-            # pipelined: d2h of leaf i+1 overlaps the AVX update of leaf i
-            # (the ctypes call releases the GIL); the h2d re-upload of leaf i
-            # is async dispatch. Reference overlaps the same three stages
-            # with CUDA streams (stage_1_and_2.py:1086).
-            if not hasattr(self, "_offload_pool"):
-                import concurrent.futures
-                self._offload_pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
-            fetch = lambda i: np.asarray(jax.device_get(grad_dev[i]), np.float32)
-            self._host_opt.begin_step(lr=self.get_lr()[0])
-            fut = self._offload_pool.submit(fetch, 0)
-            for i, (m, old, s) in enumerate(zip(self._host_masters, leaves, shard_leaves)):
-                g = fut.result()
-                if i + 1 < len(leaves):
-                    fut = self._offload_pool.submit(fetch, i + 1)
-                self._host_opt.step_single(i, m, g)
-                new_leaves[i] = jax.device_put(m.reshape(old.shape).astype(old.dtype), s)  # graft-lint: waive R008 offload params never donated (grads-only fn has no donate_argnums)
-        else:
-            grad_leaves = [np.asarray(jax.device_get(g), np.float32) for g in grad_dev]
-            self._host_opt.step(self._host_masters, grad_leaves, lr=self.get_lr()[0])
-            new_leaves = [jax.device_put(m.reshape(old.shape).astype(old.dtype), s)  # graft-lint: waive R008 offload params never donated (grads-only fn has no donate_argnums)
-                          for m, old, s in zip(self._host_masters, leaves, shard_leaves)]
+        with self.telemetry.span("optimizer_host"):
+            if hasattr(self._host_opt, "step_single"):
+                # pipelined: d2h of leaf i+1 overlaps the AVX update of leaf i
+                # (the ctypes call releases the GIL); the h2d re-upload of leaf i
+                # is async dispatch. Reference overlaps the same three stages
+                # with CUDA streams (stage_1_and_2.py:1086).
+                if not hasattr(self, "_offload_pool"):
+                    import concurrent.futures
+                    self._offload_pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+                fetch = lambda i: np.asarray(jax.device_get(grad_dev[i]), np.float32)
+                self._host_opt.begin_step(lr=self.get_lr()[0])
+                fut = self._offload_pool.submit(fetch, 0)
+                for i, (m, old, s) in enumerate(zip(self._host_masters, leaves, shard_leaves)):
+                    g = fut.result()
+                    if i + 1 < len(leaves):
+                        fut = self._offload_pool.submit(fetch, i + 1)
+                    self._host_opt.step_single(i, m, g)
+                    new_leaves[i] = jax.device_put(m.reshape(old.shape).astype(old.dtype), s)  # graft-lint: waive R008 offload params never donated (grads-only fn has no donate_argnums)
+            else:
+                grad_leaves = [np.asarray(jax.device_get(g), np.float32) for g in grad_dev]
+                self._host_opt.step(self._host_masters, grad_leaves, lr=self.get_lr()[0])
+                new_leaves = [jax.device_put(m.reshape(old.shape).astype(old.dtype), s)  # graft-lint: waive R008 offload params never donated (grads-only fn has no donate_argnums)
+                              for m, old, s in zip(self._host_masters, leaves, shard_leaves)]
         new_params = jax.tree.unflatten(treedef, new_leaves)
         new_ls = self._ls_update(self.state.loss_scale, jnp.asarray(False))
         self.state = TrainState(step=self.state.step + 1, params=new_params,
@@ -2055,15 +2133,23 @@ class DeepSpeedEngine:
         example = jax.tree.map(lambda x: np.asarray(x)[0], batch_stack)
         self._maybe_autotune(example)
         self.initialize_state(example)
+        self._maybe_write_telemetry_header(example)
         self._maybe_trace_window(n_steps)
+        tel = self.telemetry
+        tel.begin_step(self.global_steps + 1)
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
-        device_batch = self._shard_batch_steps(batch_stack)
+        with tel.span("batch_stage"):
+            device_batch = self._shard_batch_steps(batch_stack)
         rng = jax.random.fold_in(self._base_rng, self.global_steps)
-        self.state, metrics = self._train_steps_fn(self.state, device_batch, rng)
+        with tel.span("dispatch"):
+            self.state, metrics = self._train_steps_fn(self.state, device_batch, rng)
         self.global_steps += n_steps
         self.global_samples += n_steps * self.config.train_batch_size
         self.micro_steps += n_steps * self.config.gradient_accumulation_steps
+        if tel.enabled:
+            with tel.span("device_wait"):
+                jax.block_until_ready(metrics["loss"])
         self.timers(TRAIN_BATCH_TIMER).stop()
         self.tput_timer.stop(global_step=True)
         # every step in the stack counts toward overflow accounting, not just
@@ -2090,7 +2176,9 @@ class DeepSpeedEngine:
         # zero a streak the real per-step flags above just built — the
         # abort-after-K guard must see fused stacks exactly as per-dispatch
         last = {k: v for k, v in last.items() if k != "overflow"}  # counted above
-        self._post_step(last)
+        with tel.span("post_step"):
+            self._post_step(last)
+        tel.end_step(self.global_steps, n_steps=n_steps)
         self._maybe_trace_window()
         return metrics["loss"]
 
@@ -2125,37 +2213,49 @@ class DeepSpeedEngine:
                            f"config.train_batch_size={self.config.train_batch_size} "
                            f"(autotuning run mode changes the batch triangle — feed "
                            f"engine.train_batch_size samples); sample accounting will drift")
+        self._maybe_write_telemetry_header(batch)
         self._maybe_trace_window()
+        tel = self.telemetry
+        tel.begin_step(self.global_steps + 1)
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
-        device_batch = self._shard_batch(batch, with_gas_dim=True)
+        with tel.span("batch_stage"):
+            device_batch = self._shard_batch(batch, with_gas_dim=True)
         rng = jax.random.fold_in(self._base_rng, self.global_steps)
         fp_cfg = self.config.flops_profiler_config
         profiling_now = fp_cfg.enabled and self.global_steps + 1 == fp_cfg.profile_step
         if profiling_now:
             t_profile = time.time()
-        if getattr(self, "_host_opt", None) is not None:
-            _, metrics = self._offload_train_batch(device_batch, rng)
-        elif self._zeroone_runner is not None:
-            # 0/1 Adam owns the whole schedule (dense/1-bit/local/sync)
-            metrics = self._zeroone_runner.step(device_batch, rng)
-        elif (self._onebit_cfg is not None
-              and self.global_steps >= self._onebit_cfg["freeze_step"]):
-            # compression phase: momentum rides the 1-bit collective
-            if self._onebit_step_fn is None:
-                self._build_onebit_step_fn(device_batch)
-            self.state, self._onebit_errors, metrics = self._onebit_step_fn(
-                self.state, self._onebit_errors, device_batch, rng)
-        elif getattr(self, "_param_offload_enabled", False):
-            metrics = self._param_offload_train_batch(device_batch, rng)
-        else:
-            self.state, metrics = self._train_step_fn(self.state, device_batch, rng)
+        with tel.span("dispatch"):
+            if getattr(self, "_host_opt", None) is not None:
+                _, metrics = self._offload_train_batch(device_batch, rng)
+            elif self._zeroone_runner is not None:
+                # 0/1 Adam owns the whole schedule (dense/1-bit/local/sync)
+                metrics = self._zeroone_runner.step(device_batch, rng)
+            elif (self._onebit_cfg is not None
+                  and self.global_steps >= self._onebit_cfg["freeze_step"]):
+                # compression phase: momentum rides the 1-bit collective
+                if self._onebit_step_fn is None:
+                    self._build_onebit_step_fn(device_batch)
+                self.state, self._onebit_errors, metrics = self._onebit_step_fn(
+                    self.state, self._onebit_errors, device_batch, rng)
+            elif getattr(self, "_param_offload_enabled", False):
+                metrics = self._param_offload_train_batch(device_batch, rng)
+            else:
+                self.state, metrics = self._train_step_fn(self.state, device_batch, rng)
         self.global_steps += 1
         self.global_samples += self.config.train_batch_size
         self.micro_steps += self.config.gradient_accumulation_steps
         if profiling_now:
             jax.block_until_ready(metrics["loss"])
             step_latency = time.time() - t_profile
+        if tel.enabled:
+            # the ONE deliberate device sync telemetry adds: splits "host
+            # dispatched" from "device finished" so the window aggregates
+            # show where the step's wall time actually went. The timer
+            # stops below sync too, so recorded step time is unchanged.
+            with tel.span("device_wait"):
+                jax.block_until_ready(metrics["loss"])
         self.timers(TRAIN_BATCH_TIMER).stop()
         self.tput_timer.stop(global_step=True)
         if profiling_now:
@@ -2168,7 +2268,9 @@ class DeepSpeedEngine:
                                 step_latency_s=step_latency,
                                 output_file=fp_cfg.output_file)
         self._last_batch_for_stats = batch  # MoE gate observability (_post_step)
-        self._post_step(metrics)
+        with tel.span("post_step"):
+            self._post_step(metrics)
+        tel.end_step(self.global_steps)
         self._maybe_trace_window()  # close the window right after its last step
         return metrics["loss"]
 
@@ -2346,6 +2448,8 @@ class DeepSpeedEngine:
             from deepspeed_tpu.utils.jax_compat import profiler_start_trace
             profiler_start_trace(tc.output_dir, tc.host_tracer_level, tc.python_tracer)
             self._trace_active = True
+            self.telemetry.emit("xla_trace", phase="start", step=step,
+                                output_dir=tc.output_dir)
             log_dist(f"XLA trace capture started at step {step} -> {tc.output_dir}")
         elif getattr(self, "_trace_active", False) and step >= tc.start_step + tc.num_steps:
             import jax.profiler
@@ -2354,6 +2458,8 @@ class DeepSpeedEngine:
                 jax.block_until_ready(self.state.params)
             jax.profiler.stop_trace()
             self._trace_active = False
+            self.telemetry.emit("xla_trace", phase="stop", step=step - 1,
+                                output_dir=tc.output_dir)
             log_dist(f"XLA trace capture stopped after step {step - 1}")
 
     def _post_step(self, metrics):
@@ -2373,7 +2479,9 @@ class DeepSpeedEngine:
         # sync involved, and cadenced (resilience.heartbeat_interval) so the
         # steady state costs one time-read per step, one utime per interval
         from deepspeed_tpu.elasticity.elastic_agent import touch_heartbeat
-        touch_heartbeat(min_interval=self.config.resilience_config.heartbeat_interval)
+        touch_heartbeat(min_interval=self.config.resilience_config.heartbeat_interval,
+                        payload={"global_step": self.global_steps,
+                                 "last_span": self.telemetry.last_span})
         if self.progressive_layer_drop is not None:
             # host mirror of the in-graph schedule (reference update_state)
             self.progressive_layer_drop.update_state(self.global_steps)
@@ -2387,7 +2495,8 @@ class DeepSpeedEngine:
         if (len(self._pending_overflow) >= 16
                 or self.global_steps % self.config.steps_per_print == 0):
             self._drain_overflows()
-        if self.monitor.enabled and self.global_steps % self.config.steps_per_print == 0:
+        if (self.telemetry.has_consumers
+                and self.global_steps % self.config.steps_per_print == 0):
             events = [(f"Train/loss", float(metrics.get("loss", 0.0)), self.global_samples),
                       (f"Train/lr", self.get_lr()[0], self.global_samples)]
             if self._resilience_events:
@@ -2402,7 +2511,10 @@ class DeepSpeedEngine:
                     events += moe_gate_events(self.moe_gate_stats(batch), self.global_samples)
                 except Exception as e:  # observability must never kill a step
                     logger.warning(f"moe gate stats collection failed: {e}")
-            self.monitor.write_events(events)
+            # the event bus: MonitorMaster is a subscriber, the JSONL log
+            # (telemetry enabled) gets the same batch durably
+            with self.telemetry.span("monitor_flush"):
+                self.telemetry.publish_events(events, step=self.global_samples)
         if self.config.wall_clock_breakdown and self.global_steps % self.config.steps_per_print == 0:
             self.timers.log([TRAIN_BATCH_TIMER])
         # deterministic process-death injection (resilience/faults.py): armed
@@ -2534,8 +2646,10 @@ class DeepSpeedEngine:
         """One host-resolved per-step flag → watcher events (buffered for the
         next monitor write) + the fail-fast guard."""
         events = self._overflow_watcher.record(step, overflow, loss_scale)
-        if self.monitor.enabled and events:
-            # monitor x-axis is samples, like the Train/* series
+        if events and self.telemetry.has_consumers:
+            # monitor x-axis is samples, like the Train/* series; buffered
+            # for the next _post_step bus publish so a telemetry-only run
+            # (no monitor backend) still lands Resilience/* in the JSONL
             self._resilience_events.extend(
                 (tag, value, ev_step * self.config.train_batch_size)
                 for tag, value, ev_step in events)
@@ -2610,9 +2724,11 @@ class DeepSpeedEngine:
                  f"{self.global_steps} -> {self._preempt_save_dir}")
         self.save_checkpoint(self._preempt_save_dir)
         self.flush_checkpoints()  # durability before the exit below
-        if self.monitor.enabled:
-            self.monitor.write_events([
-                ("Resilience/preempt_checkpoint", float(self.global_steps), self.global_samples)])
+        self.telemetry.publish_events([
+            ("Resilience/preempt_checkpoint", float(self.global_steps), self.global_samples)],
+            step=self.global_samples)
+        self.telemetry.emit("preempt_checkpoint", signal=sig, step=self.global_steps,
+                            save_dir=self._preempt_save_dir)
         if self._preempt_exit:
             log_dist(f"preemption checkpoint durable; exiting {self._preempt_exit_code}")
             raise SystemExit(self._preempt_exit_code)
@@ -2684,7 +2800,9 @@ class DeepSpeedEngine:
         # stage-then-publish: state AND the extra per-rank files below land
         # in the staging dir and become visible in ONE atomic rename
         # (finalize) — a killed writer never leaves a partial tag
-        engine.save(self.state, tag, metadata=meta, defer_finalize=True)
+        _ckpt_t0 = time.perf_counter()
+        with self.telemetry.span("ckpt_stage"):
+            engine.save(self.state, tag, metadata=meta, defer_finalize=True)
         stage = engine.staging_dir(tag)
         if self._zeroone_runner is not None:
             # pending local updates (u) + error feedback are optimizer state.
@@ -2735,13 +2853,18 @@ class DeepSpeedEngine:
                     register = atexit.register
                 register(_flush_on_exit)
                 self._flush_atexit = True
+            self.telemetry.emit("checkpoint", tag=tag, step=self.global_steps,
+                                dur_s=time.perf_counter() - _ckpt_t0, deferred=True)
             return True
-        dist.barrier()  # all ranks' staged writes land before the publish
-        engine.finalize(tag)  # manifest + fsync + atomic rename (rank-0 rename)
-        if save_latest and dist.get_rank() == 0:
-            from deepspeed_tpu.runtime.resilience.manifest import write_atomic_text
-            write_atomic_text(os.path.join(save_dir, "latest"), tag)
-        dist.barrier()
+        with self.telemetry.span("ckpt_publish"):
+            dist.barrier()  # all ranks' staged writes land before the publish
+            engine.finalize(tag)  # manifest + fsync + atomic rename (rank-0 rename)
+            if save_latest and dist.get_rank() == 0:
+                from deepspeed_tpu.runtime.resilience.manifest import write_atomic_text
+                write_atomic_text(os.path.join(save_dir, "latest"), tag)
+            dist.barrier()
+        self.telemetry.emit("checkpoint", tag=tag, step=self.global_steps,
+                            dur_s=time.perf_counter() - _ckpt_t0, deferred=False)
         return True
 
     def flush_checkpoints(self):
@@ -2851,8 +2974,8 @@ class DeepSpeedEngine:
             except CheckpointCorruptError as e:
                 last_err = e
                 logger.error(f"checkpoint {cand} at {load_dir} is corrupt: {e}")
-                if self.monitor.enabled:
-                    self.monitor.write_events(
+                if self.telemetry.has_consumers:
+                    self.telemetry.publish_events(
                         [("Resilience/checkpoint_corrupt", 1.0, self.global_samples)])
                 if not rcfg.fallback_on_corruption:
                     raise
@@ -2863,8 +2986,8 @@ class DeepSpeedEngine:
         if loaded_tag != tag:
             logger.error(f"fell back from corrupt checkpoint {tag} to newest intact "
                          f"tag {loaded_tag} — training resumes from the older state")
-            if self.monitor.enabled:
-                self.monitor.write_events(
+            if self.telemetry.has_consumers:
+                self.telemetry.publish_events(
                     [("Resilience/checkpoint_fallback", 1.0, self.global_samples)])
         tag = loaded_tag
         self._loaded_checkpoint_tag = loaded_tag

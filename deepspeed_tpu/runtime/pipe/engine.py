@@ -597,6 +597,18 @@ class PipelineEngine(DeepSpeedEngine):
         microbatches, return the aggregated loss."""
         return super().train_batch(batch=batch, data_iter=data_iter)
 
+    def _telemetry_run_extra(self):
+        """Pipeline provenance for the telemetry run header: drift ratios
+        on a pipe rung are meaningless without the schedule that shaped
+        the program (same fields traced_programs stamps for R009/R010)."""
+        extra = {"pipe_schedule": {"stages": self.pipeline.num_stages,
+                                   "micro_batches": self.micro_batches,
+                                   "schedule": self.pipe_schedule,
+                                   "chunk_microbatches": self.pipe_chunk}}
+        if self.pipe_schedule == "1f1b":
+            extra["pipe_schedule"]["stash_slots"] = self.stash_slots
+        return extra
+
     def eval_batch(self, batch):
         """Reference ``pipe/engine.py:363``."""
         self.initialize_state(batch)

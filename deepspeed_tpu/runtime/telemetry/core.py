@@ -1,0 +1,316 @@
+"""RuntimeTelemetry: the engine-facing facade over spans + metrics + sink.
+
+Three pillars (ISSUE 13):
+
+1. **Structured event log + metrics** — a schema-versioned JSONL file
+   per run (``sink.py``) whose ``run_start`` header stamps the config
+   signature, jax/jaxlib versions, mesh axes and the step program's
+   *static price* (``analysis.static_price_from_programs``: flops_proxy,
+   liveness peak/transient bytes, analytic wire bytes). Monitor events
+   ride a tiny bus: ``MonitorMaster`` is just one subscriber, so
+   TB/W&B/CSV behavior is unchanged while every published event also
+   lands durably in the JSONL.
+2. **Step-span timeline** — ``SpanRecorder`` buffers host-phase spans;
+   every ``flush_every`` steps one ``spans`` event (raw timeline) and
+   one ``step_window`` event (per-phase p50/p99 aggregates) are written.
+   ``tools/trace_report.py`` turns the timeline into Chrome trace-event
+   JSON. ``DS_TRACE_STEPS=<start>:<count>`` additionally opens a cadenced
+   ``jax.profiler`` device-trace window into the same run directory
+   (wired by the engine through ``jax_compat.profiler_start_trace``).
+3. **Drift** — each window closes with a ``drift`` event: achieved
+   TFLOPS (predicted ``flops_proxy`` ÷ measured median step time) and
+   predicted-vs-measured memory ratios (device ``memory_stats`` peaks
+   where the backend reports them — TPU; host peak RSS as the loose
+   CPU-backend proxy, explicitly labeled). perf_ladder stamps
+   ``drift_summary()`` next to its lint/cost evidence so a chip window
+   banks model error, not just milliseconds.
+
+The recorder instruments only host code around the dispatched step —
+the traced program is bit-identical with telemetry on (gated by the
+``train_batch_telemetry`` scenario / rule R015 and the tier-1 overhead
+test).
+"""
+
+import hashlib
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from deepspeed_tpu.runtime.telemetry.metrics import Histogram, MetricsRegistry
+from deepspeed_tpu.runtime.telemetry.sink import (TELEMETRY_SCHEMA_VERSION, JsonlSink)
+from deepspeed_tpu.runtime.telemetry.spans import SpanRecorder
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = ["RuntimeTelemetry", "config_signature", "parse_trace_steps",
+           "measured_memory", "TELEMETRY_FILE"]
+
+TELEMETRY_FILE = "telemetry.jsonl"
+
+
+def config_signature(raw_dict: Dict) -> str:
+    """Stable short signature of the user config (run-header provenance)."""
+    try:
+        blob = json.dumps(raw_dict, sort_keys=True, default=str)
+    except (TypeError, ValueError):
+        blob = repr(raw_dict)
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+def parse_trace_steps(spec: Optional[str]) -> Optional[Tuple[int, int]]:
+    """``DS_TRACE_STEPS=<start>[:<count>]`` → (start, count); None when
+    unset/empty. Malformed specs raise — a mistyped capture window must
+    not silently skip the one chip run it was meant to profile."""
+    if not spec:
+        return None
+    parts = spec.split(":")
+    if len(parts) > 2:
+        raise ValueError(f"DS_TRACE_STEPS={spec!r}: expected <start>[:<count>]")
+    try:
+        start = int(parts[0])
+        count = int(parts[1]) if len(parts) == 2 and parts[1] else 1
+    except ValueError as e:
+        raise ValueError(f"DS_TRACE_STEPS={spec!r}: expected integers") from e
+    if start < 1 or count < 1:
+        raise ValueError(f"DS_TRACE_STEPS={spec!r}: start and count must be >= 1")
+    return start, count
+
+
+def measured_memory() -> Dict[str, int]:
+    """Runtime memory observations, backend-dependent: device
+    ``memory_stats`` peaks where the backend reports them (TPU/GPU), and
+    host peak RSS (ru_maxrss) always — on the CPU backend the device IS
+    the host, so RSS is the (loose, process-lifetime) measured bound the
+    drift ratio uses there."""
+    out: Dict[str, int] = {}
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats() or {}
+        for src, dst in (("peak_bytes_in_use", "device_peak_bytes"),
+                         ("bytes_in_use", "device_bytes_in_use")):
+            if src in stats:
+                out[dst] = int(stats[src])
+    except Exception:  # noqa: BLE001 — observability never raises
+        pass
+    try:
+        import resource
+        # linux reports KiB
+        out["host_peak_rss_bytes"] = int(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss) * 1024
+    except Exception:  # noqa: BLE001
+        pass
+    return out
+
+
+def drift_ratios(price: Optional[Dict], median_step_s: Optional[float],
+                 measured: Optional[Dict] = None) -> Dict[str, Any]:
+    """The predicted-vs-measured core, shared by the window flush,
+    ``drift_summary`` and ``tools/trace_report.py --drift``."""
+    out: Dict[str, Any] = {}
+    price = price or {}
+    measured = measured if measured is not None else {}
+    flops = price.get("flops_proxy")
+    if flops and median_step_s:
+        # predicted FLOPs over measured seconds — the flops half of the
+        # drift pair (a chip window compares this against its banked MFU)
+        out["achieved_tflops"] = flops / median_step_s / 1e12
+    peak = price.get("peak_bytes")
+    transient = price.get("peak_transient_bytes")
+    dev_peak = measured.get("device_peak_bytes")
+    if dev_peak and peak:
+        out["device_peak_ratio"] = dev_peak / peak
+    if dev_peak and transient:
+        out["device_peak_vs_predicted_transient"] = dev_peak / transient
+    rss = measured.get("host_peak_rss_bytes")
+    if rss and peak and dev_peak is None:
+        # CPU backend: host RSS is the only measured bound (includes the
+        # interpreter + compile peaks — an upper proxy, labeled as such)
+        out["host_rss_vs_predicted_peak"] = rss / peak
+    return out
+
+
+class RuntimeTelemetry:
+    """Facade the engine owns. Disabled (`cfg.enabled=False`) it is a
+    pure event bus: ``publish_events`` still fans out to subscribers
+    (MonitorMaster), spans/sink are no-ops."""
+
+    def __init__(self, cfg=None, flush_every: int = 10, rank: int = 0,
+                 run_info_fn: Optional[Callable[[], Dict]] = None):
+        self.cfg = cfg
+        self.enabled = bool(cfg is not None and getattr(cfg, "enabled", False))
+        self.rank = int(rank)
+        self.flush_every = max(int(getattr(cfg, "flush_interval_steps", 0) or 0)
+                               or int(flush_every), 1)
+        self._run_info_fn = run_info_fn
+        self.metrics = MetricsRegistry()
+        self.spans = SpanRecorder(
+            enabled=self.enabled,
+            max_buffered=int(getattr(cfg, "max_buffered_spans", 4096) or 4096))
+        self.run_dir: Optional[str] = None
+        self.sink = JsonlSink(None)
+        if self.enabled:
+            base = getattr(cfg, "output_path", None) or "./telemetry_logs"
+            self.run_dir = os.path.join(base, getattr(cfg, "job_name", "run"))
+            self.sink = JsonlSink(os.path.join(self.run_dir, TELEMETRY_FILE),
+                                  rank=self.rank)
+        self._subscribers: List[Callable] = []
+        self._header_written = False
+        self.static_price: Optional[Dict] = None
+        self._step_t0: Optional[float] = None
+        self._window_steps = 0
+        self._last_step = 0
+        self._phase_totals: Dict[str, Histogram] = {}
+        self._step_hist_total = Histogram()
+
+    # -- bus -----------------------------------------------------------
+    def subscribe(self, fn: Callable) -> None:
+        """Register a monitor-event consumer (``fn(event_list)``);
+        MonitorMaster.write_events is the canonical subscriber."""
+        self._subscribers.append(fn)
+
+    @property
+    def has_consumers(self) -> bool:
+        """Someone will actually see a published event batch: a subscriber
+        (MonitorMaster, rank-0 only) or the live JSONL sink (rank-gated).
+        On non-zero ranks with telemetry enabled this is False — the engine
+        must not pay for the MoE diagnostic forward to feed nobody."""
+        return bool(self._subscribers) or (self.enabled and self.sink.active)
+
+    def publish_events(self, events: List[Tuple], step: Optional[int] = None) -> None:
+        """Fan one ``(tag, value, step)`` event batch out to every
+        subscriber AND (when enabled) the JSONL log."""
+        if not events:
+            return
+        for fn in self._subscribers:
+            try:
+                fn(events)
+            except Exception as e:  # noqa: BLE001 — a sink must not kill a step
+                logger.warning(f"telemetry subscriber {fn} failed: {e}")
+        if self.enabled:
+            self.sink.write({"event": "monitor", "step": step,
+                             "events": [[t, float(v), int(s)] for t, v, s in events]})
+
+    # -- run header ----------------------------------------------------
+    @property
+    def wants_run_header(self) -> bool:
+        return self.enabled and not self._header_written and self.sink.active
+
+    def write_run_header(self, run_info: Optional[Dict] = None,
+                         static_price: Optional[Dict] = None) -> None:
+        if not self.enabled or self._header_written:
+            return
+        self._header_written = True
+        if static_price is not None:
+            self.static_price = static_price
+        info = dict(run_info or {})
+        if not info and self._run_info_fn is not None:
+            try:
+                info = self._run_info_fn()
+            except Exception as e:  # noqa: BLE001
+                info = {"run_info_error": str(e)}
+        self.sink.write({"event": "run_start",
+                         "schema": TELEMETRY_SCHEMA_VERSION,
+                         "run": info,
+                         "static_price": self.static_price}, flush=True)
+
+    # -- spans / steps -------------------------------------------------
+    def span(self, name: str):
+        return self.spans.span(name)
+
+    @property
+    def last_span(self) -> Optional[str]:
+        return self.spans.last_span
+
+    def begin_step(self, step: int) -> None:
+        if not self.enabled:
+            return
+        self._step_t0 = time.perf_counter()
+
+    def end_step(self, step: int, n_steps: int = 1) -> None:
+        """Close the per-step record; at ``flush_every`` cadence emit the
+        window's spans + aggregates + drift. ``n_steps`` > 1 for a fused
+        ``train_batches`` stack (one dispatch, n optimizer steps — the
+        per-step time is the stack time ÷ n)."""
+        if not self.enabled or self._step_t0 is None:
+            return
+        wall = time.perf_counter() - self._step_t0
+        self._step_t0 = None
+        per_step = wall / max(n_steps, 1)
+        h = self.spans._window_hist.setdefault("step", Histogram())
+        for _ in range(n_steps):  # fused stacks: n per-step samples at stack/n each
+            h.record(per_step)
+            self._step_hist_total.record(per_step)
+        self._window_steps += n_steps
+        self._last_step = step
+        if step % self.flush_every == 0 or self._window_steps >= self.flush_every:
+            self.flush_window(step)
+
+    def flush_window(self, step: int) -> None:
+        if not self.enabled:
+            return
+        events, hists, dropped = self.spans.drain()
+        self._window_steps = 0
+        for name, hist in hists.items():
+            total = self._phase_totals.get(name)
+            if total is None:
+                self._phase_totals[name] = hist
+            else:
+                total.merge(hist)
+        if not self.sink.active:
+            return
+        if events and getattr(self.cfg, "span_events", True):
+            self.sink.write({"event": "spans", "step": step, "dropped": dropped,
+                             "spans": events})
+        if hists:  # an empty window (explicit flush, no steps) emits nothing
+            window = {"event": "step_window", "step": step,
+                      "phases": {name: h.snapshot() for name, h in hists.items()}}
+            snap = self.metrics.snapshot()
+            if snap:
+                window["metrics"] = snap
+            self.sink.write(window)
+            step_hist = hists.get("step")
+            med = step_hist.percentile(50) if step_hist else None
+            measured = measured_memory()
+            self.sink.write({"event": "drift", "step": step,
+                             "window_steps": step_hist.count if step_hist else 0,
+                             "median_step_s": med,
+                             "predicted": self.static_price,
+                             "measured": measured,
+                             "ratios": drift_ratios(self.static_price, med, measured)})
+        self.sink.flush()
+
+    # -- raw events ----------------------------------------------------
+    def emit(self, kind: str, **fields) -> None:
+        """Write one structured event (checkpoint publish, xla trace
+        window, resilience fallback, ...). No-op when disabled."""
+        if not self.enabled:
+            return
+        rec = {"event": kind}
+        rec.update(fields)
+        self.sink.write(rec, flush=True)
+
+    # -- summaries -----------------------------------------------------
+    def drift_summary(self) -> Dict[str, Any]:
+        """Cumulative (whole-run) phase medians + drift ratios — what
+        perf_ladder stamps next to a rung's lint/cost evidence."""
+        if self._window_steps:
+            # flush the pending partial window under its real last step —
+            # a step-0 label would misorder consumers keying windows by step
+            self.flush_window(step=self._last_step)
+        phases = {name: round((h.percentile(50) or 0.0) * 1e3, 3)
+                  for name, h in self._phase_totals.items()}
+        med = self._step_hist_total.percentile(50)
+        out: Dict[str, Any] = {"steps": self._step_hist_total.count,
+                               "phase_p50_ms": phases}
+        if med is not None:
+            out["median_step_s"] = med
+        out["ratios"] = drift_ratios(self.static_price, med, measured_memory())
+        if self.static_price:
+            out["predicted"] = {k: self.static_price[k]
+                                for k in ("flops_proxy", "peak_bytes",
+                                          "peak_transient_bytes", "bytes_moved")
+                                if k in self.static_price}
+        return out
+
+    def close(self) -> None:
+        self.sink.close()

@@ -1,0 +1,104 @@
+"""Schema-versioned JSONL event sink.
+
+One line per event, appended to ``telemetry.jsonl`` under the run
+directory. Writes are rank-0 only (the caller passes its rank) and each
+line lands as ONE ``write()`` of a complete ``...\\n`` record on a file
+opened in append mode — on POSIX that makes concurrent writers (a
+supervisor + a child sharing a run dir by mistake) interleave at line
+granularity instead of corrupting each other mid-record. Flushing is
+batched: the engine flushes at window cadence, not per event.
+
+Schema evolution contract: every record carries no version field of its
+own — the ``run_start`` header's ``schema`` covers the whole file, and
+``read_events`` tolerates (skips) lines it cannot parse so a partially
+written tail never kills ``tools/trace_report.py``.
+"""
+
+import json
+import os
+import time
+from typing import Dict, Iterator, List, Optional
+
+__all__ = ["TELEMETRY_SCHEMA_VERSION", "JsonlSink", "read_events", "iter_events"]
+
+#: bump when an event's FIELD SEMANTICS change (adding fields is free —
+#: readers must ignore unknown fields)
+TELEMETRY_SCHEMA_VERSION = 1
+
+
+class JsonlSink:
+    """Lazy append-only JSONL writer; a no-op off rank 0 or when closed."""
+
+    def __init__(self, path: Optional[str], rank: int = 0):
+        self.path = path
+        self.rank = rank
+        self._fh = None
+        self._closed = False
+
+    @property
+    def active(self) -> bool:
+        return self.path is not None and self.rank == 0 and not self._closed
+
+    def write(self, record: Dict, flush: bool = False) -> None:
+        if not self.active:
+            return
+        if self._fh is None:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            self._fh = open(self.path, "a", buffering=1 << 16)
+        record.setdefault("t", time.time())
+        try:
+            line = json.dumps(record, separators=(",", ":"), default=_coerce)
+        except (TypeError, ValueError):
+            # a bad payload must never kill a training step
+            line = json.dumps({"event": "encode_error",
+                               "kind": str(record.get("event")), "t": record["t"]})
+        self._fh.write(line + "\n")
+        if flush:
+            self._fh.flush()
+
+    def flush(self) -> None:
+        if self._fh is not None:
+            self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.flush()
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+        self._closed = True
+
+
+def _coerce(obj):
+    """Best-effort JSON coercion for numpy / jax arrays and scalars in
+    payloads (tolist covers both; item as the scalar fallback)."""
+    for attr in ("tolist", "item"):
+        fn = getattr(obj, attr, None)
+        if callable(fn):
+            try:
+                return fn()
+            except Exception:  # noqa: BLE001 — try the next / fall through
+                continue
+    return str(obj)
+
+
+def iter_events(path: str) -> Iterator[Dict]:
+    """Yield parsed events, skipping corrupt/partial lines (a crashed
+    writer leaves at most one torn tail line — never lose the rest)."""
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                yield rec
+
+
+def read_events(path: str) -> List[Dict]:
+    return list(iter_events(path))

@@ -57,3 +57,88 @@ def test_engine_writes_monitor_events(tmp_path):
     assert csvs, "engine never wrote monitor events"
     names = {p.name for p in csvs}
     assert any("loss" in n for n in names) and any("lr" in n for n in names)
+
+
+def test_csv_monitor_caches_open_files(tmp_path, monkeypatch):
+    """N events on one tag = ONE open() for the whole monitor lifetime
+    (the satellite fix: the original reopened + getsize'd per event)."""
+    import builtins
+
+    cfg = DeepSpeedMonitorConfig(csv_monitor={"enabled": True,
+                                              "output_path": str(tmp_path),
+                                              "job_name": "cached"})
+    mon = csvMonitor(cfg.csv_monitor)
+    real_open = builtins.open
+    opens = []
+
+    def counting_open(path, *a, **k):
+        if str(path).endswith(".csv"):
+            opens.append(str(path))
+        return real_open(path, *a, **k)
+
+    monkeypatch.setattr(builtins, "open", counting_open)
+    for batch in range(3):
+        mon.write_events([("Train/loss", float(batch + i), batch * 4 + i)
+                          for i in range(4)])
+    assert len(opens) == 1, f"expected 1 open for 12 events, saw {len(opens)}"
+    loss_file = next(p for p in (tmp_path / "cached").rglob("*.csv"))
+    rows = list(csv.reader(open(loss_file)))
+    assert rows[0] == ["step", "Train/loss"] and len(rows) == 13
+    # rows are durable after each batch flush without close()
+    mon.close()
+    assert mon._files == {}
+    mon.write_events([("Train/loss", 9.0, 99)])  # reopens cleanly after close
+    assert list(csv.reader(open(loss_file)))[-1] == ["99", "9.0"]
+
+
+def test_moe_gate_events_edge_cases():
+    from deepspeed_tpu.monitor.monitor import moe_gate_events
+
+    # empty stats dict: no events, no crash
+    assert moe_gate_events({}, step=0) == []
+
+    # zero routed tokens: drop_fraction must NOT emit (no denominator);
+    # capacity_utilization still reports the dead padding
+    stats = {"layer0": {"exp_counts": [0, 0], "kept_counts": [0, 0],
+                        "routed_counts": [0, 0], "capacity_slots": 4}}
+    events = dict((t, v) for t, v, _ in moe_gate_events(stats, step=1))
+    assert "MoE/layer0/drop_fraction" not in events
+    assert "MoE/layer0/load_cv" not in events  # mean 0: undefined balance
+    assert events["MoE/layer0/capacity_utilization"] == 0.0
+
+    # missing routed_counts (dense top-2 gate): no drop_fraction, the
+    # load/capacity series still emit
+    stats = {"l": {"exp_counts": [6, 2], "kept_counts": [4, 2],
+                   "capacity_slots": 4}}
+    events = dict((t, v) for t, v, _ in moe_gate_events(stats, step=2))
+    assert "MoE/l/drop_fraction" not in events
+    assert events["MoE/l/expert0_load"] == 0.75
+    assert events["MoE/l/capacity_utilization"] == 0.75
+    assert events["MoE/l/load_cv"] > 0
+
+    # routed present and positive: drop fraction = 1 - kept/routed
+    stats = {"l": {"exp_counts": [8], "kept_counts": [6],
+                   "routed_counts": [8], "capacity_slots": 8}}
+    events = dict((t, v) for t, v, _ in moe_gate_events(stats, step=3))
+    assert events["MoE/l/drop_fraction"] == 0.25
+
+
+def test_monitor_master_rank_gating(tmp_path, monkeypatch):
+    """Off rank 0 the master builds NO sinks and write_events is a no-op
+    (reference monitor.py rank==0 checks)."""
+    import deepspeed_tpu.monitor.monitor as mm
+
+    monkeypatch.setattr(mm, "_rank", lambda: 1)
+    cfg = DeepSpeedMonitorConfig(csv_monitor={"enabled": True,
+                                              "output_path": str(tmp_path),
+                                              "job_name": "rank1"})
+    master = MonitorMaster(cfg)
+    assert master.csv_monitor is None and not master.enabled
+    master.write_events([("Train/loss", 1.0, 1)])
+    assert not list((tmp_path / "rank1").rglob("*.csv"))
+    # back on rank 0 the same config builds the sink and writes
+    monkeypatch.setattr(mm, "_rank", lambda: 0)
+    master0 = MonitorMaster(cfg)
+    assert master0.enabled
+    master0.write_events([("Train/loss", 1.0, 1)])
+    assert list((tmp_path / "rank1").rglob("*.csv"))

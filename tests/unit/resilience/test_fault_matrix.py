@@ -314,3 +314,36 @@ def test_engine_step_touches_heartbeat(tmp_path, monkeypatch):
     os.utime(hb, (0, 0))
     engine.train_batch(batch)
     assert os.path.getmtime(hb) > 0.0  # refreshed by _post_step
+
+
+def test_heartbeat_payload_roundtrip(tmp_path):
+    """The heartbeat file carries a JSON payload (pid + clocks + caller
+    fields) readable via read_heartbeat — progress, not just liveness."""
+    from deepspeed_tpu.elasticity.elastic_agent import read_heartbeat, touch_heartbeat
+    hb = str(tmp_path / "hb")
+    assert read_heartbeat(hb) is None  # missing file: no crash
+    touch_heartbeat(hb, payload={"global_step": 7, "last_span": "dispatch"})
+    data = read_heartbeat(hb)
+    assert data["global_step"] == 7 and data["last_span"] == "dispatch"
+    assert data["pid"] == os.getpid() and data["monotonic"] > 0
+    # pre-payload / torn writers degrade to None, never crash a supervisor
+    with open(hb, "w") as fh:
+        fh.write('{"torn')
+    assert read_heartbeat(hb) is None
+    # unserializable caller fields degrade to the base payload
+    touch_heartbeat(hb, payload={"bad": object()})
+    assert read_heartbeat(hb)["pid"] == os.getpid()
+
+
+def test_engine_heartbeat_reports_progress(tmp_path, monkeypatch):
+    """The engine's per-step heartbeat stamps global_step + the last
+    telemetry span, so a supervisor reports how far a child got."""
+    from deepspeed_tpu.elasticity.elastic_agent import read_heartbeat
+    hb = str(tmp_path / "hb")
+    monkeypatch.setenv("DS_ELASTIC_HEARTBEAT_FILE", hb)
+    engine, batch = fault_bench._tiny_engine(
+        ds_extra={"resilience": {"heartbeat_interval": 0.0}})
+    engine.train_batch(batch)
+    engine.train_batch(batch)
+    data = read_heartbeat(hb)
+    assert data["global_step"] == 2

@@ -221,7 +221,8 @@ _TRAIN_CHILD = textwrap.dedent("""
             f.write(json.dumps({{"step": step, "loss": loss.hex()}}) + chr(10))
         eng.save_checkpoint({ckpt!r})
         from deepspeed_tpu.elasticity.elastic_agent import touch_heartbeat
-        touch_heartbeat()
+        touch_heartbeat(payload={{"global_step": eng.global_steps,
+                                  "last_span": "checkpoint"}})
     print("CHILD_DONE", eng.global_steps)
 """)
 
@@ -257,11 +258,15 @@ def scenario_sigkill_resume(workdir, kill_at=2, total=4):
                                        {"DS_FAULT_SPEC": f"step=sigkill@{kill_at}"})
     ref_rc, _, ref_losses = run_supervised(workdir, "reference", total, {})
     bit_exact = (losses == ref_losses and len(ref_losses) == total)
+    # how far each attempt got, from the heartbeat payload the agent
+    # snapshots at attempt end (not just that the child was alive)
+    progress = [h.get("last_heartbeat") for h in agent.history]
     return _row("sigkill_midrun_resume",
                 f"agent restart + bit-exact {total}-step curve",
                 f"rc={rc} restarts={agent.restart_count} steps={sorted(losses)} "
-                f"bit_exact={bit_exact}",
-                rc == 0 and ref_rc == 0 and agent.restart_count == 1 and bit_exact)
+                f"bit_exact={bit_exact} progress={progress}",
+                rc == 0 and ref_rc == 0 and agent.restart_count == 1 and bit_exact,
+                attempt_progress=progress)
 
 
 SCENARIOS = {

@@ -14,6 +14,10 @@ Env: LADDER=760m_mb4,760m_mb8,xl_offload_mb1  (comma list; default 760m)
      LADDER_RETRIES=3      (attempts per rung on transient tunnel failures —
                             the remote-compile-helper HTTP 500 class; backoff
                             base LADDER_RETRY_BASE=15s, heartbeat-aware)
+     LADDER_TELEMETRY=1    (graft-trace evidence: per-phase span medians +
+                            drift ratios on every rung row; 0 opts out.
+                            JSONLs land under LADDER_TELEMETRY_DIR, default
+                            /tmp/ds_tpu_ladder_telemetry/<tag>)
 
 Transient-failure policy (resilience/retry.py): a rung that dies with a
 compile-helper 500 / connection flake is retried with backoff+jitter; the
@@ -70,6 +74,15 @@ def run_rung(tag, model_name, mb, offload=False, steps=None, seq=None,
         if fused_xent:
             overrides["fused_head_loss_chunk"] = 1024
     overrides.update(cfg_overrides or {})  # rung-specific model-config knobs (MoE, ...)
+    if os.environ.get("LADDER_TELEMETRY", "1") == "1":
+        # graft-trace evidence: span timeline + drift ratios for the rung's
+        # own steps (run header carries the static price). ≤2% overhead by
+        # the tier-1 gate; LADDER_TELEMETRY=0 opts out for A/B paranoia.
+        ds_overrides.setdefault("telemetry", {
+            "enabled": True,
+            "output_path": os.environ.get("LADDER_TELEMETRY_DIR",
+                                          "/tmp/ds_tpu_ladder_telemetry"),
+            "job_name": tag})
     engine, batch, n_params, cfg = build_engine(
         model_name, mb, seq or SEQ, ds_overrides=ds_overrides,
         pipe_stages=pipe_stages, **overrides)
@@ -87,6 +100,7 @@ def run_rung(tag, model_name, mb, offload=False, steps=None, seq=None,
            **moe_route_evidence(cfg),
            **lint_evidence(engine, batch, programs),
            **cost_evidence(engine, batch, programs),
+           **telemetry_evidence(engine),
            **(retry_evidence_extra or {}),
            **(retry_evidence or {}))
 
@@ -145,6 +159,25 @@ def moe_route_evidence(cfg):
     except Exception as e:  # evidence must never kill a rung
         return {"moe_route": f"error: {type(e).__name__}: {str(e)[:120]}",
                 "moe_route_source": "error"}
+
+
+def telemetry_evidence(engine):
+    """graft-trace evidence for the rung: per-phase span medians (ms) and
+    predicted-vs-measured drift ratios from the rung's OWN measured steps
+    (runtime/telemetry drift_summary — achieved TFLOPS from flops_proxy ÷
+    median step time, memory-peak ratios where the backend reports them).
+    A banked TFLOPS row thereby carries its cost-model error next to the
+    lint/cost evidence. Evidence must never kill a rung; LADDER_TELEMETRY=0
+    opts the whole subsystem out (the engine then runs telemetry-off)."""
+    if os.environ.get("LADDER_TELEMETRY", "1") != "1":
+        return {}
+    try:
+        tel = getattr(engine, "telemetry", None)
+        if tel is None or not tel.enabled:
+            return {}
+        return {"telemetry": tel.drift_summary()}
+    except Exception as e:  # evidence must never kill a rung
+        return {"telemetry_error": f"{type(e).__name__}: {str(e)[:120]}"}
 
 
 def lint_evidence(engine, batch, programs=None):

@@ -60,10 +60,17 @@ def main():
     run(2 * NEW)  # compile both programs
     compile_s = time.time() - t0
 
+    # latency distributions ride the telemetry Histogram (fixed buckets,
+    # mergeable) — the same type the continuous-batching latency-under-load
+    # successor (ROADMAP 1) will aggregate across request streams
+    from deepspeed_tpu.runtime.telemetry import Histogram
+    lat_short, lat_long = Histogram(), Histogram()
     short, long_ = [], []
     for r in range(ROUNDS):
         short.append(run(NEW))
+        lat_short.record(short[-1])
         long_.append(run(2 * NEW))
+        lat_long.record(long_[-1])
     d_short, d_long = float(np.median(short)), float(np.median(long_))
     # prefill cancels in the difference; decode rate from the extra NEW tokens
     decode_dt = max(d_long - d_short, 1e-9)
@@ -76,6 +83,10 @@ def main():
         "e2e_tokens_per_s_incl_prefill": round(e2e_tok_s, 1),
         "round_s_short": [round(t, 3) for t in short],
         "round_s_long": [round(t, 3) for t in long_],
+        "latency_short": {k: round(v, 4) for k, v in lat_short.snapshot().items()
+                          if k in ("p50", "p90", "p99", "min", "max", "mean")},
+        "latency_long": {k: round(v, 4) for k, v in lat_long.snapshot().items()
+                         if k in ("p50", "p90", "p99", "min", "max", "mean")},
         "compile_s": round(compile_s, 1),
         "backend": jax.default_backend(),
     }), flush=True)
