@@ -462,6 +462,78 @@ def pipe_1f1b_step() -> ProgramInfo:
         {"schedule": "1f1b", "activation_budget_mb": PIPE_1F1B_BUDGET_MB})
 
 
+#: the committed activation budget (MiB) for the graft-serve decode tick
+#: below (16 slots x 512 positions, tp=2, tiny GPT-2). Measured static
+#: transient on the pinned container: 8.41 MiB with the committed
+#: ``scatter`` KV write (4 per-slot scatters, O(slots) bytes each);
+#: committed at 9.0 MiB (~7% headroom). The ``dense`` masked-rebuild
+#: write measures 10.5 MiB — so ``DS_SERVE_KV_WRITE=dense`` fails R010
+#: under this budget, the DS_MOE_ROUTE-pattern seeded regression for a
+#: forced/leaked serving knob.
+SERVE_DECODE_BUDGET_MB = 9.0
+
+
+@scenario("serve_decode_step")
+def serve_decode_step() -> ProgramInfo:
+    """The graft-serve fixed-shape decode tick (inference/serving): one
+    token per slot against the per-slot ragged cache, on a tensor=2
+    serving mesh so the program carries real post-SPMD collectives. The
+    traced program IS the served one — same ``make_apply_fn`` +
+    ``build_decode_step`` the scheduler jits — so R009 pins the tp
+    collective signature, R010 gates the per-tick transient against
+    :data:`SERVE_DECODE_BUDGET_MB`, and R013 ratchets both against the
+    committed cost baseline. The KV write strategy resolves through
+    env/config exactly like a serve run (``resolve_kv_write``), which is
+    what gives ``DS_SERVE_KV_WRITE=dense`` its teeth."""
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.serving import make_slot_cache, resolve_intended_kv_write
+    from deepspeed_tpu.inference.serving.programs import (build_decode_step,
+                                                          make_apply_fn)
+    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+    from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
+
+    if len(jax.devices()) < 2:
+        raise ScenarioSkipped("serve_decode_step needs >=2 devices for the "
+                              "tensor=2 serving mesh")
+    set_topology(None)
+    try:
+        slots = 16
+        cfg = get_gpt2_config("test", n_layer=2, n_positions=512)
+        topo = MeshTopology(tensor=2, data=1, fsdp=1, devices=jax.devices()[:2])
+        engine = InferenceEngine(GPT2LMHeadModel(cfg),
+                                 DeepSpeedInferenceConfig(), topology=topo)
+        cache = make_slot_cache(engine.module, slots)
+        decode = build_decode_step(make_apply_fn(engine.module, engine._mparams),
+                                   do_sample=False, temperature=1.0, top_k=0,
+                                   top_p=1.0)
+        tokens = jnp.zeros((slots,), jnp.int32)
+        jaxpr = jax.make_jaxpr(decode)(engine.params, cache, tokens)
+        return ProgramInfo(
+            name="serve_decode_step", jaxpr=jaxpr, kind="serve_decode",
+            lower=lambda: jax.jit(decode).lower(engine.params, cache, tokens),
+            metadata={
+                "serve_slots": slots,
+                # the committed intent, env layer skipped — a forced env
+                # override drifts the program but never this declaration
+                "serve_kv_write": resolve_intended_kv_write(),
+                "activation_budget_bytes": int(SERVE_DECODE_BUDGET_MB * 2**20),
+                "collective_signature": [
+                    # tp=2 row-parallel projections: attention out-proj +
+                    # MLP out-proj per block, plus the tied LM head —
+                    # 2*n_layer + 1 all-reduces per decode tick
+                    {"layer": "compiled", "kind": "all_reduce", "count": 5,
+                     "note": "2 all-reduces per block + 1 for the tied "
+                             "LM head on the tp=2 serving mesh"},
+                    {"layer": "compiled", "kind": "all_gather", "max_count": 2,
+                     "note": "at most the two embedding-table gathers — "
+                             "more would mean GSPMD re-gathers the KV pool "
+                             "per tick"}]})
+    finally:
+        set_topology(None)
+
+
 @scenario("composition_3d_ep_zeropp")
 def composition_3d_ep_zeropp() -> ProgramInfo:
     """ROADMAP item 5's never-executed full composition: pipe x expert x

@@ -90,8 +90,16 @@ def sample_logits(logits, rng, do_sample: bool, temperature: float, top_k: int, 
         sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
-        # smallest set with cumulative prob >= top_p; find threshold logit
+        # smallest set with cumulative prob >= top_p; find threshold logit.
+        # Pinned edge cases (graft-serve satellite): an EMPTY nucleus —
+        # top_p <= 0, or a low temperature concentrating cum[0] ~ 1.0 above
+        # top_p — keeps cutoff_idx at 0, i.e. falls back to the single
+        # argmax token (never a NaN renormalization over an empty support);
+        # the clip handles the opposite edge, where rounding keeps cum
+        # strictly below top_p forever and the unclipped index would walk
+        # off the vocab axis.
         cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        cutoff_idx = jnp.minimum(cutoff_idx, logits.shape[-1] - 1)
         cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(rng, logits, axis=-1)

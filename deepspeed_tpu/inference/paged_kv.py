@@ -11,7 +11,7 @@ pool, and ``gather`` materializes a dense [b, L] view for attention via one
 TOKENS IN FLIGHT, not batch × max_len.
 """
 
-from typing import Dict, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -44,9 +44,11 @@ class PagedKVCache:
             # range, so outlier K/V magnitudes cannot overflow to inf
             self.k_scale = jnp.zeros(sshape, jnp.bfloat16)
             self.v_scale = jnp.zeros(sshape, jnp.bfloat16)
-        self._free: List[int] = list(range(num_pages - 1, -1, -1))
-        self._tables: Dict[int, List[int]] = {}   # seq id -> page list
-        self._lengths: Dict[int, int] = {}        # seq id -> tokens used
+        # allocator bookkeeping delegates to the shared BlockPool (the same
+        # accounting the serving scheduler's admission control runs on, so
+        # its counters — allocs/frees/peak/fragmentation — are one code path)
+        from deepspeed_tpu.inference.serving.blocks import BlockPool
+        self.pool = BlockPool(num_blocks=num_pages, block_size=page_size)
 
         # donated in-place page write: O(page) update, no pool copy
         def write(pool, vals, layer, page, in_page):
@@ -72,35 +74,33 @@ class PagedKVCache:
     # ------------------------------------------------------------------
     @property
     def free_pages(self) -> int:
-        return len(self._free)
+        return self.pool.free_blocks
 
     def allocate(self, seq_id: int) -> None:
-        assert seq_id not in self._tables, f"sequence {seq_id} already allocated"
-        self._tables[seq_id] = []
-        self._lengths[seq_id] = 0
+        self.pool.allocate(seq_id)
 
     def free(self, seq_id: int) -> None:
         """Return a sequence's pages to the pool (reference frees by resetting
         the workspace offset; pages make it per-sequence)."""
-        for p in self._tables.pop(seq_id):
-            self._free.append(p)
-        del self._lengths[seq_id]
+        self.pool.free(seq_id)
 
     def _ensure_capacity(self, seq_id: int, new_tokens: int) -> None:
-        need = self._lengths[seq_id] + new_tokens
-        have = len(self._tables[seq_id]) * self.page_size
-        while have < need:
-            if not self._free:
-                raise RuntimeError(f"KV page pool exhausted ({self.num_pages} pages of "
-                                   f"{self.page_size}); free finished sequences first")
-            self._tables[seq_id].append(self._free.pop())
-            have += self.page_size
+        try:
+            self.pool.ensure(seq_id, new_tokens)
+        except RuntimeError:
+            raise RuntimeError(f"KV page pool exhausted ({self.num_pages} pages of "
+                               f"{self.page_size}); free finished sequences first")
 
     def seq_len(self, seq_id: int) -> int:
-        return self._lengths[seq_id]
+        return self.pool.seq_len(seq_id)
 
     def block_table(self, seq_id: int) -> List[int]:
-        return list(self._tables[seq_id])
+        return self.pool.block_table(seq_id)
+
+    def counters(self) -> dict:
+        """Allocator accounting (allocs/frees/peak/fragmentation) — the
+        admission-control evidence surface, shared with BlockPool."""
+        return self.pool.counters()
 
     # ------------------------------------------------------------------
     # device ops
@@ -110,8 +110,8 @@ class PagedKVCache:
         t = k.shape[0]
         if layer == 0:
             self._ensure_capacity(seq_id, t)
-        start = self._lengths[seq_id]
-        table = self._tables[seq_id]
+        start = self.pool.seq_len(seq_id)
+        table = self.pool.block_table(seq_id)
         # split the token run across page boundaries; each write is a jitted
         # donated dynamic_update_slice — O(page), never an O(pool) copy
         if self.quantize:
@@ -131,20 +131,21 @@ class PagedKVCache:
                 self.v_scale = self._write(self.v_scale, v_s[off:off + n], *args)
             off += n
         if layer == self.num_layers - 1:
-            self._lengths[seq_id] += t
+            # capacity was ensured at layer 0; this only advances the length
+            self.pool.advance(seq_id, t)
 
     def gather(self, seq_ids: List[int], layer: int = 0,
                pad_to: Optional[int] = None) -> Tuple[jax.Array, jax.Array, jax.Array]:
         """Dense [b, L, heads, dim] K/V views + [b] true lengths. ``pad_to``
         buckets L so the consumer's attention program doesn't recompile per
         batch composition."""
-        max_len = max(self._lengths[s] for s in seq_ids)
+        max_len = max(self.pool.seq_len(s) for s in seq_ids)
         L = pad_to or max_len
         assert L >= max_len
         pages_per = (L + self.page_size - 1) // self.page_size
         table = np.zeros((len(seq_ids), pages_per), np.int32)
         for i, s in enumerate(seq_ids):
-            for j, p in enumerate(self._tables[s][:pages_per]):
+            for j, p in enumerate(self.pool.block_table(s)[:pages_per]):
                 table[i, j] = p
         # one gather = the block-table lookup: [b, pages_per, page, h, d]
         tbl = jnp.asarray(table)
@@ -156,9 +157,8 @@ class PagedKVCache:
         b = len(seq_ids)
         k = k.reshape(b, pages_per * self.page_size, *k.shape[3:])[:, :L]
         v = v.reshape(b, pages_per * self.page_size, *v.shape[3:])[:, :L]
-        lengths = jnp.asarray([self._lengths[s] for s in seq_ids], jnp.int32)
+        lengths = jnp.asarray([self.pool.seq_len(s) for s in seq_ids], jnp.int32)
         return k, v, lengths
 
     def utilization(self) -> float:
-        used = self.num_pages - len(self._free)
-        return used / self.num_pages
+        return self.pool.utilization()

@@ -1,0 +1,25 @@
+"""graft-serve: continuous in-flight batching with chunked prefill and
+speculative decoding (ISSUE 14 / ROADMAP item 1)."""
+
+from deepspeed_tpu.inference.serving.blocks import BlockPool
+from deepspeed_tpu.inference.serving.config import (ENV_KV_WRITE, ServingConfig,
+                                                    SpeculationConfig,
+                                                    resolve_intended_kv_write,
+                                                    resolve_kv_write,
+                                                    set_default_kv_write)
+from deepspeed_tpu.inference.serving.programs import (make_slot_cache,
+                                                      serve_programs,
+                                                      slot_capacity,
+                                                      stamp_lengths)
+from deepspeed_tpu.inference.serving.queue import RequestQueue
+from deepspeed_tpu.inference.serving.request import (ACTIVE, FINISHED, PREFILL,
+                                                     QUEUED, REFUSED, Request)
+from deepspeed_tpu.inference.serving.scheduler import ContinuousBatchingScheduler
+
+__all__ = [
+    "ACTIVE", "FINISHED", "PREFILL", "QUEUED", "REFUSED",
+    "BlockPool", "ContinuousBatchingScheduler", "ENV_KV_WRITE", "Request",
+    "RequestQueue", "ServingConfig", "SpeculationConfig", "make_slot_cache",
+    "resolve_intended_kv_write", "resolve_kv_write", "serve_programs",
+    "set_default_kv_write", "slot_capacity", "stamp_lengths",
+]

@@ -1,0 +1,123 @@
+"""graft-serve configuration: the ``"serving"`` config block.
+
+Continuous in-flight batching (ISSUE 14 / ROADMAP item 1) is driven by a
+small set of knobs with the same layered resolution discipline as the MoE
+route and the attention geometry: explicit > env > config > default, with
+the env layer (``DS_SERVE_KV_WRITE``) existing so the graft-audit
+``serve_decode_step`` scenario can catch a forced/leaked serving knob the
+exact way ``DS_MOE_ROUTE=dense`` is caught — the traced program drifts,
+the committed budget/signature does not, lint exits 1.
+"""
+
+import os
+import threading
+from typing import Optional, Tuple
+
+from pydantic import Field, model_validator
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+#: env override for the per-slot KV write strategy (the DS_MOE_ROUTE
+#: pattern: drifts the traced program, never the committed intent)
+ENV_KV_WRITE = "DS_SERVE_KV_WRITE"
+
+KV_WRITE_CHOICES = ("scatter", "dense")
+DEFAULT_KV_WRITE = "scatter"
+
+_lock = threading.Lock()
+_config_kv_write: Optional[str] = None
+
+
+def _check(value: Optional[str], choices, what: str) -> Optional[str]:
+    if value is not None and value not in choices:
+        raise ValueError(f"unknown {what} {value!r}; choices: {list(choices)}")
+    return value
+
+
+def set_default_kv_write(mode: Optional[str]) -> None:
+    """Install the scheduler-level default KV write mode (None clears)."""
+    global _config_kv_write
+    with _lock:
+        _config_kv_write = _check(mode, KV_WRITE_CHOICES, "kv_write")
+
+
+def resolve_kv_write(mode: Optional[str] = None) -> Tuple[str, str]:
+    """Resolve ``(mode, source)`` for the per-slot KV cache write.
+
+    ``scatter`` (default) appends each slot's new tokens with an O(slots x
+    tokens) scatter whose out-of-bounds (parked-slot) updates drop;
+    ``dense`` rebuilds the pool through a masked one-hot einsum — a
+    per-layer O(slots x n_positions) transient kept as the seeded R010
+    regression. ``source`` names the deciding layer, perf-ladder evidence
+    convention (``explicit`` > ``env`` > ``config`` > ``default``)."""
+    src, m = "default", DEFAULT_KV_WRITE
+    if _config_kv_write is not None:
+        m, src = _config_kv_write, "config"
+    env = os.environ.get(ENV_KV_WRITE, "").strip() or None
+    if env is not None:
+        m, src = _check(env, KV_WRITE_CHOICES, f"kv_write (from {ENV_KV_WRITE})"), "env"
+    if mode is not None:
+        m, src = _check(mode, KV_WRITE_CHOICES, "kv_write"), "explicit"
+    return m, src
+
+
+def resolve_intended_kv_write(mode: Optional[str] = None) -> str:
+    """The write mode the *committed configuration* intends, skipping the
+    env layer — what the ``serve_decode_step`` scenario's budget is priced
+    for (mirror of ``moe.routing.resolve_intended_route``)."""
+    if mode is not None:
+        return _check(mode, KV_WRITE_CHOICES, "kv_write")
+    if _config_kv_write is not None:
+        return _config_kv_write
+    return DEFAULT_KV_WRITE
+
+
+class SpeculationConfig(DeepSpeedConfigModel):
+    """Speculative decoding knobs. The drafter is the compression/KD
+    student (``compression/compress.py`` ``student_initialization`` seeds
+    it from the target's layers); verification is batched on the target
+    and lossless under greedy decoding: a rejected draft position is
+    replaced by the target's own argmax token."""
+
+    enabled: bool = False
+    #: draft tokens per speculation round (the verify block is k+1 wide:
+    #: the last accepted token rides along so the target also produces
+    #: the bonus token when every draft survives)
+    k: int = Field(4, ge=1, le=16)
+
+
+class ServingConfig(DeepSpeedConfigModel):
+    """The ``"serving"`` block (scheduler knobs; README "Serving")."""
+
+    #: decode slots (in-flight request capacity); bucketed to the next
+    #: power of two so alternating deployments reuse compiled programs
+    slots: int = Field(8, ge=1)
+    #: KV block granularity for admission control (tokens per block)
+    page_size: int = Field(16, ge=1)
+    #: total KV token budget backing admission; None = slots x model
+    #: context length (admission then only enforces per-request fit)
+    kv_pool_tokens: Optional[int] = None
+    #: chunked prefill: prompt tokens consumed per prefill tick, so a 4k
+    #: prompt cannot stall in-flight decodes for its whole prefill
+    prefill_chunk: int = Field(16, ge=1)
+    #: decode ticks guaranteed between two prefill-chunk ticks while
+    #: decodes are in flight (0 = prefill greedily)
+    prefill_interleave: int = Field(1, ge=0)
+    #: queued requests beyond this are refused on submit
+    max_queue: int = Field(1024, ge=1)
+    #: per-slot KV append strategy; resolution via :func:`resolve_kv_write`
+    kv_write: Optional[str] = None
+    #: sampling (scheduler-global; speculation requires greedy)
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    speculation: SpeculationConfig = Field(default_factory=SpeculationConfig)
+
+    @model_validator(mode="after")
+    def _validate(self):
+        _check(self.kv_write, KV_WRITE_CHOICES, "kv_write")
+        if self.speculation.enabled and self.do_sample:
+            raise ValueError("speculative decoding is only lossless under greedy "
+                             "decoding; set do_sample=False or disable speculation")
+        return self

@@ -1,0 +1,210 @@
+"""Fixed-shape serving programs over a per-slot (ragged) decode cache.
+
+The compiled surface of graft-serve is THREE programs per slot bucket —
+a chunked prefill, a one-token decode step, and (with speculation) a
+k+1-token verify step — whose shapes never change while requests join
+and leave. Join/leave is positional, not structural: the cache's index
+leaves are [slots] WRITE-POSITION vectors the scheduler stamps from its
+host-side length mirror before every tick; a parked slot carries the
+sentinel position ``n_positions`` so its KV writes drop out of bounds
+and its (garbage, finite) logits are discarded on the host. Rollback
+after a rejected speculation is therefore free — the next tick's stamp
+simply doesn't advance past the accepted prefix.
+
+Programs are cached on the target :class:`InferenceEngine` keyed by the
+pow2 slot bucket (``engine._pow2_bucket`` — the same bucketing discipline
+as ``generate``), so schedulers and repeated deployments reuse
+compilations instead of churning them.
+"""
+
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+#: cache leaves that hold write positions (scalar in ``generate``'s
+#: lockstep cache; [slots] vectors in the serving cache)
+INDEX_LEAVES = ("cache_index", "position_index")
+
+
+def _leaf_name(path) -> str:
+    last = path[-1]
+    return getattr(last, "key", None) or str(last)
+
+
+def _is_index_leaf(path) -> bool:
+    return _leaf_name(path) in INDEX_LEAVES
+
+
+def make_slot_cache(module, slots: int):
+    """A per-slot serving cache: the model's decode cache with every index
+    leaf widened from a scalar to a [slots] vector (which is what flips
+    the model's decode branch to per-slot scatter writes + per-slot
+    ``decode_lengths``). Slots start PARKED (sentinel position)."""
+    from deepspeed_tpu.models.common import init_cache
+    cache = init_cache(module, slots)
+    parked = slot_capacity(cache)
+
+    def widen(path, leaf):
+        if _is_index_leaf(path):
+            return jnp.full((slots,), parked, jnp.int32)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(widen, cache)
+
+
+def slot_capacity(cache) -> int:
+    """Token capacity per slot = the KV pool's position extent (also the
+    parked-slot sentinel: a write at this position drops out of bounds)."""
+    for path, leaf in jax.tree_util.tree_flatten_with_path(cache)[0]:
+        if _leaf_name(path) in ("cached_key", "cached_value"):
+            return int(leaf.shape[1])
+    raise ValueError("cache has no cached_key leaves — not a decode cache")
+
+
+def stamp_lengths(cache, write_pos: np.ndarray):
+    """Host-side stamp of the scheduler's authoritative per-slot write
+    positions into every index leaf (tiny [slots] arrays — the big KV
+    leaves pass through untouched, so donation chains tick to tick).
+    Each leaf gets its OWN device buffer: the cache is donated, and
+    donating one buffer through several leaves is an XLA error."""
+    pos = np.asarray(write_pos, np.int32)
+
+    def sub(path, leaf):
+        return jnp.array(pos) if _is_index_leaf(path) else leaf
+
+    return jax.tree_util.tree_map_with_path(sub, cache)
+
+
+# ---------------------------------------------------------------------------
+# step builders: apply_fn(params, cache, ids) -> (logits [S, L, V], cache')
+# ---------------------------------------------------------------------------
+def make_apply_fn(module, mparams: Optional[Callable] = None) -> Callable:
+    """The one decode apply shared by every serving program (and by the
+    ``serve_decode_step`` audit scenario, so the gated program IS the
+    served one). ``mparams`` is the engine's runtime weight view hook
+    (int8 dequant); identity when absent."""
+    mp = mparams or (lambda p: p)
+
+    def apply_fn(params, cache, ids):
+        out, upd = module.apply({"params": mp(params), "cache": cache},
+                                ids, decode=True, mutable=["cache"])
+        logits = out[0] if isinstance(out, (tuple, list)) else out
+        return logits, upd["cache"]
+
+    return apply_fn
+
+
+def build_prefill_step(apply_fn, do_sample: bool, temperature: float,
+                       top_k: int, top_p: float) -> Callable:
+    """One chunked-prefill tick: consume ``ids [S, C]`` at each slot's own
+    write position. ``last_idx [S]`` names each slot's final REAL token in
+    the chunk (a short final chunk is right-padded; pad positions write
+    beyond the committed length, are re-written by later tokens, and —
+    because the per-slot causal mask bounds every query by its own
+    position — are never attended by real queries). The chunk that
+    completes a prompt samples the request's FIRST token from its
+    last-real-position logits, so TTFT stops at prefill completion."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.engine import sample_logits
+
+    def last_logits(logits, last_idx):
+        return jnp.take_along_axis(logits, last_idx[:, None, None], axis=1)[:, 0]
+
+    if do_sample:
+        def prefill(params, cache, ids, last_idx, rng):
+            logits, cache = apply_fn(params, cache, ids)
+            tok = sample_logits(last_logits(logits, last_idx), rng, True,
+                                temperature, top_k, top_p).astype(jnp.int32)
+            return cache, tok
+    else:
+        def prefill(params, cache, ids, last_idx):
+            logits, cache = apply_fn(params, cache, ids)
+            return cache, jnp.argmax(last_logits(logits, last_idx),
+                                     axis=-1).astype(jnp.int32)
+
+    return prefill
+
+
+def build_decode_step(apply_fn, do_sample: bool, temperature: float,
+                      top_k: int, top_p: float) -> Callable:
+    """One decode tick: feed each slot's token, sample the next. Greedy
+    builds a no-rng program (``decode(params, cache, tokens)``); sampling
+    adds an rng operand."""
+    from deepspeed_tpu.inference.engine import sample_logits
+
+    if do_sample:
+        def decode(params, cache, tokens, rng):
+            logits, cache = apply_fn(params, cache, tokens[:, None])
+            tok = sample_logits(logits[:, -1], rng, True, temperature,
+                                top_k, top_p).astype(jnp.int32)
+            return cache, tok
+    else:
+        def decode(params, cache, tokens):
+            logits, cache = apply_fn(params, cache, tokens[:, None])
+            return cache, jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+
+    return decode
+
+
+def build_verify_step(apply_fn) -> Callable:
+    """Batched target verification for speculative decoding: feed the
+    k+1-token block ``[last_accepted, d_1..d_k]`` and return the target's
+    greedy token at EVERY position — the host accepts the longest draft
+    prefix the target reproduces and emits the target's own token at the
+    first divergence (lossless under greedy decoding by construction)."""
+
+    def verify(params, cache, tokens):
+        logits, cache = apply_fn(params, cache, tokens)
+        return cache, jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, K+1]
+
+    return verify
+
+
+# ---------------------------------------------------------------------------
+# engine-level program cache (satellite: serving reuses the bucketed cache)
+# ---------------------------------------------------------------------------
+def serve_programs(engine, slots_bucket: int, *, prefill_chunk: int,
+                   do_sample: bool, temperature: float, top_k: int, top_p: float,
+                   spec_k: int = 0, role: str = "target",
+                   module=None, mparams=None,
+                   kv_write: Optional[str] = None) -> Dict[str, Any]:
+    """The serving program dict for one pow2 slot bucket, cached on the
+    ENGINE (``engine._serve_cache``) so every scheduler over the same
+    engine — and re-created schedulers across deployments — reuse the
+    same compiled programs (the ``_pow2_bucket`` recompile-churn
+    satellite counts exactly one program set per bucket).
+
+    ``role``/``module`` let the speculation drafter park its own programs
+    in the same cache under a distinct key; ``kv_write`` is the RESOLVED
+    per-slot write mode the caller will trace under — part of the key, so
+    schedulers with different modes on one engine never share a program.
+
+    The key carries the module's identity (the cached closures keep the
+    module alive, so ``id`` cannot be recycled): two drafters with
+    identical knobs but different modules must never share a compiled
+    program closed over the first one's architecture. ``mparams`` is
+    assumed determined by (engine, module) — identity for custom
+    modules, the engine's weight view otherwise — and is not keyed."""
+    if not hasattr(engine, "_serve_cache"):
+        engine._serve_cache = {}
+    mod = module if module is not None else engine.module
+    key = (role, id(mod), int(slots_bucket), int(prefill_chunk), bool(do_sample),
+           float(temperature), int(top_k), float(top_p), int(spec_k), kv_write)
+    if key in engine._serve_cache:
+        return engine._serve_cache[key]
+    apply_fn = make_apply_fn(mod,
+                             mparams if mparams is not None else engine._mparams)
+    fns: Dict[str, Any] = {
+        "prefill": jax.jit(build_prefill_step(apply_fn, do_sample, temperature,
+                                              top_k, top_p), donate_argnums=(1,)),
+        "decode": jax.jit(build_decode_step(apply_fn, do_sample, temperature,
+                                            top_k, top_p), donate_argnums=(1,)),
+    }
+    if spec_k > 0:
+        fns["verify"] = jax.jit(build_verify_step(apply_fn), donate_argnums=(1,))
+    engine._serve_cache[key] = fns
+    return fns

@@ -1,0 +1,507 @@
+"""graft-serve: continuous in-flight batching over the per-slot decode
+cache (ISSUE 14 / ROADMAP item 1 — the latency-under-load axis).
+
+One scheduler drives one target :class:`InferenceEngine` through three
+fixed-shape programs (``serving/programs.py``): requests join and leave
+decode slots on every tick without changing any compiled shape; chunked
+prefill interleaves long prompts with in-flight decodes; speculative
+decoding drafts with the compression/KD student and verifies in one
+batched target pass. Admission is block-pool truthful (``queue.py``):
+a request is admitted only when its worst-case KV footprint is
+reservable, so nothing dies mid-flight and nothing leaks.
+
+Host protocol (the part that makes rollback and join/leave free): the
+scheduler's numpy ``lengths`` mirror is authoritative — every tick
+stamps it into the cache's index leaves. A parked slot carries the
+sentinel position (= slot capacity) so its writes drop out of bounds; a
+rejected speculation simply never advances the mirror past the accepted
+prefix.
+
+Integration seams (the five the last PRs built):
+* resilience — :meth:`serve` wires a ``PreemptionGuard``; SIGTERM drains
+  in-flight requests (finish), refuses the queue, and returns exit 143.
+* telemetry — per-tick spans + per-request latency/acceptance events
+  ride a ``RuntimeTelemetry`` bus when one is attached.
+* graft-audit — the decode program is the ``serve_decode_step`` scenario
+  (same ``make_apply_fn``), budgeted and signature-pinned by R009/R010/R013.
+* compression — the drafter is the KD student
+  (``compression.compress.student_initialization``).
+* engine — programs live in the engine's bucketed ``_serve_cache``.
+"""
+
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+
+from deepspeed_tpu.inference.serving.blocks import BlockPool
+from deepspeed_tpu.inference.serving.config import (ServingConfig,
+                                                    resolve_kv_write,
+                                                    set_default_kv_write)
+from deepspeed_tpu.inference.serving.programs import (make_slot_cache, serve_programs,
+                                                      slot_capacity, stamp_lengths)
+from deepspeed_tpu.inference.serving.queue import RequestQueue
+from deepspeed_tpu.inference.serving.request import (ACTIVE, FINISHED, PREFILL,
+                                                     Request)
+from deepspeed_tpu.runtime.telemetry.metrics import Histogram
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class ContinuousBatchingScheduler:
+    """Continuous (in-flight) batching over one target engine.
+
+    ``drafter``: optional ``(flax module, params)`` — the speculation
+    drafter (typically the layer-reduced KD student). Required when
+    ``config.speculation.enabled``.
+
+    ``clock``: injectable time source (``time.monotonic`` default); the
+    tier-1 scheduler test drives a simulated clock with no wall sleeps.
+    """
+
+    def __init__(self, engine, config=None, drafter: Optional[Tuple] = None,
+                 clock: Optional[Callable[[], float]] = None, telemetry=None,
+                 seed: int = 0):
+        if config is None:
+            config = ServingConfig()
+        elif isinstance(config, dict):
+            config = ServingConfig(**config)
+        self.config = config
+        self.engine = engine
+        self.module = engine.module
+        self.clock = clock or time.monotonic
+        self.telemetry = telemetry
+
+        # pow2 slot bucket: alternating deployments reuse compiled programs
+        self.slots = engine._pow2_bucket(config.slots)
+        # the fresh cache must carry the SAME engine-mesh sharding its
+        # steady-state successors (program outputs) will: a bare
+        # make_slot_cache is SingleDeviceSharding, and the first tick fed
+        # the evolved NamedSharding cache would silently recompile every
+        # program (~0.7 s mid-serve, measured as request 0's TTFT tail)
+        from jax.sharding import NamedSharding, PartitionSpec
+        self._placement = NamedSharding(engine.mesh, PartitionSpec())
+        self._cache = jax.device_put(  # graft-lint: waive R008 jax-owned fresh cache zeros, never donated before first use
+            make_slot_cache(self.module, self.slots), self._placement)
+        self.capacity = slot_capacity(self._cache)  # tokens per slot
+        self._probe_slot_decode()
+
+        # admission: block-pool truthful KV accounting
+        pool_tokens = config.kv_pool_tokens or self.slots * self.capacity
+        self.pool = BlockPool(num_blocks=max(1, pool_tokens // config.page_size),
+                              block_size=config.page_size)
+        self.queue = RequestQueue(self.pool, max_queue=config.max_queue,
+                                  max_total_tokens=self.capacity, clock=self.clock)
+
+        # the config's kv_write must reach the TRACED program, not just the
+        # evidence row: install it as the process default (the engine
+        # attention-block install/clear pattern — None clears), resolve the
+        # mode the program will actually trace under (env still outranks
+        # config, which is the DS_SERVE_KV_WRITE drift seam), and re-install
+        # at every tick so a program traced lazily after another scheduler's
+        # construction still binds THIS scheduler's mode.
+        set_default_kv_write(config.kv_write)
+        self.kv_write, self.kv_write_source = resolve_kv_write(None)
+        self.spec_k = int(config.speculation.k) if config.speculation.enabled else 0
+        if self.spec_k and drafter is None:
+            raise ValueError("speculation.enabled needs a drafter: pass "
+                             "drafter=(module, params) — e.g. the KD student from "
+                             "compression.student_initialization")
+        sampling = dict(do_sample=config.do_sample, temperature=config.temperature,
+                        top_k=config.top_k, top_p=config.top_p)
+        self.fns = serve_programs(engine, self.slots,
+                                  prefill_chunk=config.prefill_chunk,
+                                  spec_k=self.spec_k, kv_write=self.kv_write,
+                                  **sampling)
+        self._drafter = None
+        if drafter is not None and self.spec_k:
+            d_module, d_params = drafter
+            self._drafter = (d_module, jax.device_put(d_params))  # graft-lint: waive R008 drafter weights, never donated
+            self._drafter_cache = jax.device_put(  # graft-lint: waive R008 jax-owned fresh cache zeros, same placement contract as the target cache
+                make_slot_cache(d_module, self.slots), self._placement)
+            if slot_capacity(self._drafter_cache) < self.capacity:
+                raise ValueError("drafter context capacity is smaller than the "
+                                 "target's — it cannot draft to the end of a "
+                                 "maximal request")
+            self.dfns = serve_programs(engine, self.slots, role="drafter",
+                                       module=d_module, mparams=lambda p: p,
+                                       prefill_chunk=config.prefill_chunk,
+                                       spec_k=self.spec_k, kv_write=self.kv_write,
+                                       **sampling)
+
+        # host-side authoritative slot state
+        self._slot_req: List[Optional[Request]] = [None] * self.slots
+        self._lengths = np.full(self.slots, self.capacity, np.int64)  # parked sentinel
+        self._next_token = np.zeros(self.slots, np.int32)
+        self._decode_ticks_since_prefill = 10**9  # first prefill never waits
+        self._rng = jax.random.PRNGKey(seed)
+
+        # evidence: latency histograms + tick/speculation counters
+        self.ttft_hist = Histogram()
+        self.tok_hist = Histogram()
+        self.ticks = {"prefill": 0, "decode": 0, "spec": 0, "idle": 0}
+        self.drafted_total = 0
+        self.accepted_total = 0
+        self.finished: List[Request] = []
+        log_dist(f"graft-serve: slots={self.slots} capacity={self.capacity} "
+                 f"pool={self.pool.num_blocks}x{self.pool.block_size} "
+                 f"chunk={config.prefill_chunk} kv_write={self.kv_write}"
+                 f"({self.kv_write_source}) spec_k={self.spec_k}")
+
+    # ------------------------------------------------------------------
+    def _probe_slot_decode(self) -> None:
+        """Fail at construction — with the model family named — when the
+        module's decode path cannot take a per-slot index vector (only
+        families with ragged-decode support, e.g. GPT-2, can serve)."""
+        try:
+            import jax.numpy as jnp
+            ids = jnp.zeros((self.slots, 1), jnp.int32)
+            jax.eval_shape(lambda p, c: self.module.apply(
+                {"params": p, "cache": c}, ids, decode=True, mutable=["cache"]),
+                self.engine.params, self._cache)
+        except Exception as e:
+            raise NotImplementedError(
+                f"{type(self.module).__name__} does not support the per-slot "
+                f"(ragged) decode cache graft-serve schedules against — its "
+                f"decode path rejected a [slots] cache_index vector: "
+                f"{type(e).__name__}: {e}") from e
+
+    def _span(self, name: str):
+        if self.telemetry is not None:
+            return self.telemetry.span(name)
+        import contextlib
+        return contextlib.nullcontext()
+
+    # ------------------------------------------------------------------
+    def warmup(self) -> None:
+        """Compile every program this scheduler can ever run, off the
+        clock: one call each against fully-parked caches, so every KV
+        write drops out of bounds and the outputs are garbage to discard.
+        A latency-under-load run must not charge a mid-serve request for
+        XLA compile time — and a warm *request* cannot reliably reach the
+        rare-path programs (the drafter's refeed verify only runs when
+        some slot accepts all k drafts). Touches no request accounting,
+        no histograms, and not the sampling rng stream."""
+        set_default_kv_write(self.config.kv_write)
+        parked = np.full(self.slots, self.capacity, np.int64)
+        rng = ((jax.random.PRNGKey(0),) if self.config.do_sample else ())
+        C = self.config.prefill_chunk
+        ids = jax.numpy.zeros((self.slots, C), jax.numpy.int32)
+        last_idx = jax.numpy.zeros((self.slots,), jax.numpy.int32)
+        tok = jax.numpy.zeros((self.slots,), jax.numpy.int32)
+        block = jax.numpy.zeros((self.slots, self.spec_k + 1), jax.numpy.int32)
+        # a spec-mode scheduler never runs the target's plain decode
+        # (step() always spec-ticks) — don't pay its compile
+        target_calls = ([("prefill", (ids, last_idx) + rng)]
+                        + ([("verify", (block,))] if self.spec_k
+                           else [("decode", (tok,) + rng)]))
+        per_role = [(self.fns, "_cache", self.engine.params, target_calls)]
+        if self._drafter is not None:
+            # the draft loop feeds decode a mesh-committed token (see
+            # _spec_tick); every other tick input arrives uncommitted
+            dtok = jax.device_put(tok, self._placement)  # graft-lint: waive R008 warmup operand placement parity w/ the draft loop, never donated
+            per_role.append((self.dfns, "_drafter_cache", self._drafter[1],
+                             [("prefill", (ids, last_idx) + rng),
+                              ("decode", (dtok,) + rng), ("verify", (block,))]))
+        for fns, cache_attr, params, calls in per_role:
+            for name, args in calls:
+                if name in fns:
+                    cache = stamp_lengths(getattr(self, cache_attr), parked)
+                    cache, _ = fns[name](params, cache, *args)
+                    setattr(self, cache_attr, cache)
+
+    # ------------------------------------------------------------------
+    # submission / admission
+    # ------------------------------------------------------------------
+    def submit(self, request: Request) -> Request:
+        return self.queue.submit(request)
+
+    @property
+    def in_flight(self) -> List[Request]:
+        return [r for r in self._slot_req if r is not None]
+
+    def _free_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self._slot_req) if r is None]
+
+    def _admit(self) -> int:
+        free = self._free_slots()
+        admitted = self.queue.admit(len(free))
+        for slot, req in zip(free, admitted):
+            self._slot_req[slot] = req
+            self._lengths[slot] = 0
+            req.state = PREFILL
+            req.prefill_pos = 0
+        return len(admitted)
+
+    # ------------------------------------------------------------------
+    # tick
+    # ------------------------------------------------------------------
+    def step(self, admit: bool = True) -> str:
+        """One scheduler tick; returns the tick kind it ran
+        (``prefill`` | ``decode`` | ``spec`` | ``idle``)."""
+        step_no = sum(self.ticks.values()) + 1
+        # lazily-traced programs must bind THIS scheduler's write mode even
+        # if another scheduler re-installed the default since construction
+        set_default_kv_write(self.config.kv_write)
+        if self.telemetry is not None:
+            self.telemetry.begin_step(step_no)
+        with self._span("serve_admit"):
+            if admit:
+                self._admit()
+        prefilling = [i for i, r in enumerate(self._slot_req)
+                      if r is not None and r.state == PREFILL]
+        active = [i for i, r in enumerate(self._slot_req)
+                  if r is not None and r.state == ACTIVE]
+        if prefilling and (not active or self._decode_ticks_since_prefill
+                           >= self.config.prefill_interleave):
+            kind = "prefill"
+            with self._span("serve_prefill"):
+                self._prefill_tick(prefilling)
+            self._decode_ticks_since_prefill = 0
+        elif active:
+            kind = "spec" if self.spec_k else "decode"
+            with self._span(f"serve_{kind}"):
+                if self.spec_k:
+                    self._spec_tick(active)
+                else:
+                    self._decode_tick(active)
+            self._decode_ticks_since_prefill += 1
+        else:
+            kind = "idle"
+        self.ticks[kind] += 1
+        if self.telemetry is not None:
+            self.telemetry.end_step(step_no)
+        return kind
+
+    # -- prefill -------------------------------------------------------
+    def _prefill_tick(self, slots: List[int]) -> None:
+        C = self.config.prefill_chunk
+        ids = np.zeros((self.slots, C), np.int32)
+        last_idx = np.full(self.slots, C - 1, np.int32)
+        write_pos = np.full(self.slots, self.capacity, np.int64)
+        rems: Dict[int, int] = {}
+        for i in slots:
+            req = self._slot_req[i]
+            chunk = req.prompt[req.prefill_pos:req.prefill_pos + C]
+            rems[i] = rem = len(chunk)
+            ids[i, :rem] = chunk
+            last_idx[i] = rem - 1
+            write_pos[i] = self._lengths[i]
+        cache = stamp_lengths(self._cache, write_pos)
+        args = (self.engine.params, cache, jax.numpy.asarray(ids),
+                jax.numpy.asarray(last_idx))
+        if self.config.do_sample:
+            self._rng, key = jax.random.split(self._rng)
+            self._cache, tok = self.fns["prefill"](*args, key)
+        else:
+            self._cache, tok = self.fns["prefill"](*args)
+        if self._drafter is not None:
+            d_module, d_params = self._drafter
+            d_cache = stamp_lengths(self._drafter_cache, write_pos)
+            d_args = (d_params, d_cache, jax.numpy.asarray(ids),
+                      jax.numpy.asarray(last_idx))
+            if self.config.do_sample:
+                self._rng, dkey = jax.random.split(self._rng)
+                self._drafter_cache, _ = self.dfns["prefill"](*d_args, dkey)
+            else:
+                self._drafter_cache, _ = self.dfns["prefill"](*d_args)
+        with self._span("serve_device_wait"):
+            tok = np.asarray(tok)
+        now = self.clock()
+        for i in slots:
+            req, rem = self._slot_req[i], rems[i]
+            req.prefill_pos += rem
+            self._lengths[i] += rem
+            self.pool.advance(req.request_id, rem)
+            if req.prefill_pos >= req.prompt_len:
+                # prompt complete: the chunk's last-position logits sampled
+                # the FIRST new token — TTFT stops here
+                req.state = ACTIVE
+                req.record_token(int(tok[i]), now)
+                self._next_token[i] = tok[i]
+                self._maybe_finish(i, now)
+
+    # -- plain decode --------------------------------------------------
+    def _decode_tick(self, slots: List[int]) -> None:
+        write_pos = np.full(self.slots, self.capacity, np.int64)
+        tokens = np.zeros(self.slots, np.int32)
+        for i in slots:
+            write_pos[i] = self._lengths[i]
+            tokens[i] = self._next_token[i]
+        cache = stamp_lengths(self._cache, write_pos)
+        args = (self.engine.params, cache, jax.numpy.asarray(tokens))
+        if self.config.do_sample:
+            self._rng, key = jax.random.split(self._rng)
+            self._cache, tok = self.fns["decode"](*args, key)
+        else:
+            self._cache, tok = self.fns["decode"](*args)
+        with self._span("serve_device_wait"):
+            tok = np.asarray(tok)
+        now = self.clock()
+        for i in slots:
+            req = self._slot_req[i]
+            self._lengths[i] += 1  # the fed token's KV is now committed
+            self.pool.advance(req.request_id, 1)
+            req.record_token(int(tok[i]), now)
+            self._next_token[i] = tok[i]
+            self._maybe_finish(i, now)
+
+    # -- speculative decode --------------------------------------------
+    def _spec_tick(self, slots: List[int]) -> None:
+        """One speculation round: k drafter steps, one batched target
+        verify over the k+1 block, host-side longest-prefix acceptance.
+        The drafter re-feeds the verify block only when some slot accepted
+        every draft (its own pass never wrote the kth draft's KV)."""
+        k = self.spec_k
+        d_module, d_params = self._drafter
+        write_pos = np.full(self.slots, self.capacity, np.int64)
+        for i in slots:
+            write_pos[i] = self._lengths[i]
+        # committed to the mesh placement so iteration 1's input sharding
+        # matches iterations 2..k (which feed the previous jit output back);
+        # an uncommitted first feed would cost a second decode compile
+        cur = jax.device_put(  # graft-lint: waive R008 host token mirror to mesh placement, never donated
+            np.asarray([self._next_token[i] if self._slot_req[i] is not None
+                        and self._slot_req[i].state == ACTIVE else 0
+                        for i in range(self.slots)], np.int32), self._placement)
+        drafts = []
+        with self._span("serve_spec_draft"):
+            for j in range(k):
+                d_cache = stamp_lengths(self._drafter_cache, write_pos + j)
+                self._drafter_cache, cur = self.dfns["decode"](d_params, d_cache, cur)
+                drafts.append(cur)
+            drafts = np.stack([np.asarray(d) for d in drafts], axis=1)  # [S, k]
+        block = np.zeros((self.slots, k + 1), np.int32)
+        for i in slots:
+            block[i, 0] = self._next_token[i]
+            block[i, 1:] = drafts[i]
+        with self._span("serve_spec_verify"):
+            cache = stamp_lengths(self._cache, write_pos)
+            self._cache, greedy = self.fns["verify"](
+                self.engine.params, cache, jax.numpy.asarray(block))
+            greedy = np.asarray(greedy)  # [S, k+1] target argmax per position
+        refeed = False
+        now = self.clock()
+        for i in slots:
+            req = self._slot_req[i]
+            # longest prefix of drafts the target reproduces
+            a = 0
+            while a < k and drafts[i, a] == greedy[i, a]:
+                a += 1
+            emitted = list(drafts[i, :a]) + [greedy[i, a]]
+            req.drafted_tokens += k
+            req.accepted_tokens += a
+            self.drafted_total += k
+            self.accepted_total += a
+            if a == k:
+                refeed = True  # drafter never wrote d_k's KV — resync below
+            # budget/eos truncation
+            room = req.max_new_tokens - len(req.output)
+            emitted = emitted[:room]
+            if req.eos_token_id is not None and req.eos_token_id in emitted:
+                emitted = emitted[:emitted.index(req.eos_token_id) + 1]
+            for t in emitted:
+                req.record_token(int(t), now)
+            # committed KV: the fed block prefix [last, d_1..d_{m-1}]
+            self._lengths[i] += len(emitted)
+            self.pool.advance(req.request_id, len(emitted))
+            self._next_token[i] = emitted[-1]
+            self._maybe_finish(i, now)
+        if refeed and any(self._slot_req[i] is not None for i in slots):
+            with self._span("serve_spec_refeed"):
+                d_cache = stamp_lengths(self._drafter_cache, write_pos)
+                self._drafter_cache, _ = self.dfns["verify"](
+                    d_params, d_cache, jax.numpy.asarray(block))
+
+    # -- retire --------------------------------------------------------
+    def _maybe_finish(self, slot: int, now: float) -> None:
+        req = self._slot_req[slot]
+        done = len(req.output) >= req.max_new_tokens
+        if req.eos_token_id is not None and req.output and \
+                req.output[-1] == req.eos_token_id:
+            done = True
+        if not done:
+            return
+        req.state = FINISHED
+        req.finish_time = now
+        self.pool.free(req.request_id)
+        self._slot_req[slot] = None
+        self._lengths[slot] = self.capacity  # park
+        self.finished.append(req)
+        if req.ttft is not None:
+            self.ttft_hist.record(req.ttft)
+        for prev, cur in zip(req.token_times, req.token_times[1:]):
+            self.tok_hist.record(cur - prev)
+        if self.telemetry is not None:
+            self.telemetry.emit("serve_request", **req.stats())
+
+    # ------------------------------------------------------------------
+    # loops
+    # ------------------------------------------------------------------
+    def run_until_drained(self, max_ticks: int = 10**9, admit: bool = True) -> int:
+        """Tick until queue + slots are empty; returns ticks run."""
+        n = 0
+        while (self.in_flight or len(self.queue)) and n < max_ticks:
+            self.step(admit=admit)
+            n += 1
+        return n
+
+    def serve(self, requests=(), guard=None) -> int:
+        """Serve ``requests`` to completion under a preemption guard.
+
+        SIGTERM/SIGINT mid-serve triggers the drain contract (reusing
+        PR 9's ``runtime/resilience`` signal handling): stop admitting,
+        terminally REFUSE everything still queued, FINISH every in-flight
+        request, and return ``DEFAULT_PREEMPT_EXIT_CODE`` (143) so a
+        supervisor reads preemption, not success. Returns 0 on a normal
+        complete drain."""
+        from deepspeed_tpu.runtime.resilience.signals import (
+            DEFAULT_PREEMPT_EXIT_CODE, PreemptionGuard)
+        own_guard = guard is None
+        if own_guard:
+            guard = PreemptionGuard().install()
+        preempted = None
+        try:
+            for r in requests:
+                self.submit(r)
+            while self.in_flight or len(self.queue):
+                if guard.requested and preempted is None:
+                    preempted = guard.consume()
+                    refused = self.queue.refuse_all(f"draining on {preempted}")
+                    log_dist(f"graft-serve: {preempted} — draining "
+                             f"{len(self.in_flight)} in-flight, refused "
+                             f"{len(refused)} queued")
+                    if self.telemetry is not None:
+                        self.telemetry.emit("serve_drain", signal=preempted,
+                                            in_flight=len(self.in_flight),
+                                            refused=len(refused))
+                self.step(admit=preempted is None)
+        finally:
+            if own_guard:
+                guard.uninstall()
+        return DEFAULT_PREEMPT_EXIT_CODE if preempted else 0
+
+    # ------------------------------------------------------------------
+    def stats(self) -> dict:
+        """Aggregate serving evidence: latency distributions, goodput
+        inputs, speculation acceptance, pool accounting, tick mix."""
+        done = [r for r in self.finished]
+        out = {
+            "finished": len(done),
+            "refused": self.queue.refused,
+            "generated_tokens": sum(len(r.output) for r in done),
+            "ticks": dict(self.ticks),
+            "pool": self.pool.counters(),
+            "kv_write": self.kv_write,
+            "kv_write_source": self.kv_write_source,
+            "ttft": self.ttft_hist.snapshot() if self.ttft_hist.count else None,
+            "per_token": self.tok_hist.snapshot() if self.tok_hist.count else None,
+        }
+        if self.spec_k:
+            out["spec_k"] = self.spec_k
+            out["drafted"] = self.drafted_total
+            out["accepted"] = self.accepted_total
+            out["acceptance_rate"] = (self.accepted_total / self.drafted_total
+                                      if self.drafted_total else None)
+        return out

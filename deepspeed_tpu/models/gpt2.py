@@ -207,14 +207,46 @@ class SelfAttention(nn.Module):
                                      (b, cfg.n_positions, cfg.n_head, cfg.head_dim), v.dtype)
             cache_index = self.variable("cache", "cache_index", lambda: jnp.zeros([], jnp.int32))
             idx = cache_index.value
-            cached_k.value = jax.lax.dynamic_update_slice(cached_k.value, k, (0, idx, 0, 0))
-            cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, v, (0, idx, 0, 0))
+            if idx.ndim:
+                # graft-serve per-slot ragged cache: ``cache_index`` arrives
+                # as a [B] write-position vector (serving.make_slot_cache),
+                # so every slot of an in-flight batch appends at its OWN
+                # length — the join/leave masking is positional: a parked
+                # slot's sentinel position (>= n_positions) makes its
+                # scatter writes drop out of bounds, no jnp.where over the
+                # pool. decode_lengths becomes genuinely per-slot, which
+                # the attention backends already mask per sequence.
+                from deepspeed_tpu.inference.serving.config import resolve_kv_write
+                mode, _ = resolve_kv_write(getattr(cfg, "serve_kv_write", None))
+                pos = idx[:, None] + jnp.arange(l)[None, :]  # [b, l]
+                if mode == "dense":
+                    # masked full-pool rebuild: one [b, l, P] one-hot and a
+                    # [b, P, h, d] temporary PER LAYER per tick — kept as the
+                    # DS_SERVE_KV_WRITE seeded regression for the R010 gate
+                    # (semantically identical: out-of-bounds one-hot rows are
+                    # zero, so parked slots still drop their writes)
+                    onehot = jax.nn.one_hot(pos, cfg.n_positions, dtype=jnp.float32)
+                    written = (onehot.sum(1) > 0)[..., None, None]  # [b, P, 1, 1]
+                    upd_k = jnp.einsum("blp,blhd->bphd", onehot, k.astype(jnp.float32))
+                    upd_v = jnp.einsum("blp,blhd->bphd", onehot, v.astype(jnp.float32))
+                    cached_k.value = jnp.where(written, upd_k.astype(k.dtype), cached_k.value)
+                    cached_v.value = jnp.where(written, upd_v.astype(v.dtype), cached_v.value)
+                else:
+                    bidx = jnp.arange(b)[:, None]
+                    # default scatter mode drops out-of-bounds updates —
+                    # exactly the parked-slot contract
+                    cached_k.value = cached_k.value.at[bidx, pos].set(k)
+                    cached_v.value = cached_v.value.at[bidx, pos].set(v)
+                decode_lengths = idx + l
+            else:
+                cached_k.value = jax.lax.dynamic_update_slice(cached_k.value, k, (0, idx, 0, 0))
+                cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, v, (0, idx, 0, 0))
+                # per-sequence live-length vector — the flash backend's decode
+                # kernel skips dead KV blocks; the XLA backend derives the
+                # validity mask from it
+                decode_lengths = jnp.broadcast_to(idx + l, (b,))
             cache_index.value = idx + l
             k, v = cached_k.value, cached_v.value
-            # per-sequence live-length vector — the flash backend's decode
-            # kernel skips dead KV blocks; the XLA backend derives the
-            # validity mask from it
-            decode_lengths = jnp.broadcast_to(idx + l, (b,))
             causal = False
         from deepspeed_tpu.models.common import attention_geometry_kwargs
         attn_out = dot_product_attention(q,
@@ -343,9 +375,16 @@ class GPT2LMHeadModel(nn.Module):
             # attention layer's cache_index (same increment per call — flax
             # offers no clean cross-module read, so the counter is duplicated)
             pos_idx = self.variable("cache", "position_index", lambda: jnp.zeros([], jnp.int32))
-            positions = pos_idx.value + jnp.arange(seq_len)
+            if pos_idx.value.ndim:
+                # per-slot serving cache: [B] positions (clip keeps parked
+                # slots' sentinel positions in-table; their rows are dead)
+                positions = jnp.clip(pos_idx.value[:, None] + jnp.arange(seq_len)[None, :],
+                                     0, cfg.n_positions - 1)
+                x = x + jnp.take(wpe_value, positions, axis=0).astype(cfg.dtype)
+            else:
+                positions = pos_idx.value + jnp.arange(seq_len)
+                x = x + jnp.take(wpe_value, positions, axis=0).astype(cfg.dtype)[None]
             pos_idx.value = pos_idx.value + seq_len
-            x = x + jnp.take(wpe_value, positions, axis=0).astype(cfg.dtype)[None]
         else:
             x = x + wpe_value[:seq_len].astype(cfg.dtype)
         if not deterministic and cfg.dropout > 0.0:

@@ -53,10 +53,16 @@ def test_committed_cost_baseline_covers_the_matrix():
     programs = baseline["programs"]
     # the gate scenarios must be banked or the ratchet has no teeth
     for name in ("moe_ep_step", "pipe_chunked_step", "pipe_1f1b_step",
-                 "zero3_train_step", "train_batch_parity"):
+                 "zero3_train_step", "train_batch_parity",
+                 "serve_decode_step"):
         assert name in programs, name
         assert programs[name]["peak_bytes"] > 0
         assert "collective_counts" in programs[name]
+    # the banked serve decode tick must sit under its committed budget
+    # with headroom for the ratchet to have teeth (PERF.md §PR14)
+    from deepspeed_tpu.analysis.scenarios import SERVE_DECODE_BUDGET_MB
+    assert (programs["serve_decode_step"]["peak_transient_bytes"]
+            <= SERVE_DECODE_BUDGET_MB * 2**20)
     # the banked 1F1B transient must sit strictly below both the chunked
     # schedule's transient AND its own committed budget — the ratchet-DOWN
     # this PR's schedule refactor banked (PERF.md §PR11)
@@ -142,6 +148,37 @@ def test_pipe_schedule_env_drift_exits_1(graft_lint, tmp_path, monkeypatch):
     report = _report(tmp_path)
     hits = report["programs"]["pipe_1f1b_step"]["summary"]["rule_hits"]
     assert hits.get("R009") and hits.get("R010")
+
+
+def test_serve_kv_write_env_drift_exits_1(graft_lint, tmp_path, monkeypatch):
+    """DS_SERVE_KV_WRITE=dense against the committed-scatter serving
+    scenario (the DS_MOE_ROUTE pattern on a serving knob): the masked
+    full-pool KV rebuild fattens the per-tick transient past the
+    committed budget — R010 fires and the R013 ratchet reports the
+    regression vs the banked scatter price."""
+    monkeypatch.setenv("DS_SERVE_KV_WRITE", "dense")
+    rc = graft_lint.run(["--cost", "--scenarios", "serve_decode_step",
+                         "--no-ast", "--out", str(tmp_path), "-q"])
+    assert rc == 1
+    report = _report(tmp_path)
+    hits = report["programs"]["serve_decode_step"]["summary"]["rule_hits"]
+    assert hits.get("R010") or hits.get("R013"), hits
+    # the scenario's declared intent stays the committed one — the drift
+    # is visible precisely because the env layer cannot rewrite it
+    from deepspeed_tpu.analysis.scenarios import SERVE_DECODE_BUDGET_MB
+    assert (report["cost"]["serve_decode_step"]
+            ["memory"]["peak_transient_bytes"] > SERVE_DECODE_BUDGET_MB * 2**20)
+
+
+def test_serve_scenario_clean_on_committed_write(graft_lint, tmp_path):
+    rc = graft_lint.run(["--cost", "--scenarios", "serve_decode_step",
+                         "--no-ast", "--out", str(tmp_path), "-q"])
+    assert rc == 0
+    report = _report(tmp_path)
+    cost = report["cost"]["serve_decode_step"]
+    assert cost["memory"]["peak_transient_bytes"] > 0
+    # the tp=2 serving collectives are real compiled-layer ops
+    assert cost["collectives"]["compiled"]["counts"].get("all_reduce") == 5
 
 
 def test_cost_update_baseline_roundtrip(graft_lint, tmp_path, monkeypatch):

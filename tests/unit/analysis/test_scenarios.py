@@ -41,7 +41,7 @@ def test_matrix_builds_expected_scenarios(matrix):
     expected = {"gpt2_fwd_bwd", "llama_fwd_bwd", "bert_fwd_bwd",
                 "moe_top1_route", "moe_top2_route", "train_batch_parity",
                 "zero2_train_step", "zero3_train_step", "moe_ep_step",
-                "pipe_chunked_step", "pipe_1f1b_step"}
+                "pipe_chunked_step", "pipe_1f1b_step", "serve_decode_step"}
     assert expected <= set(programs) | set(skipped)
     # the pipe pipe*data*fsdp scenario is allowed to skip on the 0.4.37
     # container (the known partial-manual shard_map gap) and the
@@ -81,6 +81,15 @@ def test_cost_signature_metadata_armed(matrix):
     if "moe_ep_step" in programs:
         kinds = {e["kind"] for e in programs["moe_ep_step"].metadata["collective_signature"]}
         assert {"dense_dispatch", "resharding"} <= kinds
+    if "serve_decode_step" in programs:
+        # the graft-serve decode tick (PR 14): budget armed for R010, the
+        # tp=2 serving collective signature pinned for R009, and the
+        # committed KV-write intent declared (env drift has no way in)
+        meta = programs["serve_decode_step"].metadata
+        assert meta.get("activation_budget_bytes", 0) > 0
+        assert meta["serve_kv_write"] == "scatter"
+        assert any(e["kind"] == "all_reduce" and e["count"] == 5
+                   for e in meta["collective_signature"])
 
 
 def test_clean_matrix_zero_false_positives(matrix):

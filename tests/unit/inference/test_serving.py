@@ -293,8 +293,10 @@ class TestSeq2SeqBeamSearch:
 
 
 def test_serve_bench_tool_smoke(monkeypatch):
-    """tools/serve_bench.py (decode-throughput bench) runs at test scale
-    and emits a well-formed JSON line."""
+    """tools/serve_bench.py (latency-under-load bench, PR 14) runs the
+    continuous-vs-static comparison at test scale and emits well-formed
+    JSON rows: p50/p99 TTFT + per-token latency, goodput, and the
+    comparison verdict line."""
     import importlib.util
     import io
     import contextlib
@@ -303,8 +305,9 @@ def test_serve_bench_tool_smoke(monkeypatch):
 
     tools = _os.path.join(_os.path.dirname(_os.path.dirname(_os.path.dirname(
         _os.path.dirname(_os.path.abspath(__file__))))), "tools")
-    for k, v in {"SERVE_MODEL": "test", "SERVE_BATCH": "2", "SERVE_PROMPT": "16",
-                 "SERVE_NEW": "8", "SERVE_ROUNDS": "1"}.items():
+    for k, v in {"SERVE_MODEL": "test", "SERVE_MODE": "both", "SERVE_QPS": "50",
+                 "SERVE_REQUESTS": "6", "SERVE_PROMPT": "16", "SERVE_NEW": "8",
+                 "SERVE_SLOTS": "2", "SERVE_CHUNK": "8"}.items():
         monkeypatch.setenv(k, v)
     spec = importlib.util.spec_from_file_location(
         "serve_bench", _os.path.join(tools, "serve_bench.py"))
@@ -314,6 +317,16 @@ def test_serve_bench_tool_smoke(monkeypatch):
     with contextlib.redirect_stdout(buf):
         rc = mod.main()
     assert rc == 0
-    line = json.loads(buf.getvalue().strip().splitlines()[-1])
-    assert line["decode_tokens_per_s"] > 0 and line["new"] == 8
-    assert line["e2e_tokens_per_s_incl_prefill"] > 0
+    rows = [json.loads(l) for l in buf.getvalue().splitlines()
+            if l.startswith("{")]
+    by_mode = {r["mode"]: r for r in rows if "mode" in r}
+    assert set(by_mode) == {"continuous", "static"}
+    for r in by_mode.values():
+        assert r["finished"] == 6 and r["goodput_tok_s"] > 0
+        assert r["ttft"]["p50"] > 0 and r["ttft"]["p99"] >= r["ttft"]["p50"]
+        assert r["per_token"]["p99"] >= r["per_token"]["p50"] > 0
+    cont = by_mode["continuous"]
+    assert cont["chunked_prefill"] and cont["pool"]["used_blocks"] == 0
+    assert "serve_cost_transient_bytes" in cont  # lint/cost evidence rode along
+    comparison = [r for r in rows if r.get("comparison") == "continuous_vs_static"]
+    assert comparison and "continuous_beats_static_goodput" in comparison[0]

@@ -287,6 +287,14 @@ def test_preempt_save_dir_config_arms_at_init(tmp_path, _restore_signals):
     assert os.path.exists(os.path.join(ckpt, "latest"))
 
 
+def test_sigterm_mid_serve_drains_and_exits_143(tmp_path):
+    """graft-serve drain contract under a REAL SIGTERM (subprocess): every
+    in-flight request finishes its full budget, the queue is terminally
+    refused, no KV block leaks, exit code is 143."""
+    row = fault_bench.scenario_serve_drain(str(tmp_path))
+    assert row["ok"], row
+
+
 # ---------------------------------------------------------------------------
 # heartbeat cadence (satellite: wired + off the hot path)
 # ---------------------------------------------------------------------------

@@ -106,15 +106,22 @@ def test_rlhf_bench_runs_end_to_end():
 
 
 def test_serve_bench_runs_end_to_end():
+    """The PR-14 latency-under-load bench in a clean subprocess: Poisson
+    arrivals through the continuous scheduler, TTFT/per-token/goodput row
+    shape (the in-process both-modes comparison is covered by
+    tests/unit/inference/test_serving.py::test_serve_bench_tool_smoke)."""
     lines = _run_cpu(
         "import sys; sys.path.insert(0, 'tools');"
         "import jax; jax.config.update('jax_platforms', 'cpu');"
         "import serve_bench; serve_bench.main()",
-        env_extra={"SERVE_MODEL": "test", "SERVE_BATCH": "2",
+        env_extra={"SERVE_MODEL": "test", "SERVE_MODE": "continuous",
+                   "SERVE_QPS": "50", "SERVE_REQUESTS": "4",
                    "SERVE_PROMPT": "16", "SERVE_NEW": "8",
-                   "SERVE_ROUNDS": "1"})
+                   "SERVE_SLOTS": "2", "SERVE_CHUNK": "8"})
     assert lines, "serve_bench printed no JSON"
     row = lines[-1]
     assert row["backend"] == "cpu"
-    assert row["e2e_tokens_per_s_incl_prefill"] > 0
-    assert row["round_s_short"] and row["round_s_long"]
+    assert row["mode"] == "continuous" and row["finished"] == 4
+    assert row["goodput_tok_s"] > 0
+    assert row["ttft"]["p99"] >= row["ttft"]["p50"] > 0
+    assert row["pool"]["used_blocks"] == 0

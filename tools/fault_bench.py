@@ -12,6 +12,8 @@ fails.
 | persistent NaN grads   | inf loss boost through real overflow path   | abort after K consecutive skips (loud)       |
 | SIGKILL mid-run        | DS_FAULT_SPEC step=sigkill@N under agent    | restart + bit-exact resumed loss curve       |
 | transient HTTP 500     | compile-helper-500-shaped flaky call        | retried with backoff; attempts in evidence   |
+| SIGTERM mid-serve      | real SIGTERM to a serving subprocess        | in-flight drained to full budget, queue      |
+|                        |                                             | refused, exit 143 (graft-serve drain)        |
 
 Run: python tools/fault_bench.py            (scenario subset: FAULT_SCENARIOS=...)
 Tests import the scenario functions directly (tests/unit/resilience/).
@@ -269,8 +271,125 @@ def scenario_sigkill_resume(workdir, kill_at=2, total=4):
                 attempt_progress=progress)
 
 
+_SERVE_CHILD = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir", os.path.join({repo!r}, ".jax_cache"))
+    import numpy as np
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.serving import (ContinuousBatchingScheduler,
+                                                 Request, ServingConfig)
+    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+    from deepspeed_tpu.parallel.topology import MeshTopology
+
+    cfg = get_gpt2_config("test", n_layer=2, n_positions=256)
+    topo = MeshTopology(tensor=1, data=1, fsdp=1, devices=jax.devices()[:1])
+    engine = InferenceEngine(GPT2LMHeadModel(cfg),
+                             DeepSpeedInferenceConfig(replace_with_kernel_inject=False),
+                             topology=topo)
+    sched = ContinuousBatchingScheduler(engine,
+                                        ServingConfig(slots=2, prefill_chunk=8))
+    rng = np.random.default_rng(0)
+    # ~190 warm decode ticks per slot pair: the full serve takes seconds,
+    # so the parent's SIGTERM reliably lands mid-flight, while the
+    # post-signal drain (<= one request's remaining budget) stays short
+    reqs = [Request(prompt=rng.integers(0, cfg.vocab_size, (8,)).astype(np.int32),
+                    max_new_tokens=192) for _ in range(8)]
+    # warm the serving programs so the post-signal drain measures the drain,
+    # not XLA compiles
+    warm = Request(prompt=reqs[0].prompt, max_new_tokens=2)
+    sched.submit(warm)
+    sched.run_until_drained(max_ticks=10**5)
+    sched.finished.clear()
+    print("SERVING_READY", flush=True)
+    rc = sched.serve(reqs)           # installs the PreemptionGuard itself
+    stats = sched.stats()
+    print("DRAIN " + json.dumps({{
+        "rc": rc, "finished": stats["finished"], "refused": stats["refused"],
+        "in_flight_after": len(sched.in_flight),
+        "pool_used_after": stats["pool"]["used_blocks"],
+        "full_budget": all(len(r.output) == r.max_new_tokens
+                           for r in sched.finished)}}), flush=True)
+    sys.exit(rc)
+""")
+
+
+def scenario_serve_drain(workdir):
+    """Real SIGTERM to an actively-serving process (graft-serve): in-flight
+    requests must DRAIN to their full token budget (never truncated or
+    dropped), everything still queued is terminally refused, no KV block
+    leaks, and the process exits 143 so a supervisor reads preemption."""
+    import select as _select
+    import signal as _signal
+    import time as _time
+
+    from envutil import cpu_subprocess_env
+    # stderr to a FILE, not a pipe: the parent tails stdout line-by-line
+    # before SIGTERM, and an undrained stderr pipe filling up (verbose jax
+    # warnings) would deadlock child against parent with no timeout armed
+    err_path = os.path.join(workdir, "serve_drain.stderr")
+    with open(err_path, "w") as err_fh:
+        p = subprocess.Popen([PY, "-c", _SERVE_CHILD.format(repo=REPO)],
+                             env=cpu_subprocess_env(), stdout=subprocess.PIPE,
+                             stderr=err_fh, text=True, cwd=REPO)
+        try:
+            deadline = _time.monotonic() + 300
+            ready = False
+            # read the fd RAW while waiting: select() on the buffered
+            # TextIOWrapper can report not-ready while SERVING_READY
+            # already sits in the wrapper's internal buffer (a readline
+            # drains every line the pipe delivered in one read)
+            fd = p.stdout.fileno()
+            os.set_blocking(fd, False)
+            buf = b""
+            while _time.monotonic() < deadline:
+                if not _select.select([fd], [], [], 1.0)[0]:
+                    continue
+                chunk = os.read(fd, 65536)
+                if not chunk:
+                    break  # EOF: child died before serving
+                buf += chunk
+                if b"SERVING_READY" in buf:
+                    ready = True
+                    break
+            os.set_blocking(fd, True)  # communicate() needs blocking reads
+            if not ready:
+                p.kill()
+                p.wait(timeout=30)
+                err = open(err_path).read()
+                return _row("sigterm_mid_serve", "child reaches SERVING_READY",
+                            f"never ready in 300s; stderr: {err[-200:]}", False)
+            _time.sleep(0.25)        # a few ticks: requests genuinely in flight
+            p.send_signal(_signal.SIGTERM)
+            out, _ = p.communicate(timeout=420)
+        except Exception:
+            p.kill()
+            raise
+    err = open(err_path).read()
+    drain = None
+    for line in out.splitlines():
+        if line.startswith("DRAIN "):
+            drain = json.loads(line[len("DRAIN "):])
+    if drain is None:
+        return _row("sigterm_mid_serve", "drain row emitted",
+                    f"rc={p.returncode} no DRAIN line; stderr: {err[-200:]}", False)
+    ok = (p.returncode == 143 and drain["rc"] == 143
+          and drain["finished"] >= 1 and drain["refused"] >= 1
+          and drain["finished"] + drain["refused"] == 8
+          and drain["in_flight_after"] == 0 and drain["pool_used_after"] == 0
+          and drain["full_budget"])
+    return _row("sigterm_mid_serve",
+                "in-flight drained (full budget), queued refused, exit 143",
+                f"rc={p.returncode} {drain}", ok)
+
+
 SCENARIOS = {
     "torn_save": scenario_torn_save,
+    "serve_drain": scenario_serve_drain,
     "truncate": lambda wd: scenario_corrupt_checkpoint(wd, "truncate"),
     "bitflip": lambda wd: scenario_corrupt_checkpoint(wd, "bitflip"),
     "all_corrupt": scenario_all_corrupt,

@@ -307,6 +307,70 @@ RUNGS = {
 }
 
 
+#: graft-serve latency-under-load rungs (ISSUE 14): each is a committed
+#: tools/serve_bench.py configuration, so the next chip window measures
+#: the serving curve for free. Rows carry the bench's own evidence
+#: columns — serve_lint / serve_cost_* (graft-audit price of the decode
+#: program actually served) and, via SERVE_TELEMETRY, per-tick span
+#: medians + drift — next to goodput and p50/p99 TTFT / per-token
+#: latency. The continuous-vs-static comparison row rides the b32 rung;
+#: chunked-prefill and speculation are isolated A/Bs on one knob each.
+SERVE_RUNGS = {
+    # the measured decode sweet spot (PERF.md decode sweep: batch 32):
+    # continuous vs static at equal offered load, the headline comparison
+    "serve_qps_b32": {"SERVE_MODE": "both", "SERVE_SLOTS": "32",
+                      "SERVE_QPS": "16", "SERVE_REQUESTS": "96",
+                      "SERVE_PROMPT": "64", "SERVE_NEW": "32"},
+    # chunked prefill A/B: every 4th prompt is 4x long; CHUNK=0 disables
+    # chunking (whole-prompt prefill ticks stall in-flight decodes)
+    "serve_qps_chunked_on": {"SERVE_MODE": "continuous", "SERVE_SLOTS": "8",
+                             "SERVE_QPS": "8", "SERVE_REQUESTS": "48",
+                             "SERVE_PROMPT": "64", "SERVE_NEW": "32",
+                             "SERVE_LONG_EVERY": "4", "SERVE_CHUNK": "16"},
+    "serve_qps_chunked_off": {"SERVE_MODE": "continuous", "SERVE_SLOTS": "8",
+                              "SERVE_QPS": "8", "SERVE_REQUESTS": "48",
+                              "SERVE_PROMPT": "64", "SERVE_NEW": "32",
+                              "SERVE_LONG_EVERY": "4", "SERVE_CHUNK": "0"},
+    # speculation A/B: KD-student drafter on/off at the same trace
+    "serve_qps_spec_on": {"SERVE_MODE": "continuous", "SERVE_SLOTS": "8",
+                          "SERVE_QPS": "8", "SERVE_REQUESTS": "48",
+                          "SERVE_PROMPT": "64", "SERVE_NEW": "32",
+                          "SERVE_SPEC": "1", "SERVE_SPEC_K": "4"},
+    "serve_qps_spec_off": {"SERVE_MODE": "continuous", "SERVE_SLOTS": "8",
+                           "SERVE_QPS": "8", "SERVE_REQUESTS": "48",
+                           "SERVE_PROMPT": "64", "SERVE_NEW": "32",
+                           "SERVE_SPEC": "0"},
+}
+
+
+def run_serve_rung(tag, serve_env, retry_evidence=None):
+    """One serving rung: tools/serve_bench.py in a clean subprocess (its
+    own engine + scheduler state; a wedged serve can't poison later
+    rungs), each of its JSON rows re-emitted with the rung tag and any
+    retry evidence. Never wrapped in `timeout` (serve_bench contract)."""
+    import subprocess
+    env = dict(os.environ)
+    env.setdefault("SERVE_MODEL", "350m")
+    env.setdefault("SERVE_TELEMETRY", "1")
+    env.update(serve_env)
+    p = subprocess.run([sys.executable,
+                        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                     "serve_bench.py")],
+                       env=env, capture_output=True, text=True)
+    emitted = 0
+    for line in p.stdout.splitlines():
+        if line.startswith("{"):
+            row = json.loads(line)
+            print(json.dumps(dict({"tag": tag}, **row,
+                                  **(retry_evidence or {}))), flush=True)
+            emitted += 1
+        elif line.startswith("#"):
+            print(line, flush=True)
+    if p.returncode != 0 or not emitted:
+        raise RuntimeError(f"serve rung {tag} failed rc={p.returncode}: "
+                           f"{p.stderr[-400:]}")
+
+
 def _frontier_rungs():
     """Rungs generated FROM the committed graft-search Pareto frontier
     (analysis_results/search_pareto.json, 350m_judged space): the next
@@ -410,8 +474,12 @@ def main():
                       f"{history[-1]['error_class'] or 'transient failure'}", flush=True)
 
         try:
-            policy.call(run_rung, tag, retry_evidence=evidence,
-                        before_attempt=attempt, **RUNGS[tag.strip()])
+            if tag.strip() in SERVE_RUNGS:
+                policy.call(run_serve_rung, tag, SERVE_RUNGS[tag.strip()],
+                            retry_evidence=evidence, before_attempt=attempt)
+            else:
+                policy.call(run_rung, tag, retry_evidence=evidence,
+                            before_attempt=attempt, **RUNGS[tag.strip()])
         except Exception as e:  # noqa: BLE001 — keep laddering past OOMs
             row = {"tag": tag, "error": f"{type(e).__name__}: {str(e)[:300]}"}
             cls = classify_failure(e)

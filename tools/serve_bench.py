@@ -1,17 +1,40 @@
-"""Serving decode throughput on the real chip — the inference-side
-companion to bench.py (the reference's inference benchmarks live in
-DeepSpeedExamples; its headline is fused-kernel decode speed).
+"""graft-serve bench: latency under load, not offline throughput.
 
-Measures decode tokens/s by DIFFERENCING: each round times generate()
-at ``NEW`` and at ``2*NEW`` new tokens with the same prompt shape — the
-prefill cost cancels in the difference, so the decode rate is isolated
-from the per-dispatch chunked prefill (whose timing the tunnel's dedupe
-cache can flatter, PERF.md session 3; the decode while_loop itself
-chains state token-by-token). End-to-end rate reports alongside.
+Replays one Poisson arrival trace at a target QPS through (a) the
+continuous in-flight batching scheduler (``inference/serving``) and (b)
+the pre-PR-14 static batcher (accumulate a fixed batch, run
+``engine.generate``), reporting per-mode p50/p99 time-to-first-token,
+p50/p99 per-token latency, and goodput (completed tokens per second of
+wall clock at the offered load). Both modes see the SAME trace, so the
+comparison row is apples-to-apples: the acceptance claim is that
+continuous batching beats static batching on goodput at equal offered
+load (PERF.md §PR14).
 
 Run: python tools/serve_bench.py    (background it; poll stdout)
-Env: SERVE_MODEL=350m SERVE_BATCH=8 SERVE_PROMPT=128 SERVE_NEW=128
-     SERVE_ROUNDS=3
+Env: SERVE_MODEL=test|125m|350m...   model family config
+     SERVE_MODE=continuous,static   comma list; "both" = the comparison
+     SERVE_QPS=4.0                  offered load (Poisson arrivals)
+     SERVE_REQUESTS=32              trace length
+     SERVE_PROMPT=64 SERVE_NEW=32   tokens per request
+     SERVE_NEW_JITTER=0             1 = ragged output budgets: max_new ~
+                                    U[NEW/4, NEW] per request (real traces
+                                    finish at different lengths — a static
+                                    batch decodes to its max while
+                                    continuous retires slots early)
+     SERVE_LONG_EVERY=0             every Nth request gets a 4x prompt
+                                    (continuous-only modes; exercises
+                                    chunked prefill under decode load)
+     SERVE_SLOTS=8                  decode slots (= static batch size)
+     SERVE_CHUNK=16                 prefill chunk (0 = prompt-sized, i.e.
+                                    chunked prefill OFF)
+     SERVE_SPEC=0 SERVE_SPEC_K=4    speculative decoding (KD student
+                                    drafter, half the target's layers)
+     SERVE_POOL_TOKENS=0            KV pool budget (0 = slots x context)
+     SERVE_TELEMETRY=0              per-tick spans + serve events to a
+                                    graft-trace JSONL run dir (drift
+                                    summary rides the continuous row)
+     SERVE_TELEMETRY_DIR=/tmp/ds_tpu_serve_telemetry
+     SERVE_SEED=0
 NEVER wrap in `timeout` — clean-exit only (PERF.md wedge lessons).
 """
 import json
@@ -25,10 +48,212 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))  # bench_core
 import numpy as np
 
 MODEL = os.environ.get("SERVE_MODEL", "350m")
-BATCH = int(os.environ.get("SERVE_BATCH", "8"))
-PROMPT = int(os.environ.get("SERVE_PROMPT", "128"))
-NEW = int(os.environ.get("SERVE_NEW", "128"))
-ROUNDS = int(os.environ.get("SERVE_ROUNDS", "3"))
+MODES = os.environ.get("SERVE_MODE", "both")
+QPS = float(os.environ.get("SERVE_QPS", "4.0"))
+REQUESTS = int(os.environ.get("SERVE_REQUESTS", "32"))
+PROMPT = int(os.environ.get("SERVE_PROMPT", "64"))
+NEW = int(os.environ.get("SERVE_NEW", "32"))
+LONG_EVERY = int(os.environ.get("SERVE_LONG_EVERY", "0"))
+NEW_JITTER = os.environ.get("SERVE_NEW_JITTER", "0") == "1"
+SLOTS = int(os.environ.get("SERVE_SLOTS", "8"))
+CHUNK = int(os.environ.get("SERVE_CHUNK", "16"))
+SPEC = os.environ.get("SERVE_SPEC", "0") == "1"
+SPEC_K = int(os.environ.get("SERVE_SPEC_K", "4"))
+POOL_TOKENS = int(os.environ.get("SERVE_POOL_TOKENS", "0"))
+TELEMETRY = os.environ.get("SERVE_TELEMETRY", "0") == "1"
+SEED = int(os.environ.get("SERVE_SEED", "0"))
+
+
+def build_engine(n_positions):
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+
+    cfg = get_gpt2_config(MODEL, n_positions=n_positions, dtype=None)
+    model = GPT2LMHeadModel(cfg)
+    engine = deepspeed_tpu.init_inference(model, replace_with_kernel_inject=True,
+                                          max_out_tokens=n_positions)
+    return engine, cfg
+
+
+def build_drafter(engine, cfg, n_positions):
+    """The speculation drafter: a layer-reduced KD student seeded from the
+    target's own layers (``compression.compress.student_initialization``)
+    — the in-tree half the ISSUE names; a trained student drops in the
+    same way."""
+    import jax
+    import flax.linen as nn
+    from deepspeed_tpu.compression.compress import student_initialization
+    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+
+    n_student = max(1, cfg.n_layer // 2)
+    # evenly spaced teacher layers seed the student (standard KD recipe)
+    teacher_layers = [int(round(i * (cfg.n_layer - 1) / max(n_student - 1, 1)))
+                      for i in range(n_student)]
+    dcfg = get_gpt2_config(MODEL, n_positions=n_positions, dtype=None,
+                           n_layer=n_student)
+    drafter = GPT2LMHeadModel(dcfg)
+    d_init = nn.meta.unbox(drafter.init(jax.random.PRNGKey(1),
+                                        np.zeros((1, 8), np.int32))["params"])
+    d_params = student_initialization(
+        d_init, jax.device_get(nn.meta.unbox(engine.params)),
+        {"compression_training": {"layer_reduction": {
+            "enabled": True, "module_name_prefix": "h",
+            "teacher_layer": teacher_layers,
+            "other_module_name": ["wte", "wpe", "ln_f"]}}})
+    return drafter, d_params, teacher_layers
+
+
+def poisson_trace(rng, vocab):
+    """[(arrival_offset_s, prompt, max_new)] — one trace shared by every
+    mode so offered load is identical across the comparison."""
+    gaps = rng.exponential(1.0 / QPS, REQUESTS)
+    arrivals = np.cumsum(gaps)
+    trace = []
+    for i in range(REQUESTS):
+        p = PROMPT * 4 if LONG_EVERY and (i + 1) % LONG_EVERY == 0 else PROMPT
+        prompt = rng.integers(0, vocab, (p,)).astype(np.int32)
+        n = int(rng.integers(max(NEW // 4, 1), NEW + 1)) if NEW_JITTER else NEW
+        trace.append((float(arrivals[i]), prompt, n))
+    return trace
+
+
+def _lat_row(hist):
+    if hist is None or (hasattr(hist, "count") and not hist.count):
+        return None
+    snap = hist.snapshot() if hasattr(hist, "snapshot") else hist
+    return {k: round(v, 4) for k, v in snap.items()
+            if k in ("p50", "p90", "p99", "min", "max", "mean")}
+
+
+def serve_evidence(engine, slots):
+    """Static lint + cost evidence for the decode program this run serves
+    (the perf-ladder contract: a banked latency row must prove its
+    program passes the same gates CI enforces)."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        from deepspeed_tpu import analysis
+        from deepspeed_tpu.analysis.memory import estimate_memory
+        from deepspeed_tpu.analysis.program import ProgramInfo
+        from deepspeed_tpu.inference.serving import make_slot_cache, resolve_kv_write
+        from deepspeed_tpu.inference.serving.programs import (build_decode_step,
+                                                              make_apply_fn)
+
+        slots = engine._pow2_bucket(slots)  # price the program actually served
+        cache = make_slot_cache(engine.module, slots)
+        decode = build_decode_step(make_apply_fn(engine.module, engine._mparams),
+                                   False, 1.0, 0, 1.0)
+        tokens = jnp.zeros((slots,), jnp.int32)
+        jaxpr = jax.make_jaxpr(decode)(engine.params, cache, tokens)
+        info = ProgramInfo(name="serve_decode", jaxpr=jaxpr, kind="serve_decode")
+        findings, _ = analysis.run_program_rules(info)
+        mem = estimate_memory(info)
+        mode, src = resolve_kv_write(None)
+        return {"serve_lint": analysis.summarize(findings),
+                "serve_cost_peak_bytes": mem.peak_bytes,
+                "serve_cost_transient_bytes": mem.peak_transient_bytes,
+                "serve_kv_write": mode, "serve_kv_write_source": src}
+    except Exception as e:  # evidence must never kill a run
+        return {"serve_evidence_error": f"{type(e).__name__}: {str(e)[:120]}"}
+
+
+def run_continuous(engine, cfg, trace, drafter=None, telemetry=None):
+    from deepspeed_tpu.inference.serving import (ContinuousBatchingScheduler,
+                                                 Request, ServingConfig)
+
+    n_positions = cfg.n_positions
+    scfg = ServingConfig(
+        slots=SLOTS, page_size=16,
+        kv_pool_tokens=POOL_TOKENS or None,
+        prefill_chunk=CHUNK if CHUNK > 0 else n_positions,
+        speculation={"enabled": drafter is not None, "k": SPEC_K})
+    sched = ContinuousBatchingScheduler(engine, scfg, drafter=drafter,
+                                        telemetry=telemetry)
+    # compile every serving program off the clock — including rare-path
+    # ones a warm request can't reliably reach, like the drafter's
+    # full-k refeed verify (latency-under-load must not charge a
+    # mid-serve request for XLA compile time)
+    sched.warmup()
+
+    t0 = time.monotonic()
+    i = 0
+    while i < len(trace) or sched.in_flight or len(sched.queue):
+        now = time.monotonic() - t0
+        while i < len(trace) and trace[i][0] <= now:
+            _, prompt, new = trace[i]
+            sched.submit(Request(prompt=prompt, max_new_tokens=new,
+                                 arrival_time=t0 + trace[i][0]))
+            i += 1
+        if sched.in_flight or len(sched.queue):
+            sched.step()
+        elif i < len(trace):
+            time.sleep(min(max(trace[i][0] - now, 0.0), 0.05))
+    wall = time.monotonic() - t0
+    stats = sched.stats()
+    row = {
+        "mode": "continuous", "wall_s": round(wall, 3),
+        "finished": stats["finished"], "refused": stats["refused"],
+        "goodput_tok_s": round(stats["generated_tokens"] / wall, 1),
+        "ttft": _lat_row(stats["ttft"]), "per_token": _lat_row(stats["per_token"]),
+        "ticks": stats["ticks"], "pool": stats["pool"],
+        "chunked_prefill": CHUNK > 0, "prefill_chunk": CHUNK or n_positions,
+        "slots": sched.slots,
+    }
+    if drafter is not None:
+        row["speculation"] = {"k": SPEC_K,
+                              "drafted": stats["drafted"],
+                              "accepted": stats["accepted"],
+                              "acceptance_rate": round(stats["acceptance_rate"], 3)
+                              if stats["acceptance_rate"] is not None else None}
+    if telemetry is not None and telemetry.enabled:
+        row["telemetry"] = telemetry.drift_summary()
+    return row
+
+
+def run_static(engine, cfg, trace):
+    """The pre-PR-14 baseline: accumulate arrivals into fixed batches of
+    ``SLOTS`` and run offline ``engine.generate`` per batch. Every token
+    of a request becomes available only when its whole batch finishes —
+    which is exactly the latency story continuous batching replaces."""
+    from deepspeed_tpu.runtime.telemetry import Histogram
+
+    # warm the generate programs off the clock at the REAL batch bucket
+    # (generate caches per pow2 bucket: a batch-1 warm would leave the
+    # timed flushes paying the SLOTS-bucket compile — same courtesy as
+    # continuous warming its own fixed-shape programs)
+    engine.generate(np.repeat(trace[0][1][None, :], SLOTS, axis=0),
+                    max_new_tokens=2)
+
+    ttft_h, tok_h = Histogram(), Histogram()
+    t0 = time.monotonic()
+    i, batch, finished, tokens_out = 0, [], 0, 0
+    while i < len(trace) or batch:
+        now = time.monotonic() - t0
+        while i < len(trace) and trace[i][0] <= now:
+            batch.append(trace[i])
+            i += 1
+        flush = len(batch) >= SLOTS or (batch and i >= len(trace))
+        if flush:
+            part, batch = batch[:SLOTS], batch[SLOTS:]
+            prompts = np.stack([p for _, p, _ in part])
+            new = max(n for _, _, n in part)
+            out = np.asarray(engine.generate(prompts, max_new_tokens=new))
+            done = time.monotonic() - t0
+            per_tok = (done - now) / max(new, 1)
+            for arr, _, n in part:
+                ttft_h.record(done - arr)   # first token only at batch end
+                for _ in range(n - 1):
+                    tok_h.record(per_tok)
+                finished += 1
+                tokens_out += n
+            del out
+        elif i < len(trace):
+            time.sleep(min(max(trace[i][0] - now, 0.0), 0.05))
+    wall = time.monotonic() - t0
+    return {"mode": "static", "wall_s": round(wall, 3), "finished": finished,
+            "refused": 0, "goodput_tok_s": round(tokens_out / wall, 1),
+            "ttft": _lat_row(ttft_h), "per_token": _lat_row(tok_h),
+            "batch": SLOTS}
 
 
 def main():
@@ -36,60 +261,72 @@ def main():
 
     from bench_core import enable_compile_cache
 
+    # knob incompatibilities are knowable from env alone — fail them
+    # BEFORE paying minutes of engine build + compile + continuous replay
+    modes = ["continuous", "static"] if MODES == "both" else MODES.split(",")
+    unknown = [m for m in modes if m not in ("continuous", "static")]
+    if unknown:
+        raise SystemExit(f"unknown SERVE_MODE entry {unknown[0]!r}")
+    if LONG_EVERY and "static" in modes:
+        raise SystemExit(
+            "static mode cannot batch ragged prompts (SERVE_LONG_EVERY): "
+            "the chunked-prefill A/B is continuous-only — use "
+            "SERVE_MODE=continuous")
+    if SPEC and "static" in modes:
+        print("# static mode ignores SERVE_SPEC (no speculation offline)",
+              flush=True)
+
     enable_compile_cache()
-    import deepspeed_tpu
-    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+    n_positions = max((PROMPT * 4 if LONG_EVERY else PROMPT) + NEW + 1, 128)
+    engine, cfg = build_engine(n_positions)
+    rng = np.random.default_rng(SEED)
+    trace = poisson_trace(rng, cfg.vocab_size)
 
-    cfg = get_gpt2_config(MODEL, n_positions=PROMPT + 2 * NEW, dtype=None)
-    model = GPT2LMHeadModel(cfg)
-    engine = deepspeed_tpu.init_inference(model, dtype="bf16",
-                                          replace_with_kernel_inject=True,
-                                          max_out_tokens=PROMPT + 2 * NEW)
-    rng = np.random.default_rng(0)
+    drafter = None
+    if SPEC and "continuous" in modes:
+        d_module, d_params, teacher_layers = build_drafter(engine, cfg, n_positions)
+        drafter = (d_module, d_params)
+        print(f"# drafter: {d_module.config.n_layer}-layer KD student seeded "
+              f"from teacher layers {teacher_layers}", flush=True)
 
-    def run(new_tokens):
-        prompts = rng.integers(0, cfg.vocab_size, (BATCH, PROMPT)).astype(np.int32)
-        t0 = time.time()
-        out = np.asarray(engine.generate(prompts, max_new_tokens=new_tokens))
-        dt = time.time() - t0
-        assert out.shape == (BATCH, PROMPT + new_tokens)
-        return dt
+    telemetry = None
+    if TELEMETRY:
+        from deepspeed_tpu.runtime.config import TelemetryConfig
+        from deepspeed_tpu.runtime.telemetry import RuntimeTelemetry
+        telemetry = RuntimeTelemetry(TelemetryConfig(
+            enabled=True,
+            output_path=os.environ.get("SERVE_TELEMETRY_DIR",
+                                       "/tmp/ds_tpu_serve_telemetry"),
+            job_name=f"serve_{MODEL}_qps{QPS}"))
+        telemetry.write_run_header({"bench": "serve_bench", "model": MODEL,
+                                    "qps": QPS, "slots": SLOTS})
 
-    t0 = time.time()
-    run(NEW)
-    run(2 * NEW)  # compile both programs
-    compile_s = time.time() - t0
-
-    # latency distributions ride the telemetry Histogram (fixed buckets,
-    # mergeable) — the same type the continuous-batching latency-under-load
-    # successor (ROADMAP 1) will aggregate across request streams
-    from deepspeed_tpu.runtime.telemetry import Histogram
-    lat_short, lat_long = Histogram(), Histogram()
-    short, long_ = [], []
-    for r in range(ROUNDS):
-        short.append(run(NEW))
-        lat_short.record(short[-1])
-        long_.append(run(2 * NEW))
-        lat_long.record(long_[-1])
-    d_short, d_long = float(np.median(short)), float(np.median(long_))
-    # prefill cancels in the difference; decode rate from the extra NEW tokens
-    decode_dt = max(d_long - d_short, 1e-9)
-    decode_tok_s = BATCH * NEW / decode_dt
-    e2e_tok_s = BATCH * NEW / d_short
-    print(json.dumps({
-        "model": MODEL, "batch": BATCH, "prompt": PROMPT, "new": NEW,
-        "decode_tokens_per_s": round(decode_tok_s, 1),
-        "decode_ms_per_token": round(decode_dt / NEW * 1e3, 2),
-        "e2e_tokens_per_s_incl_prefill": round(e2e_tok_s, 1),
-        "round_s_short": [round(t, 3) for t in short],
-        "round_s_long": [round(t, 3) for t in long_],
-        "latency_short": {k: round(v, 4) for k, v in lat_short.snapshot().items()
-                          if k in ("p50", "p90", "p99", "min", "max", "mean")},
-        "latency_long": {k: round(v, 4) for k, v in lat_long.snapshot().items()
-                         if k in ("p50", "p90", "p99", "min", "max", "mean")},
-        "compile_s": round(compile_s, 1),
-        "backend": jax.default_backend(),
-    }), flush=True)
+    rows = {}
+    header = {"model": MODEL, "qps": QPS, "requests": REQUESTS, "prompt": PROMPT,
+              "new": NEW, "new_jitter": NEW_JITTER, "long_every": LONG_EVERY,
+              "slots": SLOTS, "backend": jax.default_backend(), "seed": SEED}
+    for mode in modes:
+        if mode == "continuous":
+            row = run_continuous(engine, cfg, trace, drafter=drafter,
+                                 telemetry=telemetry)
+            row.update(serve_evidence(engine, SLOTS))
+        else:
+            row = run_static(engine, cfg, trace)
+        rows[mode] = dict(header, **row)
+        print(json.dumps(rows[mode]), flush=True)
+    if telemetry is not None:
+        telemetry.close()
+    if "continuous" in rows and "static" in rows:
+        c, s = rows["continuous"], rows["static"]
+        comparison = {
+            "comparison": "continuous_vs_static", "qps": QPS,
+            "goodput_ratio": round(c["goodput_tok_s"] / max(s["goodput_tok_s"], 1e-9), 3),
+            "ttft_p99_ratio": (round(c["ttft"]["p99"] / s["ttft"]["p99"], 3)
+                               if c.get("ttft") and s.get("ttft") else None),
+            "continuous_beats_static_goodput":
+                c["goodput_tok_s"] > s["goodput_tok_s"],
+        }
+        print(json.dumps(comparison), flush=True)
     return 0
 
 
