@@ -134,16 +134,25 @@ class DSElasticAgent:
         max_restarts: give up after this many restarts.
         env: extra environment for the child.
         on_restart: callback ``(restart_count, world_size) -> None``.
+        checkpoint_dir: the job's checkpoint dir. When set, every attempt's
+            history row records the old→new topology transition — the
+            stamped world size of the newest intact tag vs the attempt's
+            target world — and whether the relaunch resumes plain,
+            reshards (graft-elastic ``resume_elastic``), or starts fresh.
+            Read from ``metadata.json`` stamps only: the supervisor never
+            opens checkpoint state (and never initializes jax).
     """
 
     def __init__(self, cmd: Sequence[str], world_sizes: Sequence[int],
                  heartbeat_timeout: float = 60.0, max_restarts: int = 3,
                  env: Optional[dict] = None, poll_interval: float = 0.5,
                  startup_timeout: Optional[float] = None,
-                 on_restart: Optional[Callable[[int, int], None]] = None):
+                 on_restart: Optional[Callable[[int, int], None]] = None,
+                 checkpoint_dir: Optional[str] = None):
         assert world_sizes, "world_sizes ladder must be non-empty"
         self.cmd = list(cmd)
         self.world_sizes = list(world_sizes)
+        self.checkpoint_dir = checkpoint_dir
         self.heartbeat_timeout = float(heartbeat_timeout)
         # a child cannot heartbeat until backend init + first-step compile
         # finish (minutes on a cold cache) — the staleness clock before the
@@ -218,12 +227,32 @@ class DSElasticAgent:
             except OSError:
                 pass
 
+    def _resume_decision(self, world: int) -> Optional[Dict]:
+        """How this attempt will come back up (plain / reshard / fresh),
+        from checkpoint metadata stamps alone. None without a
+        ``checkpoint_dir``; never raises — a supervisor's bookkeeping must
+        not take down a restartable job."""
+        if not self.checkpoint_dir:
+            return None
+        try:
+            from deepspeed_tpu.runtime.elastic.agent import decide_resume
+            return decide_resume(self.checkpoint_dir, world)
+        except Exception as e:  # noqa: BLE001 — diagnostics only
+            logger.warning(f"elastic agent: cannot read checkpoint topology: {e}")
+            return None
+
     def _run(self, heartbeat_path: str) -> int:
+        prev_world: Optional[int] = None
         while True:
             idx = min(self.restart_count, len(self.world_sizes) - 1)
             world = self.world_sizes[idx]
+            decision = self._resume_decision(world)
             logger.info(f"elastic agent: launching attempt {self.restart_count + 1} "
-                     f"at world size {world}")
+                     f"at world size {world}"
+                     + (f" ({decision['resume']} resume from tag {decision['tag']}"
+                        + (f", reshard {decision['ckpt_world']} -> {world}"
+                           if decision["resume"] == "reshard" else "")
+                        + ")" if decision else ""))
             t0 = time.time()
             proc = self._spawn(world, heartbeat_path)
             armed_mtime = os.path.getmtime(heartbeat_path)
@@ -264,11 +293,23 @@ class DSElasticAgent:
             hb = read_heartbeat(heartbeat_path)
             if hb and hb.get("pid") == os.getpid():
                 hb = None  # our own arm-touch record: the child never reported
-            progress = ({k: hb[k] for k in ("global_step", "last_span", "pid")
+            progress = ({k: hb[k] for k in ("global_step", "last_span", "pid",
+                                            "world_size", "mesh_axes")
                          if k in hb} if hb else None)
-            self.history.append(dict(world_size=world, rc=rc, reason=reason,
-                                     duration_s=round(time.time() - t0, 2),
-                                     last_heartbeat=progress))
+            row = dict(world_size=world, rc=rc, reason=reason,
+                       duration_s=round(time.time() - t0, 2),
+                       last_heartbeat=progress)
+            # old→new topology record: what this attempt resumed from and
+            # how (plain / reshard / fresh) — restart logs and post-mortems
+            # narrate fleet reshapes, not just exit codes. The row always
+            # carries the full documented key set; without a checkpoint_dir
+            # the decision fields stay None (resume mode unobservable).
+            topo = dict(prev_world_size=prev_world, world_size=world,
+                        resume=None, tag=None, ckpt_world=None, ckpt_axes=None)
+            topo.update(decision or {})
+            row["topology"] = topo
+            self.history.append(row)
+            prev_world = world
             if rc == 0:
                 logger.info(f"elastic agent: job finished at world size {world}")
                 return 0

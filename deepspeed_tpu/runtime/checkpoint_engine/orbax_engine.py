@@ -38,7 +38,7 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         self.base_dir = os.path.abspath(base_dir)
         self.use_async = use_async
         self._ckptr = ocp.StandardCheckpointer()
-        self._staged = {}  # tag -> (staging_dir, leaf-checksum source tree)
+        self._staged = {}  # tag -> (staging_dir, leaf-checksum source tree, layout)
 
     def _path(self, tag):
         return os.path.join(self.base_dir, str(tag))
@@ -50,19 +50,23 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         staged = self._staged.get(str(tag))
         return staged[0] if staged else None
 
-    def save(self, state, tag, metadata: Optional[dict] = None, defer_finalize: bool = False):
+    def save(self, state, tag, metadata: Optional[dict] = None, defer_finalize: bool = False,
+             layout: Optional[dict] = None):
         """Stage ``tag``. Published atomically by ``finalize`` — which this
         call performs itself unless ``defer_finalize`` (caller has extra
         files to stage; it must then finalize before the state is donated
         to another train step — the engine's sync path does) or
-        ``use_async`` (durability lands at ``commit``)."""
+        ``use_async`` (durability lands at ``commit``). ``layout``: the
+        graft-elastic layout manifest (per-leaf logical shape/dtype/spec +
+        mesh axes — ``runtime/elastic/layout.py``), stamped into the tag's
+        integrity manifest so any world size can plan a resume against it."""
         tag = str(tag)
         staging = ckpt_manifest.staging_path(self.base_dir, tag)
         if jax.process_index() == 0:
             # rank-0 only, excluding every dir THIS engine still has in
             # flight (this tag plus any deferred/async-pending ones):
             # another rank's collective write may be populating them
-            in_flight = {staging} | {s for s, _ in self._staged.values()}
+            in_flight = {staging} | {s[0] for s in self._staged.values()}
             ckpt_manifest.sweep_stale_staging(self.base_dir, exclude=in_flight)
         single_process = jax.process_count() == 1
         if self.use_async and single_process:
@@ -101,7 +105,7 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         if metadata is not None and jax.process_index() == 0:
             with open(os.path.join(staging, "metadata.json"), "w") as f:
                 json.dump(metadata, f)
-        self._staged[tag] = (staging, leaf_src)
+        self._staged[tag] = (staging, leaf_src, layout)
         log_dist(f"saved checkpoint {tag} -> staged at {staging}"
                  + (" (async, pending commit)" if self.use_async else ""))
         if not defer_finalize and not self.use_async:
@@ -113,13 +117,15 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         Multi-process: rank 0 owns the publish (all ranks staged into the
         same shared-fs dir); callers barrier around this."""
         tag = str(tag)
-        staging, leaf_src = self._staged.pop(tag)
+        staging, leaf_src, layout = self._staged.pop(tag)
         if jax.process_index() != 0:
             return
         leaf_entries = (ckpt_manifest.state_leaf_entries(leaf_src)
                         if leaf_src is not None else None)
         ckpt_manifest.write_manifest(
-            staging, ckpt_manifest.build_manifest(staging, leaf_entries=leaf_entries))
+            staging, ckpt_manifest.build_manifest(
+                staging, leaf_entries=leaf_entries,
+                extra={"layout": layout} if layout is not None else None))
         fault_point("ckpt_pre_rename")  # torn-save injection: die between staging and publish
         ckpt_manifest.atomic_publish(staging, self._path(tag))
         log_dist(f"published checkpoint {tag} -> {self._path(tag)}")

@@ -1,0 +1,47 @@
+"""graft-elastic: world-size-independent checkpoints + reshard-on-resume.
+
+PR 9 proved kill-and-resume is bit-exact at a *fixed* world size. This
+subsystem breaks the coupling between a checkpoint and the mesh that
+wrote it, so a preemptible fleet that loses or gains hosts resumes
+training at the surviving world size without human intervention:
+
+* :mod:`layout` — every checkpoint manifest stamps each leaf's *logical*
+  global shape, dtype and :class:`~jax.sharding.PartitionSpec` against
+  named mesh axes, making every published tag world-size-independent by
+  construction;
+* :mod:`planner` — pure-host reshard planning: given a source layout and
+  a target mesh, per-leaf slice-assembly plans (which saved shard
+  ranges feed which target shards), with loud refusals on axes the plan
+  cannot satisfy — unit-testable on CPU with virtual meshes, no chip
+  time, no jax import;
+* :mod:`resume` — ``DeepSpeedEngine.resume_elastic()``: verified load
+  (PR 9 corruption fallback), the reshard plan priced and validated
+  *before* the restore pays for anything, step/RNG/loss-scale/LR
+  restored on the new mesh, every restored leaf re-hashed against its
+  save-time digest (the digest is over the logical global array, so the
+  check proves the reshard bit-exact end to end);
+* :mod:`agent` — jax-free decision helpers for ``DSElasticAgent``:
+  read a checkpoint dir's stamped topology (metadata only, the state is
+  never opened) and decide plain-resume vs reshard vs fresh start.
+"""
+
+from deepspeed_tpu.runtime.elastic.planner import (  # noqa: F401
+    LeafPlan,
+    ReshardPlan,
+    ReshardRefusal,
+    assemble,
+    plan_leaf,
+    plan_reshard,
+    shard_array,
+    unshard,
+)
+from deepspeed_tpu.runtime.elastic.agent import (  # noqa: F401
+    checkpoint_topology,
+    decide_resume,
+)
+
+__all__ = [
+    "LeafPlan", "ReshardPlan", "ReshardRefusal", "assemble", "plan_leaf",
+    "plan_reshard", "shard_array", "unshard", "checkpoint_topology",
+    "decide_resume",
+]

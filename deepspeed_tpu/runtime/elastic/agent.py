@@ -1,0 +1,68 @@
+"""jax-free elastic-agent decision helpers (graft-elastic).
+
+``DSElasticAgent`` is a launcher-level supervisor that must stay alive
+when the accelerator backend is exactly what is hung — so it never
+imports jax (``elasticity/elastic_agent.py`` docstring). These helpers
+give it topology awareness on the same terms: every checkpoint tag's
+``metadata.json`` carries the writer's ``world_size`` + ``mesh_axes``
+stamp (``engine.save_checkpoint``), so the reshard-vs-plain-resume
+decision reads a few hundred bytes of JSON and never opens the state.
+"""
+
+import os
+from typing import Dict, Optional
+
+from deepspeed_tpu.runtime.elastic.layout import normalized_axes
+from deepspeed_tpu.runtime.resilience.manifest import list_checkpoint_tags
+
+
+def checkpoint_topology(base_dir: str, tag: Optional[str] = None) -> Optional[Dict]:
+    """The stamped topology of ``tag`` (default: the ``latest`` marker,
+    else the newest tag) under ``base_dir`` — ``{"tag", "global_steps",
+    "world_size", "mesh_axes"}`` (the ``with_meta`` entry shape of
+    ``list_checkpoint_tags``, single source of the stamp parsing) — or
+    None when no published tag exists. ``world_size`` is None for tags
+    saved before graft-elastic."""
+    entries = {e["tag"]: e for e in list_checkpoint_tags(base_dir, with_meta=True)}
+    if not entries:
+        return None
+    if tag is None:
+        newest = next(iter(entries))
+        try:
+            with open(os.path.join(base_dir, "latest")) as f:
+                marker = f.read().strip()
+            tag = marker if marker in entries else newest
+        except OSError:
+            tag = newest
+    return entries.get(tag)
+
+
+def decide_resume(base_dir: Optional[str], target_world: int,
+                  target_axes: Optional[Dict[str, int]] = None) -> Dict:
+    """How the next attempt at ``target_world`` will come back up:
+    ``fresh`` (no checkpoint), ``plain`` (same topology — the bit-exact
+    PR 9 path), ``reshard`` (world/axes changed — ``resume_elastic``
+    replans the layout), or ``unknown`` (pre-elastic checkpoint without a
+    topology stamp — the restore will be unplanned). An equal world size
+    reads as ``plain`` unless ``target_axes`` says otherwise — pass the
+    child's axis split when it can vary at constant world size, or the
+    supervisor's narration will under-report a same-world resharding
+    (``resume_elastic`` itself always re-derives the truth from the
+    layout manifest)."""
+    decision = {"resume": "fresh", "tag": None, "ckpt_world": None,
+                "ckpt_axes": None, "world_size": int(target_world)}
+    info = checkpoint_topology(base_dir) if base_dir else None
+    if info is None:
+        return decision
+    decision.update(tag=info["tag"], ckpt_world=info["world_size"],
+                    ckpt_axes=info["mesh_axes"])
+    if info["world_size"] is None:
+        decision["resume"] = "unknown"
+    elif info["world_size"] != int(target_world):
+        decision["resume"] = "reshard"
+    elif (target_axes is not None and info["mesh_axes"] is not None
+          and normalized_axes(target_axes) != normalized_axes(info["mesh_axes"])):
+        decision["resume"] = "reshard"  # same world, different axis split
+    else:
+        decision["resume"] = "plain"
+    return decision

@@ -1,0 +1,96 @@
+"""Checkpoint layout manifests (graft-elastic).
+
+A layout records, for every leaf of the engine's ``TrainState``, the
+*logical* contract a restore at any world size needs: global shape,
+dtype, and the :class:`~jax.sharding.PartitionSpec` that mapped the leaf
+onto named mesh axes — plus the mesh axis sizes and world size of the
+writer. It rides each checkpoint tag's ``manifest.json`` (PR 9) under
+the ``"layout"`` key, next to the per-leaf digests; because those
+digests hash the *logical global* array (layout-stable, C-contiguous —
+``manifest.state_leaf_entries``), layout + digests together make every
+published tag world-size-independent **and** reshard-verifiable by
+construction.
+
+Everything here serializes to plain JSON; :mod:`planner` consumes the
+dicts without importing jax.
+"""
+
+from typing import Dict, Optional
+
+from deepspeed_tpu.runtime.elastic.planner import LAYOUT_VERSION, _norm_spec
+
+
+def spec_entries(spec, ndim: int):
+    """Serialize a PartitionSpec: one entry per dimension — ``None`` or a
+    list of mesh-axis names (JSON-stable; tuples become lists). Single
+    source with the planner's parser (:func:`planner._norm_spec`), so the
+    manifest can never serialize a form the plan side cannot read."""
+    return _norm_spec(list(spec), ndim)
+
+
+def mesh_axes_of(mesh) -> Dict[str, int]:
+    return {str(a): int(s) for a, s in mesh.shape.items()}
+
+
+def normalized_axes(mesh_axes: Optional[Dict[str, int]]) -> Dict[str, int]:
+    """Axis sizes with the size-1 axes dropped — what actually shards
+    data. Two meshes with equal normalized axes (and world size) hold
+    bit-identical placements for every spec."""
+    return {str(a): int(s) for a, s in (mesh_axes or {}).items() if int(s) > 1}
+
+
+def same_topology(a: Optional[dict], b: Optional[dict]) -> bool:
+    """Do two layouts (or ``{"mesh_axes", "world_size"}`` stamps) describe
+    the same sharding topology? Conservative on missing data: unknown is
+    never "same"."""
+    if not a or not b:
+        return False
+    if a.get("world_size") != b.get("world_size"):
+        return False
+    return normalized_axes(a.get("mesh_axes")) == normalized_axes(b.get("mesh_axes"))
+
+
+def build_layout(state, shardings, mesh) -> dict:
+    """The layout manifest for a concrete state pytree + its shardings on
+    ``mesh``. Leaf keys are ``jax.tree_util.keystr`` paths — the same keys
+    the integrity manifest's per-leaf digests use, so a reader can join
+    the two tables."""
+    import jax
+
+    flat_state = jax.tree_util.tree_flatten_with_path(state)[0]
+    flat_shard = jax.tree_util.tree_flatten_with_path(shardings)[0]
+    assert len(flat_state) == len(flat_shard), (
+        f"state/sharding trees disagree: {len(flat_state)} vs {len(flat_shard)} leaves")
+    leaves = {}
+    for (path, leaf), (_, shard) in zip(flat_state, flat_shard):
+        shape = tuple(int(n) for n in getattr(leaf, "shape", ()))
+        spec = getattr(shard, "spec", None)
+        leaves[jax.tree_util.keystr(path)] = {
+            "shape": list(shape),
+            "dtype": str(getattr(leaf, "dtype", "")),
+            "spec": spec_entries(spec, len(shape)) if spec is not None else [None] * len(shape),
+        }
+    return {
+        "version": LAYOUT_VERSION,
+        "world_size": int(mesh.devices.size),
+        "mesh_axes": mesh_axes_of(mesh),
+        "leaves": leaves,
+    }
+
+
+def engine_layout(engine) -> dict:
+    """The layout of a live engine's current state (the reshard *target*
+    at resume time, the stamped layout at save time)."""
+    assert engine.state is not None, "initialize_state must run before layout stamping"
+    return build_layout(engine.state, engine.state_shardings, engine.mesh)
+
+
+def layout_from_manifest(manifest: Optional[dict]) -> Optional[dict]:
+    """The layout block of a checkpoint manifest, or None for tags saved
+    before graft-elastic (restores stay possible, just unplanned)."""
+    if not manifest:
+        return None
+    layout = manifest.get("layout")
+    if layout and int(layout.get("version", -1)) == LAYOUT_VERSION:
+        return layout
+    return None

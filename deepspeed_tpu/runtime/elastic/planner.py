@@ -1,0 +1,274 @@
+"""Pure-host reshard planner (graft-elastic).
+
+Given a *source* checkpoint layout (per-leaf logical shape/dtype +
+PartitionSpec against named mesh axes — :mod:`layout`) and a *target*
+layout, emit per-leaf **slice-assembly plans**: which source shard
+ranges feed which target shards. Planning is index arithmetic over
+virtual shard grids — no jax import, no devices, no chip time — so the
+whole contract is provable with numpy on CPU (property tests in
+``tests/unit/elastic/test_reshard_planner.py``) and the production
+resume path can validate + price a reshard *before* paying for any
+deserialization.
+
+Semantics:
+
+* A leaf's shard grid is ``shape[d] / prod(mesh_axes[a] for a in
+  spec[d])`` per dimension — even chunking only. An axis size that does
+  not divide its dimension is a :class:`ReshardRefusal`, never a silent
+  pad (the engine's own sharding planner only emits divisible specs, so
+  a refusal here means the *request* is unsatisfiable — e.g. an expert
+  axis larger than the expert count).
+* A plan is feasible iff the source and target layouts agree on the
+  leaf set and on every leaf's logical shape + dtype. World size, axis
+  names and axis sizes are free to differ — that is the point.
+* ``gather_bytes`` is the deterministic cost proxy the telemetry and
+  the R013 ratchet ride: bytes that land on a target shard whose grid
+  coordinate differs from the source shard they came from. Zero iff the
+  layouts chunk a leaf identically; when the grids differ in shape,
+  pieces whose coordinates still coincide (e.g. target shard 0 of a
+  split reading from source shard 0) stay excluded — a 4→8 split of one
+  dimension therefore moves exactly 7/8 of the leaf's bytes.
+"""
+
+import dataclasses
+import itertools
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+LAYOUT_VERSION = 1
+
+
+class ReshardRefusal(RuntimeError):
+    """The reshard plan cannot be satisfied (uneven divisor, unknown mesh
+    axis, leaf-set or shape/dtype drift between source and target).
+    Raised *before* any restore work — a refused resume touches nothing.
+    ``problems`` lists every violation, not just the first."""
+
+    def __init__(self, problems: Sequence[str]):
+        self.problems = list(problems)
+        head = "; ".join(self.problems[:6])
+        more = f" (+{len(self.problems) - 6} more)" if len(self.problems) > 6 else ""
+        super().__init__(f"reshard plan refused: {head}{more}")
+
+
+def _norm_spec(spec, ndim: int) -> List[Optional[List[str]]]:
+    """Normalize a serialized PartitionSpec to one entry per dimension:
+    ``None`` (unsharded) or a list of mesh-axis names."""
+    entries = list(spec or [])
+    if len(entries) > ndim:
+        raise ReshardRefusal([f"spec {spec!r} has more entries than array rank {ndim}"])
+    entries += [None] * (ndim - len(entries))
+    out: List[Optional[List[str]]] = []
+    for e in entries:
+        if e is None:
+            out.append(None)
+        elif isinstance(e, str):
+            out.append([e])
+        else:
+            out.append([str(a) for a in e])
+    return out
+
+
+def _grid(key: str, shape: Sequence[int], spec, mesh_axes: Dict[str, int],
+          problems: List[str]) -> Optional[Tuple[int, ...]]:
+    """Shards per dimension for one leaf, collecting refusals."""
+    entries = _norm_spec(spec, len(shape))
+    grid = []
+    ok = True
+    for dim, (n, axes) in enumerate(zip(shape, entries)):
+        shards = 1
+        for a in axes or []:
+            size = mesh_axes.get(a)
+            if size is None:
+                problems.append(f"{key}: dim {dim} sharded over unknown mesh axis "
+                                f"{a!r} (mesh has {sorted(mesh_axes)})")
+                ok = False
+                continue
+            shards *= int(size)
+        if shards > 1 and (n == 0 or n % shards != 0):
+            problems.append(f"{key}: dim {dim} of size {n} not divisible by "
+                            f"{shards} shards ({axes})")
+            ok = False
+        grid.append(max(shards, 1))
+    return tuple(grid) if ok else None
+
+
+def _dim_overlaps(n: int, src_shards: int, dst_shards: int):
+    """Per destination chunk index: ``[(src_index, src_range, dst_range)]``
+    where ranges are (start, stop) *within* the respective chunk."""
+    cs, cd = n // src_shards, n // dst_shards
+    out = []
+    for j in range(dst_shards):
+        lo, hi = j * cd, (j + 1) * cd
+        pieces = []
+        for i in range(lo // cs, (hi - 1) // cs + 1):
+            g0, g1 = max(lo, i * cs), min(hi, (i + 1) * cs)
+            pieces.append((i, (g0 - i * cs, g1 - i * cs), (g0 - lo, g1 - lo)))
+        out.append(pieces)
+    return out
+
+
+@dataclasses.dataclass
+class LeafPlan:
+    """Slice assembly for one leaf: for every target shard coordinate, the
+    (source coordinate, source slices, target slices) pieces feeding it."""
+
+    key: str
+    shape: Tuple[int, ...]
+    dtype: str
+    src_grid: Tuple[int, ...]
+    dst_grid: Tuple[int, ...]
+    #: per-dimension overlap tables (cross product = the full piece list)
+    dim_overlaps: List[list]
+
+    @property
+    def itemsize(self) -> int:
+        import numpy as np
+        return np.dtype(self.dtype).itemsize
+
+    @property
+    def total_bytes(self) -> int:
+        return int(math.prod(self.shape)) * self.itemsize
+
+    def pieces(self, dst_coord: Tuple[int, ...]):
+        """Iterate ``(src_coord, src_slices, dst_slices)`` for one target
+        shard — ``slices`` are tuples of python ``slice`` objects."""
+        per_dim = [self.dim_overlaps[d][j] for d, j in enumerate(dst_coord)]
+        for combo in itertools.product(*per_dim):
+            src_coord = tuple(p[0] for p in combo)
+            src_sl = tuple(slice(*p[1]) for p in combo)
+            dst_sl = tuple(slice(*p[2]) for p in combo)
+            yield src_coord, src_sl, dst_sl
+
+    def dst_coords(self):
+        return itertools.product(*[range(s) for s in self.dst_grid])
+
+    def gather_bytes(self) -> int:
+        """Bytes arriving on a target shard from a *different* source shard
+        grid coordinate — the wire-cost proxy the resume telemetry and
+        R013 ratchet record. Identical grids short-circuit to 0; differing
+        grids still exclude coordinate-aligned pieces (module docstring:
+        a 4→8 one-dim split moves 7/8 of the bytes, not all of them)."""
+        if self.src_grid == self.dst_grid:
+            return 0  # identical chunking: every piece is the aligned shard
+        item = self.itemsize
+        moved = 0
+        for dst_coord in self.dst_coords():
+            for src_coord, src_sl, _ in self.pieces(dst_coord):
+                if src_coord != dst_coord:
+                    moved += item * math.prod(s.stop - s.start for s in src_sl)
+        return moved
+
+
+@dataclasses.dataclass
+class ReshardPlan:
+    src_axes: Dict[str, int]
+    dst_axes: Dict[str, int]
+    leaves: Dict[str, LeafPlan]
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(p.total_bytes for p in self.leaves.values())
+
+    @property
+    def gather_bytes(self) -> int:
+        return sum(p.gather_bytes() for p in self.leaves.values())
+
+    def summary(self) -> dict:
+        return {"leaves": len(self.leaves), "total_bytes": self.total_bytes,
+                "gather_bytes": self.gather_bytes,
+                "src_axes": dict(self.src_axes), "dst_axes": dict(self.dst_axes)}
+
+
+def plan_leaf(key: str, shape: Sequence[int], dtype: str,
+              src_spec, src_axes: Dict[str, int],
+              dst_spec, dst_axes: Dict[str, int]) -> LeafPlan:
+    """Plan one leaf's reshard; raises :class:`ReshardRefusal`."""
+    problems: List[str] = []
+    src_grid = _grid(key, shape, src_spec, src_axes, problems)
+    dst_grid = _grid(key, shape, dst_spec, dst_axes, problems)
+    if problems:
+        raise ReshardRefusal(problems)
+    overlaps = [_dim_overlaps(n, s, d) if n else [[]]
+                for n, s, d in zip(shape, src_grid, dst_grid)]
+    return LeafPlan(key=key, shape=tuple(int(n) for n in shape), dtype=str(dtype),
+                    src_grid=src_grid, dst_grid=dst_grid, dim_overlaps=overlaps)
+
+
+def plan_reshard(src_layout: dict, dst_layout: dict) -> ReshardPlan:
+    """Plan a full state reshard between two layouts (the dicts
+    :func:`layout.build_layout` produces / checkpoint manifests carry).
+    Refuses — listing every violation — on leaf-set drift, shape/dtype
+    drift, unknown axes, or uneven divisors."""
+    problems: List[str] = []
+    for side, lo in (("source", src_layout), ("target", dst_layout)):
+        if int(lo.get("version", -1)) != LAYOUT_VERSION:
+            problems.append(f"{side} layout version {lo.get('version')!r} != {LAYOUT_VERSION}")
+    if problems:
+        raise ReshardRefusal(problems)
+    src_leaves, dst_leaves = src_layout["leaves"], dst_layout["leaves"]
+    missing = sorted(set(dst_leaves) - set(src_leaves))
+    extra = sorted(set(src_leaves) - set(dst_leaves))
+    problems += [f"leaf {k} missing from the source checkpoint" for k in missing[:8]]
+    problems += [f"source leaf {k} has no home in the target state" for k in extra[:8]]
+    plans: Dict[str, LeafPlan] = {}
+    src_axes = {str(a): int(s) for a, s in (src_layout.get("mesh_axes") or {}).items()}
+    dst_axes = {str(a): int(s) for a, s in (dst_layout.get("mesh_axes") or {}).items()}
+    for key in sorted(set(src_leaves) & set(dst_leaves)):
+        s, d = src_leaves[key], dst_leaves[key]
+        if list(s["shape"]) != list(d["shape"]) or str(s["dtype"]) != str(d["dtype"]):
+            problems.append(f"{key}: logical {s['shape']}/{s['dtype']} in the source "
+                            f"!= {d['shape']}/{d['dtype']} in the target (the param "
+                            f"tree changed — use the universal checkpoint path)")
+            continue
+        try:
+            plans[key] = plan_leaf(key, s["shape"], s["dtype"], s.get("spec"),
+                                   src_axes, d.get("spec"), dst_axes)
+        except ReshardRefusal as e:
+            problems += e.problems
+    if problems:
+        raise ReshardRefusal(problems)
+    return ReshardPlan(src_axes=src_axes, dst_axes=dst_axes, leaves=plans)
+
+
+# -- host-side plan execution (tests + npy extras) ---------------------------
+
+def shard_array(arr, spec, mesh_axes: Dict[str, int], key: str = "<leaf>"):
+    """Split a full array into its shard dict ``{coord: subarray}`` under a
+    layout — the host-side model of a sharded placement."""
+    problems: List[str] = []
+    grid = _grid(key, arr.shape, spec, mesh_axes, problems)
+    if problems:
+        raise ReshardRefusal(problems)
+    shards = {}
+    for coord in itertools.product(*[range(g) for g in grid]):
+        sl = tuple(slice(c * (n // g), (c + 1) * (n // g))
+                   for c, n, g in zip(coord, arr.shape, grid))
+        shards[coord] = arr[sl]
+    return shards, grid
+
+
+def assemble(plan: LeafPlan, src_shards) -> Dict[Tuple[int, ...], "object"]:
+    """Execute one leaf's plan against host source shards: returns the
+    target shard dict. Bit-exact by construction — pure slice copies."""
+    import numpy as np
+    chunk = tuple(n // g for n, g in zip(plan.shape, plan.dst_grid))
+    out = {}
+    for dst_coord in plan.dst_coords():
+        dst = np.empty(chunk, dtype=plan.dtype)
+        for src_coord, src_sl, dst_sl in plan.pieces(dst_coord):
+            dst[dst_sl] = src_shards[src_coord][src_sl]
+        out[dst_coord] = dst
+    return out
+
+
+def unshard(shards, grid: Sequence[int], shape: Sequence[int]):
+    """Reassemble a shard dict into the full logical array."""
+    import numpy as np
+    first = next(iter(shards.values()))
+    full = np.empty(tuple(shape), dtype=first.dtype)
+    for coord, piece in shards.items():
+        sl = tuple(slice(c * (n // g), (c + 1) * (n // g))
+                   for c, n, g in zip(coord, shape, grid))
+        full[sl] = piece
+    return full

@@ -1,0 +1,168 @@
+"""``resume_elastic``: verified checkpoint restore onto a *different* mesh.
+
+The composition this subsystem exists for: PR 9's verified load +
+corruption fallback, the layout manifest, and the pure-host reshard
+planner become one resume path that works at any world size:
+
+1. resolve the newest intact tag (marker-tolerant, like ``resume``);
+2. read its **layout** manifest and compare topologies — identical
+   topology delegates to the plain bit-exact path;
+3. otherwise **plan** the reshard on the host (feasibility + priced
+   gather bytes) and refuse loudly *before* the restore pays for
+   anything (:class:`~.planner.ReshardRefusal` lists every unsatisfiable
+   leaf/axis);
+4. execute the verified load: orbax reads each leaf straight into its
+   new sharding, and the PR 9 per-leaf digest check — which hashes the
+   *logical global* array — re-proves every resharded leaf bit-exact
+   against its save-time digest;
+5. restore the full timeline (``state.step``/LR, RNG fold-in counters,
+   dynamic loss scale) on the new mesh, and record the old→new topology
+   in telemetry + the returned report.
+"""
+
+import dataclasses
+import os
+from typing import Optional
+
+from deepspeed_tpu.runtime.elastic.layout import (engine_layout, layout_from_manifest,
+                                                  mesh_axes_of, normalized_axes,
+                                                  same_topology)
+from deepspeed_tpu.runtime.elastic.planner import ReshardRefusal, plan_reshard  # noqa: F401 — re-export
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+@dataclasses.dataclass
+class ReshardReport:
+    """What ``resume_elastic`` did. ``mode``: ``fresh`` (no checkpoint),
+    ``plain`` (same topology, bit-exact PR 9 path), ``reshard`` (planned
+    cross-topology restore), ``unplanned`` (pre-layout checkpoint: the
+    restore still verifies digests, but no plan could be priced)."""
+
+    mode: str
+    tag: Optional[str] = None
+    client_state: dict = dataclasses.field(default_factory=dict)
+    source_topology: Optional[dict] = None
+    target_topology: Optional[dict] = None
+    leaves: int = 0
+    total_bytes: int = 0
+    gather_bytes: int = 0
+
+    def __iter__(self):  # (tag, client_state) unpacking, like engine.resume
+        yield self.tag
+        yield self.client_state
+
+
+def _tag_layout(load_dir: str, tag: str):
+    """The layout stamped in ``tag``'s manifest, or None (pre-elastic tag
+    or unreadable manifest — the verified load deals with corruption)."""
+    from deepspeed_tpu.runtime.resilience.manifest import (CheckpointCorruptError,
+                                                           read_manifest)
+    try:
+        return layout_from_manifest(read_manifest(os.path.join(load_dir, tag)))
+    except CheckpointCorruptError:
+        return None  # load_checkpoint's fallback scan owns corruption handling
+
+
+def resume_elastic(engine, load_dir: Optional[str] = None, tag: Optional[str] = None) -> ReshardReport:
+    """Resume ``engine`` from ``load_dir`` at the engine's *current*
+    topology, whatever topology wrote the checkpoint. Returns a
+    :class:`ReshardReport` (iterable as ``(tag, client_state)`` so it
+    drops into ``resume()`` call sites). Raises
+    :class:`~.planner.ReshardRefusal` when the checkpoint cannot be laid
+    out on this mesh — loudly, before any state is touched."""
+    load_dir = load_dir or engine._preempt_save_dir
+    assert load_dir, "resume_elastic() needs a load_dir (or an armed resilience.preempt_save_dir)"
+    assert engine.state is not None, ("initialize_state(example_batch) must run before "
+                                      "resume_elastic so the target mesh layout is known")
+    tags = engine._resume_preamble(load_dir)  # shared flush/sweep/list ordering
+    if not tags:
+        log_dist(f"resume_elastic: no checkpoints under {load_dir}; fresh start")
+        return ReshardReport(mode="fresh",
+                             target_topology={"world_size": int(engine.mesh.devices.size),
+                                              "mesh_axes": mesh_axes_of(engine.mesh)})
+    requested, load_tag = tag, tag
+    if requested is None:
+        if os.path.exists(os.path.join(load_dir, "latest")):
+            with open(os.path.join(load_dir, "latest")) as f:
+                requested = f.read().strip()
+            if requested not in tags:
+                logger.warning(f"resume_elastic: 'latest' names unpublished tag "
+                               f"{requested!r}; using newest intact tag")
+                requested = load_tag = tags[0]
+        else:
+            logger.warning(f"resume_elastic: {load_dir} has tags but no 'latest' marker "
+                           f"(crash between publish and marker?); using newest intact tag")
+            requested = load_tag = tags[0]
+
+    target = engine_layout(engine)
+    report = _plan_against(engine, load_dir, requested, target)
+
+    # the verified load (corruption fallback included): orbax restores each
+    # leaf directly into its target sharding; verify="full" re-hashes every
+    # restored GLOBAL leaf against the save-time digest — the proof that
+    # the reshard was bit-exact, not just shape-compatible
+    path, client = engine.load_checkpoint(load_dir, tag=load_tag)
+    if path is None:
+        return ReshardReport(mode="fresh", target_topology={
+            "world_size": target["world_size"], "mesh_axes": target["mesh_axes"]})
+    loaded = getattr(engine, "_loaded_checkpoint_tag", requested)
+    if loaded != requested:
+        # the fallback scan moved to an older intact tag: re-plan so the
+        # report describes the checkpoint actually restored. The restore
+        # has already happened — a refusal HERE must classify, never raise
+        # (the "refusal leaves state untouched" contract only holds on the
+        # pre-restore plan above)
+        try:
+            report = _plan_against(engine, load_dir, loaded, target)
+        except ReshardRefusal as e:
+            logger.error(f"resume_elastic: fallback tag {loaded} restored (digest-"
+                         f"verified) but its layout cannot be planned: {e}")
+            report = ReshardReport(mode="unplanned", tag=loaded, target_topology={
+                "world_size": target["world_size"], "mesh_axes": target["mesh_axes"]})
+    report.tag = loaded
+    report.client_state = client
+
+    old = report.source_topology
+    desc = (f"resharded {normalized_axes((old or {}).get('mesh_axes')) or 'replicated'}"
+            f"@{(old or {}).get('world_size')} -> "
+            f"{normalized_axes(target['mesh_axes']) or 'replicated'}"
+            f"@{target['world_size']}" if report.mode == "reshard" else report.mode)
+    log_dist(f"resume_elastic: {desc}; tag {loaded} at step {engine.global_steps} "
+             f"(gather bytes {report.gather_bytes}, loss scale {float(engine.cur_scale)})")
+    if engine.telemetry.has_consumers and report.mode == "reshard":
+        engine.telemetry.publish_events(
+            [("Resilience/reshard_resume", float(report.gather_bytes), engine.global_samples)])
+    engine.telemetry.emit("resume_elastic", mode=report.mode, tag=loaded,
+                          step=engine.global_steps,
+                          source=old, target={"world_size": target["world_size"],
+                                              "mesh_axes": normalized_axes(target["mesh_axes"])},
+                          gather_bytes=report.gather_bytes)
+    engine.last_reshard = report
+    return report
+
+
+def _plan_against(engine, load_dir: str, tag: str, target: dict) -> ReshardReport:
+    """Plan (or classify) the restore of ``tag`` onto ``target`` BEFORE any
+    deserialization. Refusals propagate — a resume that cannot satisfy
+    the layout must fail loudly with every violation, never restore a
+    partial state."""
+    source = _tag_layout(load_dir, tag)
+    tgt_stamp = {"world_size": target["world_size"], "mesh_axes": target["mesh_axes"]}
+    if source is None:
+        logger.warning(f"resume_elastic: tag {tag} carries no layout manifest "
+                       f"(saved before graft-elastic); restoring unplanned — "
+                       f"digest verification still applies")
+        return ReshardReport(mode="unplanned", tag=tag, target_topology=tgt_stamp)
+    src_stamp = {"world_size": source.get("world_size"),
+                 "mesh_axes": source.get("mesh_axes")}
+    if same_topology(source, target) and source.get("leaves") == target.get("leaves"):
+        # identical mesh AND identical per-leaf chunking: the bit-exact
+        # plain path. Same mesh with drifted leaf specs (e.g. a zero-stage
+        # change resharding params) is still a real cross-layout restore —
+        # it falls through to the planner so the report prices it honestly.
+        return ReshardReport(mode="plain", tag=tag, source_topology=src_stamp,
+                             target_topology=tgt_stamp)
+    plan = plan_reshard(source, target)  # ReshardRefusal propagates, pre-restore
+    return ReshardReport(mode="reshard", tag=tag, source_topology=src_stamp,
+                         target_topology=tgt_stamp, leaves=len(plan.leaves),
+                         total_bytes=plan.total_bytes, gather_bytes=plan.gather_bytes)

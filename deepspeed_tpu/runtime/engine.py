@@ -2481,7 +2481,15 @@ class DeepSpeedEngine:
         from deepspeed_tpu.elasticity.elastic_agent import touch_heartbeat
         touch_heartbeat(min_interval=self.config.resilience_config.heartbeat_interval,
                         payload={"global_step": self.global_steps,
-                                 "last_span": self.telemetry.last_span})
+                                 "last_span": self.telemetry.last_span,
+                                 # topology stamp: the elastic agent reads
+                                 # reshard-vs-plain straight off the pulse.
+                                 # SAME shape as the metadata.json stamp
+                                 # (full axis dict) so the two compare with
+                                 # plain equality
+                                 "world_size": int(self.mesh.devices.size),
+                                 "mesh_axes": {str(a): int(s)
+                                               for a, s in self.mesh.shape.items()}})
         if self.progressive_layer_drop is not None:
             # host mirror of the in-graph schedule (reference update_state)
             self.progressive_layer_drop.update_state(self.global_steps)
@@ -2733,6 +2741,24 @@ class DeepSpeedEngine:
             log_dist(f"preemption checkpoint durable; exiting {self._preempt_exit_code}")
             raise SystemExit(self._preempt_exit_code)
 
+    def _resume_preamble(self, load_dir):
+        """The shared pre-restore sequence of :meth:`resume` and
+        :meth:`resume_elastic`: commit any in-flight async save (the sweep
+        below would destroy its live staging mid-write), run the
+        crash-window staging sweep rank-0-only (a tag overwrite killed
+        between its displace and publish renames left the intact copy
+        under ``.tmp.<tag>.old.*`` — restore it before listing), barrier,
+        and return the published tags newest-first. One copy of this
+        ordering: both resume paths MUST observe identical sweep/list
+        semantics or their tag resolution drifts."""
+        from deepspeed_tpu.runtime.resilience.manifest import (list_checkpoint_tags,
+                                                               sweep_stale_staging)
+        self.flush_checkpoints()
+        if dist.get_rank() == 0:
+            sweep_stale_staging(load_dir)
+        dist.barrier()
+        return list_checkpoint_tags(load_dir)
+
     def resume(self, load_dir=None, tag=None):
         """Preemption-safe auto-resume: restore from the newest intact
         checkpoint under ``load_dir`` (default: the armed preemption dir).
@@ -2745,21 +2771,9 @@ class DeepSpeedEngine:
         marker: with no/stale marker it resolves the newest intact tag
         directly. Returns ``(tag, client_state)`` — ``(None, {})`` means no
         checkpoint exists yet (fresh start)."""
-        from deepspeed_tpu.runtime.resilience.manifest import (list_checkpoint_tags,
-                                                               sweep_stale_staging)
         load_dir = load_dir or self._preempt_save_dir
         assert load_dir, "resume() needs a load_dir (or an armed resilience.preempt_save_dir)"
-        # an in-flight async save stages under .tmp.<tag> in this very dir:
-        # it must be committed before the sweep below, or the sweep would
-        # destroy the live staging mid-write
-        self.flush_checkpoints()
-        # crash-window recovery: a tag overwrite killed between its displace
-        # and publish renames left the intact copy under a .tmp.<tag>.old.*
-        # name — restore it before listing
-        if dist.get_rank() == 0:
-            sweep_stale_staging(load_dir)
-        dist.barrier()
-        tags = list_checkpoint_tags(load_dir)
+        tags = self._resume_preamble(load_dir)
         if not tags:
             log_dist(f"resume: no checkpoints under {load_dir}; fresh start")
             return None, {}
@@ -2774,6 +2788,20 @@ class DeepSpeedEngine:
         log_dist(f"resumed from checkpoint {loaded} at step {self.global_steps} "
                  f"(samples {self.global_samples}, loss scale {float(self.cur_scale)})")
         return loaded, client
+
+    def resume_elastic(self, load_dir=None, tag=None):
+        """World-size-elastic resume (graft-elastic): restore the newest
+        intact checkpoint onto THIS engine's mesh, whatever topology wrote
+        it. Same topology delegates to the bit-exact plain path; a changed
+        topology is planned on the host first (feasibility + gather bytes,
+        ``runtime/elastic/planner.py``) and refused loudly on axes the plan
+        cannot satisfy — before any deserialization. Every restored leaf is
+        re-hashed against its save-time digest (the digest covers the
+        logical global array), so a completed reshard is *proven* bit-exact.
+        Returns a :class:`~deepspeed_tpu.runtime.elastic.resume.ReshardReport`
+        (iterable as ``(tag, client_state)`` like :meth:`resume`)."""
+        from deepspeed_tpu.runtime.elastic.resume import resume_elastic
+        return resume_elastic(self, load_dir, tag=tag)
 
     # ------------------------------------------------------------------
     # checkpointing (reference engine.py:2906 save / 2601 load)
@@ -2793,16 +2821,29 @@ class DeepSpeedEngine:
             "global_samples": self.global_samples,
             "micro_steps": self.micro_steps,
             "skipped_steps": self.skipped_steps,
+            # topology stamp (graft-elastic): lets a supervisor decide
+            # reshard-vs-plain-resume from metadata alone, without ever
+            # opening the state (elastic/agent.decide_resume,
+            # list_checkpoint_tags(with_meta=True))
+            "world_size": int(self.mesh.devices.size),
+            "mesh_axes": {str(a): int(s) for a, s in self.mesh.shape.items()},
             "client_state": client_state or {},
         }
         if self.curriculum_scheduler is not None:
             meta["curriculum_state"] = self.curriculum_scheduler.get_state()
+        # per-leaf layout manifest (logical shape/dtype/PartitionSpec vs
+        # named mesh axes): what makes the published tag world-size-
+        # independent by construction — any target mesh plans its reshard
+        # against this, and the per-leaf digests prove the reshard bit-exact
+        from deepspeed_tpu.runtime.elastic.layout import engine_layout
+        layout = engine_layout(self)
         # stage-then-publish: state AND the extra per-rank files below land
         # in the staging dir and become visible in ONE atomic rename
         # (finalize) — a killed writer never leaves a partial tag
         _ckpt_t0 = time.perf_counter()
         with self.telemetry.span("ckpt_stage"):
-            engine.save(self.state, tag, metadata=meta, defer_finalize=True)
+            engine.save(self.state, tag, metadata=meta, defer_finalize=True,
+                        layout=layout)
         stage = engine.staging_dir(tag)
         if self._zeroone_runner is not None:
             # pending local updates (u) + error feedback are optimizer state.

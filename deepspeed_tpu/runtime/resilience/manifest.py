@@ -302,10 +302,17 @@ def verify_state_leaves(state, manifest: dict, ckpt_dir: str = "") -> None:
             + (f" (+{len(problems) - 8} more)" if len(problems) > 8 else ""))
 
 
-def list_checkpoint_tags(base_dir: str) -> list:
+def list_checkpoint_tags(base_dir: str, with_meta: bool = False) -> list:
     """Published tags under ``base_dir``, newest first. Order: the
     ``global_steps`` recorded in each tag's metadata (falling back to dir
-    mtime) — the corruption-fallback scan walks this list."""
+    mtime) — the corruption-fallback scan walks this list.
+
+    ``with_meta=True`` returns one dict per tag — ``{"tag",
+    "global_steps", "world_size", "mesh_axes"}`` — from the topology
+    stamp every save records in ``metadata.json`` (graft-elastic), so an
+    elastic supervisor decides reshard-vs-plain-resume without opening
+    any checkpoint state (``world_size``/``mesh_axes`` are None for tags
+    saved before the stamp existed)."""
     if not os.path.isdir(base_dir):
         return []
     tags = []
@@ -316,13 +323,34 @@ def list_checkpoint_tags(base_dir: str) -> list:
         if not (os.path.exists(os.path.join(full, "state"))
                 or os.path.exists(os.path.join(full, MANIFEST_NAME))):
             continue
-        steps = -1
+        steps, meta = -1, {}
         meta_path = os.path.join(full, "metadata.json")
         try:
             with open(meta_path) as f:
-                steps = int(json.load(f).get("global_steps", -1))
+                meta = json.load(f)
         except (OSError, ValueError):
-            pass
-        tags.append((steps, os.path.getmtime(full), name))
-    tags.sort(reverse=True)
-    return [name for _, _, name in tags]
+            meta = {}
+        if not isinstance(meta, dict):
+            meta = {}
+        try:
+            steps = int(meta.get("global_steps", -1))
+        except (ValueError, TypeError):
+            steps = -1  # malformed steps must not discard a valid topology stamp
+        entry = {"tag": name, "global_steps": steps if steps >= 0 else None,
+                 "world_size": None, "mesh_axes": None}
+        # stamp coercion tolerates malformed-but-valid-JSON metadata: one
+        # bad tag must never abort the listing the corruption-fallback
+        # scan and decide_resume walk (fields degrade to None = unknown)
+        try:
+            if meta.get("world_size") is not None:
+                entry["world_size"] = int(meta["world_size"])
+            if isinstance(meta.get("mesh_axes"), dict):
+                entry["mesh_axes"] = {str(a): int(s)
+                                      for a, s in meta["mesh_axes"].items()}
+        except (ValueError, TypeError):
+            entry["world_size"] = entry["mesh_axes"] = None
+        tags.append((steps, os.path.getmtime(full), name, entry))
+    tags.sort(reverse=True, key=lambda t: t[:3])
+    if with_meta:
+        return [entry for _, _, _, entry in tags]
+    return [name for _, _, name, _ in tags]

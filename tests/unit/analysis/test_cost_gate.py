@@ -54,10 +54,17 @@ def test_committed_cost_baseline_covers_the_matrix():
     # the gate scenarios must be banked or the ratchet has no teeth
     for name in ("moe_ep_step", "pipe_chunked_step", "pipe_1f1b_step",
                  "zero3_train_step", "train_batch_parity",
-                 "serve_decode_step"):
+                 "serve_decode_step", "reshard_resume"):
         assert name in programs, name
         assert programs[name]["peak_bytes"] > 0
         assert "collective_counts" in programs[name]
+    # the elastic restore path's gather bytes are ratcheted (graft-elastic):
+    # the banked reshard program must carry real compiled movement and its
+    # gather collectives, and no reduction may ever appear in a reshard
+    reshard = programs["reshard_resume"]
+    assert reshard["bytes_moved"]["compiled"] > 0
+    assert reshard["collective_counts"]["compiled"]["all_gather"] >= 1
+    assert "all_reduce" not in reshard["collective_counts"]["compiled"]
     # the banked serve decode tick must sit under its committed budget
     # with headroom for the ratchet to have teeth (PERF.md §PR14)
     from deepspeed_tpu.analysis.scenarios import SERVE_DECODE_BUDGET_MB

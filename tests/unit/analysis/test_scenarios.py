@@ -134,28 +134,29 @@ def test_skipped_scenarios_are_structured_gaps(matrix):
 
 
 def test_composition_blocking_gap_ratchet():
-    """ROADMAP-5 burn-down, step 1: the composition scenario's first
+    """ROADMAP-5 burn-down, step 2: the composition scenario's first
     blocking gap may only move FORWARD through the order
-    device-count -> partial-manual -> moe-in-pipe -> none. The floor is
-    environment-conditional (an 8-device tier-1 run legitimately blocks
-    on device count), but a backward move — e.g. a refactor that breaks
-    the 16-device build back into a device-count error on a capable
-    runtime — fails here."""
-    import jax
-
+    device-count -> partial-manual -> moe-in-pipe -> none. The
+    device-count link is burned down (a <16-device run probes the
+    16-virtual-device build in a subprocess and reports the gap behind
+    it), so the floor is now partial-manual on the pinned container and
+    moe-in-pipe on modern jax — TIGHTER than the PR-12 floor, on every
+    runtime, regardless of the ambient device count."""
     from deepspeed_tpu.analysis.scenarios import (COMPOSITION_GAP_ORDER,
                                                   composition_blocking_gap,
                                                   composition_gap_rank)
     from deepspeed_tpu.utils.jax_compat import PARTIAL_MANUAL_OK
 
+    import pytest
+
     gap = composition_blocking_gap()
     assert gap["kind"] in COMPOSITION_GAP_ORDER, gap
-    if len(jax.devices()) < 16:
-        floor = "device_count"
-    elif not PARTIAL_MANUAL_OK:
-        floor = "partial_manual"
-    else:
-        floor = "moe_in_pipe"
+    if gap.get("probe") == "failed":
+        # the floor depends on the 16-device subprocess probe; a rig where
+        # the probe itself cannot run (resource-starved, fork-limited) is
+        # an environment problem, not a burn-down regression
+        pytest.skip(f"16-device composition probe failed on this rig: {gap}")
+    floor = "partial_manual" if not PARTIAL_MANUAL_OK else "moe_in_pipe"
     assert composition_gap_rank(gap["kind"]) >= composition_gap_rank(floor), (
         f"composition gap regressed backward: {gap} (floor on this "
         f"runtime: {floor})")

@@ -130,6 +130,76 @@ def test_hang_detection_kills_and_restarts(tmp_path):
     assert worlds[0] == 4 and worlds[3] == 2, rows
 
 
+def test_agent_history_records_topology_transitions(tmp_path):
+    """With a checkpoint_dir, every attempt's history row carries the
+    old→new topology record (from metadata stamps alone — the supervisor
+    never opens checkpoint state): first attempt fresh, restart at a
+    different world decided as reshard against the stamped world size."""
+    import json as _json
+
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+
+    ckpt = tmp_path / "ckpt"
+    fail_flag = tmp_path / "fail_once"
+    fail_flag.write_text("")
+    # child: fails once (forcing a restart at the next world), then fakes a
+    # checkpoint publish stamped at world 4 and exits 0 — no jax involved
+    child = (
+        "import json, os, sys\n"
+        f"flag = {str(fail_flag)!r}\n"
+        f"ckpt = {str(ckpt)!r}\n"
+        "tag = os.path.join(ckpt, 'global_step1')\n"
+        "os.makedirs(tag, exist_ok=True)\n"
+        "open(os.path.join(tag, 'state'), 'w').write('x')\n"
+        "json.dump({'global_steps': 1, 'world_size': 4,\n"
+        "           'mesh_axes': {'data': 1, 'fsdp': 4}},\n"
+        "          open(os.path.join(tag, 'metadata.json'), 'w'))\n"
+        "open(os.path.join(ckpt, 'latest'), 'w').write('global_step1')\n"
+        "if os.path.exists(flag):\n"
+        "    os.unlink(flag)\n"
+        "    sys.exit(1)\n"
+    )
+    agent = DSElasticAgent([sys.executable, "-c", child], world_sizes=[4, 8],
+                           max_restarts=2, checkpoint_dir=str(ckpt))
+    rc = agent.run(workdir=str(tmp_path))
+    assert rc == 0 and agent.restart_count == 1, agent.history
+    first, second = agent.history
+    # attempt 1 found no checkpoint yet -> fresh, no previous world
+    assert first["topology"]["resume"] == "fresh"
+    assert first["topology"]["prev_world_size"] is None
+    # attempt 2 found the world-4 stamp and targets world 8 -> reshard
+    topo = second["topology"]
+    assert topo["resume"] == "reshard" and topo["ckpt_world"] == 4
+    assert topo["world_size"] == 8 and topo["prev_world_size"] == 4
+    assert topo["tag"] == "global_step1"
+    assert _json.dumps(agent.history)  # rows stay JSON-serializable
+
+
+def test_decide_resume_reads_stamps_only(tmp_path):
+    """decide_resume: fresh on empty, plain on matching topology, reshard
+    on axis-split change even at equal world size, unknown on pre-stamp
+    metadata."""
+    import json as _json
+
+    from deepspeed_tpu.runtime.elastic.agent import decide_resume
+
+    ckpt = tmp_path / "ck"
+    assert decide_resume(str(ckpt), 4)["resume"] == "fresh"
+    tag = ckpt / "t1"
+    tag.mkdir(parents=True)
+    (tag / "state").write_text("x")
+    meta = {"global_steps": 3, "world_size": 4, "mesh_axes": {"data": 2, "fsdp": 2}}
+    (tag / "metadata.json").write_text(_json.dumps(meta))
+    assert decide_resume(str(ckpt), 4)["resume"] == "plain"
+    assert decide_resume(str(ckpt), 2)["resume"] == "reshard"
+    # same world, different split: still a reshard when axes are known
+    d = decide_resume(str(ckpt), 4, target_axes={"data": 1, "fsdp": 4})
+    assert d["resume"] == "reshard" and d["ckpt_axes"] == {"data": 2, "fsdp": 2}
+    # pre-elastic tag (no stamp): unknown — the restore will be unplanned
+    (tag / "metadata.json").write_text(_json.dumps({"global_steps": 3}))
+    assert decide_resume(str(ckpt), 4)["resume"] == "unknown"
+
+
 def test_validate_world_sizes_rejects_invalid_ladder():
     from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
     ds = {"elasticity": {"enabled": True, "max_train_batch_size": 8,

@@ -1,0 +1,33 @@
+"""Elastic fault matrix in tier-1 (tools/fault_bench.py scenarios,
+graft-elastic): SIGKILL at a step boundary on 4 virtual devices under
+``DSElasticAgent``, relaunch on 8 (scale-up) and on 2 (scale-down), the
+checkpoint resharded by ``resume_elastic`` — bit-identical restored
+leaves (W→W′→W digest round trip), stitched loss curve inside the
+documented :data:`fault_bench.RESHARD_LOSS_RTOL` envelope, topology
+transition recorded in the agent history. Subprocess kill-and-resume on
+the PR 9 pattern (simulated per-step data clocks, exact-hex loss rows);
+the world-4 reference run is shared across both directions."""
+
+import os
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+for p in (REPO, os.path.join(REPO, "tools")):
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+import fault_bench  # noqa: E402 — scenarios shared with the CLI
+
+
+def test_scale_up_4_to_8(tmp_path):
+    row = fault_bench.scenario_scale_up(str(tmp_path))
+    assert row["ok"], row
+    assert row["attempt_topology"]["resume"] == "reshard"
+    assert row["attempt_topology"]["ckpt_world"] == 4
+    assert row["attempt_topology"]["world_size"] == 8
+
+
+def test_scale_down_4_to_2(tmp_path):
+    row = fault_bench.scenario_scale_down(str(tmp_path))
+    assert row["ok"], row
+    assert row["attempt_topology"]["world_size"] == 2

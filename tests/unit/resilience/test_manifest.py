@@ -120,6 +120,43 @@ def test_list_tags_orders_by_steps_and_skips_staging(tmp_path):
     assert M.list_checkpoint_tags(str(tmp_path)) == ["b", "c", "a"]
 
 
+def test_list_tags_with_meta_tolerates_malformed_stamps(tmp_path):
+    """with_meta entries carry the graft-elastic topology stamp; a tag whose
+    metadata is valid JSON but carries a corrupted stamp degrades its
+    fields to None — it must never abort the listing the corruption
+    fallback and decide_resume walk."""
+    good = tmp_path / "good"
+    (good / "state").mkdir(parents=True)
+    (good / "metadata.json").write_text(json.dumps(
+        {"global_steps": 2, "world_size": 4, "mesh_axes": {"data": 1, "fsdp": 4}}))
+    bad = tmp_path / "bad"
+    (bad / "state").mkdir(parents=True)
+    (bad / "metadata.json").write_text(json.dumps(
+        {"global_steps": 1, "world_size": [4], "mesh_axes": {"fsdp": None}}))
+    old = tmp_path / "old"  # pre-elastic tag: no stamp at all
+    (old / "state").mkdir(parents=True)
+    (old / "metadata.json").write_text(json.dumps({"global_steps": 0}))
+    entries = {e["tag"]: e for e in M.list_checkpoint_tags(str(tmp_path), with_meta=True)}
+    assert set(entries) == {"good", "bad", "old"}
+    assert entries["good"]["world_size"] == 4
+    assert entries["good"]["mesh_axes"] == {"data": 1, "fsdp": 4}
+    assert entries["bad"]["world_size"] is None and entries["bad"]["mesh_axes"] is None
+    assert entries["old"]["world_size"] is None and entries["old"]["global_steps"] == 0
+    # malformed steps must not discard a VALID topology stamp riding the
+    # same metadata.json
+    halfbad = tmp_path / "halfbad"
+    (halfbad / "state").mkdir(parents=True)
+    (halfbad / "metadata.json").write_text(json.dumps(
+        {"global_steps": None, "world_size": 4, "mesh_axes": {"fsdp": 4}}))
+    entry = {e["tag"]: e for e in M.list_checkpoint_tags(
+        str(tmp_path), with_meta=True)}["halfbad"]
+    assert entry["global_steps"] is None
+    assert entry["world_size"] == 4 and entry["mesh_axes"] == {"fsdp": 4}
+    # plain listing unaffected, newest (by steps) first; the step-less tag
+    # sorts behind every stamped one
+    assert M.list_checkpoint_tags(str(tmp_path)) == ["good", "bad", "old", "halfbad"]
+
+
 def test_sweep_stale_staging(tmp_path):
     (tmp_path / ".tmp.x" / "state").mkdir(parents=True)
     (tmp_path / "keep").mkdir()
