@@ -618,6 +618,97 @@ def serve_decode_step() -> ProgramInfo:
         set_topology(None)
 
 
+#: committed activation budget (MiB) for the QUANTIZED graft-serve decode
+#: tick (8 slots x 256 positions, n_embd=128 bf16 compute, tp=2). The
+#: int8-weight program's transient is dominated by the int8 KV pools +
+#: bf16 dequant/attention temporaries; measured static transient on the
+#: pinned container: 2.63 MiB, committed at 2.9 MiB (~10% headroom).
+#: ``DS_SERVE_WQ=fp`` swings the program back to full-width fp kernels —
+#: peak bytes jump ~40% past the R013 tolerance, the seeded regression
+#: for a forced/leaked served weight dtype.
+SERVE_QUANT_DECODE_BUDGET_MB = 2.9
+
+
+@scenario("serve_quant_decode_step")
+def serve_quant_decode_step() -> ProgramInfo:
+    """graft-quant-serve's decode tick: the SAME ``make_apply_fn`` +
+    ``build_decode_step`` program as :func:`serve_decode_step`, but served
+    the way the quantized scheduler builds it — int8 per-group weight
+    codes with dequant fused into the GEMM (``_quant_view``), int8 KV
+    pools (``make_slot_cache(kv_quant=True)``), bf16 compute. A weight-
+    heavier config (n_embd=128) than the fp reference makes the weight
+    path the dominant term, so the A/B against ``serve_decode_step``
+    prices exactly what quantization buys per tick.
+
+    The served dtype resolves at the BUILDER (``resolve_weight_dtype``
+    over the scenario's installed config default), never inside the
+    module — so ``DS_SERVE_WQ`` drifts the traced program while
+    ``serve_weight_dtype`` metadata stays the committed intent
+    (``resolve_intended_weight_dtype``), and R013 fails the drift."""
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.serving import (make_slot_cache,
+                                                 resolve_intended_weight_dtype,
+                                                 resolve_weight_dtype,
+                                                 set_default_weight_dtype)
+    from deepspeed_tpu.inference.serving.programs import (build_decode_step,
+                                                          make_apply_fn)
+    from deepspeed_tpu.inference.serving.scheduler import _quant_view
+    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+    from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
+
+    if len(jax.devices()) < 2:
+        raise ScenarioSkipped("serve_quant_decode_step needs >=2 devices for "
+                              "the tensor=2 serving mesh")
+    set_topology(None)
+    set_default_weight_dtype("int8")  # the committed serving config
+    try:
+        slots = 8
+        cfg = get_gpt2_config("test", n_layer=2, n_embd=128, n_head=8,
+                              n_positions=256, dtype=jnp.bfloat16)
+        topo = MeshTopology(tensor=2, data=1, fsdp=1, devices=jax.devices()[:2])
+        engine = InferenceEngine(GPT2LMHeadModel(cfg),
+                                 DeepSpeedInferenceConfig(), topology=topo)
+        # builder-level resolution, exactly the scheduler's seam: env
+        # outranks the installed config default, so a forced DS_SERVE_WQ
+        # changes WHAT GETS BUILT here while the metadata below does not
+        wd, _src = resolve_weight_dtype(None)
+        module, params = engine.module, engine.params
+        if wd != "fp":
+            module, params = _quant_view(module, params, wd, 64)
+        cache = make_slot_cache(module, slots, kv_quant=True)
+        decode = build_decode_step(make_apply_fn(module, engine._mparams),
+                                   do_sample=False, temperature=1.0, top_k=0,
+                                   top_p=1.0)
+        tokens = jnp.zeros((slots,), jnp.int32)
+        jaxpr = jax.make_jaxpr(decode)(params, cache, tokens)
+        return ProgramInfo(
+            name="serve_quant_decode_step", jaxpr=jaxpr, kind="serve_decode",
+            lower=lambda: jax.jit(decode).lower(params, cache, tokens),
+            metadata={
+                "serve_slots": slots,
+                # committed intent, env layer skipped — the drift anchor
+                "serve_weight_dtype": resolve_intended_weight_dtype(None),
+                "serve_kv_quant": True,
+                "activation_budget_bytes": int(SERVE_QUANT_DECODE_BUDGET_MB * 2**20),
+                "collective_signature": [
+                    # same tp=2 skeleton as serve_decode_step: 2 row-parallel
+                    # all-reduces per block + 1 for the tied LM head — but in
+                    # bf16, so the compiled wire bytes land strictly below
+                    # the fp tick's (the headline A/B the baseline pins)
+                    {"layer": "compiled", "kind": "all_reduce", "count": 5,
+                     "note": "2 all-reduces per block + 1 for the tied "
+                             "LM head, bf16 activations on the tp=2 mesh"},
+                    {"layer": "compiled", "kind": "all_gather", "max_count": 2,
+                     "note": "at most the two embedding-table gathers — "
+                             "more would mean GSPMD re-gathers the int8 "
+                             "codes or the KV pool per tick"}]})
+    finally:
+        set_default_weight_dtype(None)
+        set_topology(None)
+
+
 @scenario("reshard_resume")
 def reshard_resume() -> ProgramInfo:
     """graft-elastic's restore-path data movement, as a static program the

@@ -23,12 +23,14 @@ class PagedKVCache:
     """One layer's K and V pools + the shared allocator state."""
 
     def __init__(self, num_pages: int, page_size: int, num_heads: int, head_dim: int,
-                 num_layers: int = 1, dtype=jnp.bfloat16, quantize: bool = False):
-        """``quantize=True``: pools store int8 with one bf16 scale per
-        (page, position, head) — the reference's int8 KV path
-        (``inference_context.h`` int8 workspaces + dequant kernels) at 2x
-        the tokens-in-flight per HBM byte; ``gather`` dequantizes on read
-        into the compute dtype."""
+                 num_layers: int = 1, dtype=jnp.bfloat16, quantize: bool = True):
+        """``quantize=True`` (the serving default since graft-quant-serve —
+        int8 KV is how the block pool admits deeper on the same HBM):
+        pools store int8 with one bf16 scale per (page, position, head) —
+        the reference's int8 KV path (``inference_context.h`` int8
+        workspaces + dequant kernels) at 2x the tokens-in-flight per HBM
+        byte; ``gather`` dequantizes on read into the compute dtype.
+        ``quantize=False`` keeps exact fp pools (parity debugging)."""
         self.num_pages = num_pages
         self.page_size = page_size
         self.num_layers = num_layers
@@ -59,13 +61,11 @@ class PagedKVCache:
 
         def quant(vals):
             # per-(token, head) groups through the shared quantizer library
-            # (ops/quantizer/core.quantize — one int8 implementation repo-wide)
-            from deepspeed_tpu.ops.quantizer.core import quantize as core_quantize
-            t, h, d = vals.shape
-            q, params = core_quantize(vals, num_bits=8, symmetric=True,
-                                      num_groups=t * h)
-            return (q.reshape(t, h, d),
-                    params.scale.reshape(t, h, 1).astype(jnp.bfloat16))
+            # (ops/quantizer/core — one int8 implementation repo-wide; the
+            # last-axis form is shape/sharding-preserving)
+            from deepspeed_tpu.ops.quantizer.core import quantize_lastaxis
+            q, scale = quantize_lastaxis(vals, num_bits=8)
+            return q, scale.astype(jnp.bfloat16)
 
         self._quant = jax.jit(quant)
 

@@ -2,11 +2,16 @@
 speculative decoding (ISSUE 14 / ROADMAP item 1)."""
 
 from deepspeed_tpu.inference.serving.blocks import BlockPool
-from deepspeed_tpu.inference.serving.config import (ENV_KV_WRITE, ServingConfig,
+from deepspeed_tpu.inference.serving.config import (ENV_KV_WRITE,
+                                                    ENV_WEIGHT_DTYPE,
+                                                    ServingConfig,
                                                     SpeculationConfig,
                                                     resolve_intended_kv_write,
+                                                    resolve_intended_weight_dtype,
                                                     resolve_kv_write,
-                                                    set_default_kv_write)
+                                                    resolve_weight_dtype,
+                                                    set_default_kv_write,
+                                                    set_default_weight_dtype)
 from deepspeed_tpu.inference.serving.programs import (make_slot_cache,
                                                       serve_programs,
                                                       slot_capacity,
@@ -18,8 +23,11 @@ from deepspeed_tpu.inference.serving.scheduler import ContinuousBatchingSchedule
 
 __all__ = [
     "ACTIVE", "FINISHED", "PREFILL", "QUEUED", "REFUSED",
-    "BlockPool", "ContinuousBatchingScheduler", "ENV_KV_WRITE", "Request",
+    "BlockPool", "ContinuousBatchingScheduler", "ENV_KV_WRITE",
+    "ENV_WEIGHT_DTYPE", "Request",
     "RequestQueue", "ServingConfig", "SpeculationConfig", "make_slot_cache",
-    "resolve_intended_kv_write", "resolve_kv_write", "serve_programs",
-    "set_default_kv_write", "slot_capacity", "stamp_lengths",
+    "resolve_intended_kv_write", "resolve_intended_weight_dtype",
+    "resolve_kv_write", "resolve_weight_dtype", "serve_programs",
+    "set_default_kv_write", "set_default_weight_dtype", "slot_capacity",
+    "stamp_lengths",
 ]

@@ -24,8 +24,17 @@ ENV_KV_WRITE = "DS_SERVE_KV_WRITE"
 KV_WRITE_CHOICES = ("scatter", "dense")
 DEFAULT_KV_WRITE = "scatter"
 
+#: env override for the served weight dtype (graft-quant-serve); same
+#: drift seam: a forced/leaked value changes the traced decode program,
+#: the serve_quant_decode_step budget stays priced for the intent
+ENV_WEIGHT_DTYPE = "DS_SERVE_WQ"
+
+WEIGHT_DTYPE_CHOICES = ("fp", "int8", "int4")
+DEFAULT_WEIGHT_DTYPE = "fp"
+
 _lock = threading.Lock()
 _config_kv_write: Optional[str] = None
+_config_weight_dtype: Optional[str] = None
 
 
 def _check(value: Optional[str], choices, what: str) -> Optional[str]:
@@ -72,6 +81,45 @@ def resolve_intended_kv_write(mode: Optional[str] = None) -> str:
     return DEFAULT_KV_WRITE
 
 
+def set_default_weight_dtype(mode: Optional[str]) -> None:
+    """Install the scheduler-level served weight dtype (None clears)."""
+    global _config_weight_dtype
+    with _lock:
+        _config_weight_dtype = _check(mode, WEIGHT_DTYPE_CHOICES, "weight_dtype")
+
+
+def resolve_weight_dtype(mode: Optional[str] = None) -> Tuple[str, str]:
+    """Resolve ``(mode, source)`` for the served weight dtype.
+
+    ``fp`` (default) serves the param tree as stored; ``int8``/``int4``
+    serve per-group quantized codes with dequant fused into the GEMM
+    (``ops/pallas/quant_matmul.py``). ``source`` names the deciding layer
+    (``explicit`` > ``env`` > ``config`` > ``default``), the same evidence
+    convention as :func:`resolve_kv_write`."""
+    src, m = "default", DEFAULT_WEIGHT_DTYPE
+    if _config_weight_dtype is not None:
+        m, src = _config_weight_dtype, "config"
+    env = os.environ.get(ENV_WEIGHT_DTYPE, "").strip() or None
+    if env is not None:
+        m, src = _check(env, WEIGHT_DTYPE_CHOICES,
+                        f"weight_dtype (from {ENV_WEIGHT_DTYPE})"), "env"
+    if mode is not None:
+        m, src = _check(mode, WEIGHT_DTYPE_CHOICES, "weight_dtype"), "explicit"
+    return m, src
+
+
+def resolve_intended_weight_dtype(mode: Optional[str] = None) -> str:
+    """The weight dtype the *committed configuration* intends, skipping
+    the env layer — what ``serve_quant_decode_step`` prices its budget
+    and collective signature for (mirror of
+    :func:`resolve_intended_kv_write`)."""
+    if mode is not None:
+        return _check(mode, WEIGHT_DTYPE_CHOICES, "weight_dtype")
+    if _config_weight_dtype is not None:
+        return _config_weight_dtype
+    return DEFAULT_WEIGHT_DTYPE
+
+
 class SpeculationConfig(DeepSpeedConfigModel):
     """Speculative decoding knobs. The drafter is the compression/KD
     student (``compression/compress.py`` ``student_initialization`` seeds
@@ -97,6 +145,11 @@ class ServingConfig(DeepSpeedConfigModel):
     #: total KV token budget backing admission; None = slots x model
     #: context length (admission then only enforces per-request fit)
     kv_pool_tokens: Optional[int] = None
+    #: total KV BYTE budget backing admission — converted to tokens from
+    #: the cache's measured per-token footprint (codes + scales under
+    #: ``kv_quant``), so quantized KV admits proportionally deeper on the
+    #: same HBM; wins over ``kv_pool_tokens`` when both are set
+    kv_pool_bytes: Optional[int] = None
     #: chunked prefill: prompt tokens consumed per prefill tick, so a 4k
     #: prompt cannot stall in-flight decodes for its whole prefill
     prefill_chunk: int = Field(16, ge=1)
@@ -107,6 +160,17 @@ class ServingConfig(DeepSpeedConfigModel):
     max_queue: int = Field(1024, ge=1)
     #: per-slot KV append strategy; resolution via :func:`resolve_kv_write`
     kv_write: Optional[str] = None
+    #: served weight dtype (graft-quant-serve); resolution via
+    #: :func:`resolve_weight_dtype`. ``int8``/``int4`` quantize the served
+    #: param tree per group (weights only; embeddings/norms stay fp) and
+    #: fuse dequant into the GEMM
+    weight_dtype: Optional[str] = None
+    #: target rows per quantization group along the contraction axis
+    weight_group_size: int = Field(64, ge=1)
+    #: int8 KV pools for the per-slot serving cache (the serving default:
+    #: codes + per-(slot, position, head) scales, quantize-on-write /
+    #: dequantize-on-read). False keeps fp KV for parity debugging
+    kv_quant: bool = True
     #: sampling (scheduler-global; speculation requires greedy)
     do_sample: bool = False
     temperature: float = 1.0
@@ -117,6 +181,7 @@ class ServingConfig(DeepSpeedConfigModel):
     @model_validator(mode="after")
     def _validate(self):
         _check(self.kv_write, KV_WRITE_CHOICES, "kv_write")
+        _check(self.weight_dtype, WEIGHT_DTYPE_CHOICES, "weight_dtype")
         if self.speculation.enabled and self.do_sample:
             raise ValueError("speculative decoding is only lossless under greedy "
                              "decoding; set do_sample=False or disable speculation")

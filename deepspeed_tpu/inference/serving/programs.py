@@ -38,11 +38,21 @@ def _is_index_leaf(path) -> bool:
     return _leaf_name(path) in INDEX_LEAVES
 
 
-def make_slot_cache(module, slots: int):
+#: KV pool leaves (``models/gpt2.py`` SelfAttention decode cache)
+KV_LEAVES = ("cached_key", "cached_value")
+
+
+def make_slot_cache(module, slots: int, kv_quant: bool = False):
     """A per-slot serving cache: the model's decode cache with every index
     leaf widened from a scalar to a [slots] vector (which is what flips
     the model's decode branch to per-slot scatter writes + per-slot
-    ``decode_lengths``). Slots start PARKED (sentinel position)."""
+    ``decode_lengths``). Slots start PARKED (sentinel position).
+
+    ``kv_quant=True`` (the ``ServingConfig.kv_quant`` serving default)
+    converts the KV pools to int8 codes and adds a
+    ``<leaf>_scale [slots, P, H, 1]`` companion per pool — the provided
+    cache dtype is what statically flips the model's decode branch to
+    quantize-on-write / dequantize-on-read."""
     from deepspeed_tpu.models.common import init_cache
     cache = init_cache(module, slots)
     parked = slot_capacity(cache)
@@ -52,7 +62,32 @@ def make_slot_cache(module, slots: int):
             return jnp.full((slots,), parked, jnp.int32)
         return leaf
 
-    return jax.tree_util.tree_map_with_path(widen, cache)
+    cache = jax.tree_util.tree_map_with_path(widen, cache)
+    if kv_quant:
+        cache = quantize_slot_cache(cache)
+    return cache
+
+
+def quantize_slot_cache(cache):
+    """int8-KV view of a (fresh) slot cache: each KV pool becomes int8
+    codes and gains a per-(slot, position, head) scale leaf in the pool's
+    original dtype. Zero scales on parked/unwritten rows dequantize to the
+    zeros the fp cache would hold."""
+
+    def walk(tree):
+        out = {}
+        for name, leaf in tree.items():
+            if isinstance(leaf, dict) or hasattr(leaf, "items"):
+                out[name] = walk(leaf)
+            elif name in KV_LEAVES:
+                out[name] = jnp.zeros(leaf.shape, jnp.int8)
+                out[name + "_scale"] = jnp.zeros(leaf.shape[:-1] + (1,),
+                                                 leaf.dtype)
+            else:
+                out[name] = leaf
+        return out
+
+    return walk(cache)
 
 
 def slot_capacity(cache) -> int:
@@ -83,14 +118,23 @@ def stamp_lengths(cache, write_pos: np.ndarray):
 # ---------------------------------------------------------------------------
 def make_apply_fn(module, mparams: Optional[Callable] = None) -> Callable:
     """The one decode apply shared by every serving program (and by the
-    ``serve_decode_step`` audit scenario, so the gated program IS the
-    served one). ``mparams`` is the engine's runtime weight view hook
-    (int8 dequant); identity when absent."""
+    ``serve_decode_step``/``serve_quant_decode_step`` audit scenarios, so
+    the gated program IS the served one). ``mparams`` is the engine's
+    runtime weight view hook (int8 dequant); identity when absent.
+
+    A weight-quantized serving path passes ``params`` as the bundle
+    ``{"params": codes, "quant": scales}`` (``quantize_params`` output);
+    the quant collection rides into ``module.apply`` so projections read
+    their scales via ``get_variable("quant", "kernel_scale")``."""
     mp = mparams or (lambda p: p)
 
     def apply_fn(params, cache, ids):
-        out, upd = module.apply({"params": mp(params), "cache": cache},
-                                ids, decode=True, mutable=["cache"])
+        if isinstance(params, dict) and "quant" in params and "params" in params:
+            variables = {"params": mp(params["params"]),
+                         "quant": params["quant"], "cache": cache}
+        else:
+            variables = {"params": mp(params), "cache": cache}
+        out, upd = module.apply(variables, ids, decode=True, mutable=["cache"])
         logits = out[0] if isinstance(out, (tuple, list)) else out
         return logits, upd["cache"]
 
@@ -171,7 +215,8 @@ def serve_programs(engine, slots_bucket: int, *, prefill_chunk: int,
                    do_sample: bool, temperature: float, top_k: int, top_p: float,
                    spec_k: int = 0, role: str = "target",
                    module=None, mparams=None,
-                   kv_write: Optional[str] = None) -> Dict[str, Any]:
+                   kv_write: Optional[str] = None,
+                   weight_dtype: Optional[str] = None) -> Dict[str, Any]:
     """The serving program dict for one pow2 slot bucket, cached on the
     ENGINE (``engine._serve_cache``) so every scheduler over the same
     engine — and re-created schedulers across deployments — reuse the
@@ -179,9 +224,10 @@ def serve_programs(engine, slots_bucket: int, *, prefill_chunk: int,
     satellite counts exactly one program set per bucket).
 
     ``role``/``module`` let the speculation drafter park its own programs
-    in the same cache under a distinct key; ``kv_write`` is the RESOLVED
-    per-slot write mode the caller will trace under — part of the key, so
-    schedulers with different modes on one engine never share a program.
+    in the same cache under a distinct key; ``kv_write`` and
+    ``weight_dtype`` are the RESOLVED per-slot write mode / served weight
+    dtype the caller will trace under — part of the key, so schedulers
+    with different modes on one engine never share a program.
 
     The key carries the module's identity (the cached closures keep the
     module alive, so ``id`` cannot be recycled): two drafters with
@@ -193,7 +239,8 @@ def serve_programs(engine, slots_bucket: int, *, prefill_chunk: int,
         engine._serve_cache = {}
     mod = module if module is not None else engine.module
     key = (role, id(mod), int(slots_bucket), int(prefill_chunk), bool(do_sample),
-           float(temperature), int(top_k), float(top_p), int(spec_k), kv_write)
+           float(temperature), int(top_k), float(top_p), int(spec_k), kv_write,
+           weight_dtype)
     if key in engine._serve_cache:
         return engine._serve_cache[key]
     apply_fn = make_apply_fn(mod,

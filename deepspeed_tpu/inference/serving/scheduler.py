@@ -39,14 +39,41 @@ import jax
 from deepspeed_tpu.inference.serving.blocks import BlockPool
 from deepspeed_tpu.inference.serving.config import (ServingConfig,
                                                     resolve_kv_write,
-                                                    set_default_kv_write)
-from deepspeed_tpu.inference.serving.programs import (make_slot_cache, serve_programs,
+                                                    resolve_weight_dtype,
+                                                    set_default_kv_write,
+                                                    set_default_weight_dtype)
+from deepspeed_tpu.inference.serving.programs import (KV_LEAVES, _leaf_name,
+                                                      make_slot_cache, serve_programs,
                                                       slot_capacity, stamp_lengths)
 from deepspeed_tpu.inference.serving.queue import RequestQueue
 from deepspeed_tpu.inference.serving.request import (ACTIVE, FINISHED, PREFILL,
                                                      Request)
 from deepspeed_tpu.runtime.telemetry.metrics import Histogram
 from deepspeed_tpu.utils.logging import log_dist
+
+
+def _quant_view(module, params, weight_dtype: str, group_size: int):
+    """graft-quant-serve: the (quant module, params bundle) pair a
+    quantized serving path closes over. The module is rebuilt with
+    ``serve_weight_dtype`` set EXPLICITLY — projections must statically
+    declare the code layout the param tree actually carries (int4 halves
+    the contraction axis), so env resolution never reaches the module;
+    the ``DS_SERVE_WQ`` seam acts here, at the builder. Refuses model
+    families without the seam rather than silently serving fp."""
+    import dataclasses
+
+    from deepspeed_tpu.ops.quantizer.weights import quantize_params
+    cfg = getattr(module, "config", None)
+    if (cfg is None or not dataclasses.is_dataclass(cfg)
+            or not any(f.name == "serve_weight_dtype"
+                       for f in dataclasses.fields(cfg))):
+        raise NotImplementedError(
+            f"{type(module).__name__} does not declare the serve_weight_dtype "
+            f"seam — weight-quantized serving needs projections that read "
+            f"int8/int4 kernels (models/gpt2.py pattern)")
+    q_module = type(module)(dataclasses.replace(cfg, serve_weight_dtype=weight_dtype))
+    qparams, qscales = quantize_params(params, weight_dtype, group_size)
+    return q_module, {"params": qparams, "quant": qscales}
 
 
 class ContinuousBatchingScheduler:
@@ -73,6 +100,24 @@ class ContinuousBatchingScheduler:
         self.clock = clock or time.monotonic
         self.telemetry = telemetry
 
+        # graft-quant-serve: resolve the served weight dtype (env outranks
+        # config — the DS_SERVE_WQ drift seam, same layering as kv_write)
+        # and, when quantized, swap in the quant module + code/scale bundle
+        # every program below closes over. The engine's own params stay fp.
+        set_default_weight_dtype(config.weight_dtype)
+        self.weight_dtype, self.weight_dtype_source = resolve_weight_dtype(None)
+        self.kv_quant = bool(config.kv_quant)
+        self._serve_params = engine.params
+        if self.weight_dtype != "fp":
+            if getattr(engine, "_wq_scales", None) is not None:
+                raise ValueError(
+                    "engine already serves an int8 weight view (engine quant "
+                    "config); serving.weight_dtype would double-quantize — "
+                    "enable one of the two")
+            self.module, self._serve_params = _quant_view(
+                engine.module, engine.params, self.weight_dtype,
+                config.weight_group_size)
+
         # pow2 slot bucket: alternating deployments reuse compiled programs
         self.slots = engine._pow2_bucket(config.slots)
         # the fresh cache must carry the SAME engine-mesh sharding its
@@ -83,12 +128,20 @@ class ContinuousBatchingScheduler:
         from jax.sharding import NamedSharding, PartitionSpec
         self._placement = NamedSharding(engine.mesh, PartitionSpec())
         self._cache = jax.device_put(  # graft-lint: waive R008 jax-owned fresh cache zeros, never donated before first use
-            make_slot_cache(self.module, self.slots), self._placement)
+            make_slot_cache(self.module, self.slots, kv_quant=self.kv_quant),
+            self._placement)
         self.capacity = slot_capacity(self._cache)  # tokens per slot
         self._probe_slot_decode()
 
-        # admission: block-pool truthful KV accounting
+        # admission: block-pool truthful KV accounting. A byte budget is
+        # sized into tokens from the cache's ACTUAL per-token footprint
+        # (int8 codes + scales under kv_quant), which is how quantized KV
+        # turns the same HBM into more blocks and deeper admission.
         pool_tokens = config.kv_pool_tokens or self.slots * self.capacity
+        if config.kv_pool_bytes:
+            pool_tokens = max(config.page_size,
+                              int(config.kv_pool_bytes /
+                                  max(1.0, self._kv_bytes_per_token())))
         self.pool = BlockPool(num_blocks=max(1, pool_tokens // config.page_size),
                               block_size=config.page_size)
         self.queue = RequestQueue(self.pool, max_queue=config.max_queue,
@@ -110,16 +163,28 @@ class ContinuousBatchingScheduler:
                              "compression.student_initialization")
         sampling = dict(do_sample=config.do_sample, temperature=config.temperature,
                         top_k=config.top_k, top_p=config.top_p)
+        quantized = self.weight_dtype != "fp"
         self.fns = serve_programs(engine, self.slots,
+                                  module=self.module if quantized else None,
+                                  mparams=(lambda p: p) if quantized else None,
                                   prefill_chunk=config.prefill_chunk,
                                   spec_k=self.spec_k, kv_write=self.kv_write,
+                                  weight_dtype=self.weight_dtype if quantized else None,
                                   **sampling)
         self._drafter = None
         if drafter is not None and self.spec_k:
             d_module, d_params = drafter
+            d_weight_dtype = None
+            if quantized:
+                # the drafter rides int8 whenever the target serves
+                # quantized: speculation gets cheaper in the same units
+                d_module, d_params = _quant_view(d_module, d_params, "int8",
+                                                 config.weight_group_size)
+                d_weight_dtype = "int8"
             self._drafter = (d_module, jax.device_put(d_params))  # graft-lint: waive R008 drafter weights, never donated
             self._drafter_cache = jax.device_put(  # graft-lint: waive R008 jax-owned fresh cache zeros, same placement contract as the target cache
-                make_slot_cache(d_module, self.slots), self._placement)
+                make_slot_cache(d_module, self.slots, kv_quant=self.kv_quant),
+                self._placement)
             if slot_capacity(self._drafter_cache) < self.capacity:
                 raise ValueError("drafter context capacity is smaller than the "
                                  "target's — it cannot draft to the end of a "
@@ -128,6 +193,7 @@ class ContinuousBatchingScheduler:
                                        module=d_module, mparams=lambda p: p,
                                        prefill_chunk=config.prefill_chunk,
                                        spec_k=self.spec_k, kv_write=self.kv_write,
+                                       weight_dtype=d_weight_dtype,
                                        **sampling)
 
         # host-side authoritative slot state
@@ -147,7 +213,9 @@ class ContinuousBatchingScheduler:
         log_dist(f"graft-serve: slots={self.slots} capacity={self.capacity} "
                  f"pool={self.pool.num_blocks}x{self.pool.block_size} "
                  f"chunk={config.prefill_chunk} kv_write={self.kv_write}"
-                 f"({self.kv_write_source}) spec_k={self.spec_k}")
+                 f"({self.kv_write_source}) wq={self.weight_dtype}"
+                 f"({self.weight_dtype_source}) kv_quant={self.kv_quant} "
+                 f"spec_k={self.spec_k}")
 
     # ------------------------------------------------------------------
     def _probe_slot_decode(self) -> None:
@@ -156,16 +224,31 @@ class ContinuousBatchingScheduler:
         families with ragged-decode support, e.g. GPT-2, can serve)."""
         try:
             import jax.numpy as jnp
+
+            from deepspeed_tpu.inference.serving.programs import make_apply_fn
             ids = jnp.zeros((self.slots, 1), jnp.int32)
-            jax.eval_shape(lambda p, c: self.module.apply(
-                {"params": p, "cache": c}, ids, decode=True, mutable=["cache"]),
-                self.engine.params, self._cache)
+            probe = make_apply_fn(self.module)
+            jax.eval_shape(lambda p, c: probe(p, c, ids),
+                           self._serve_params, self._cache)
         except Exception as e:
             raise NotImplementedError(
                 f"{type(self.module).__name__} does not support the per-slot "
                 f"(ragged) decode cache graft-serve schedules against — its "
                 f"decode path rejected a [slots] cache_index vector: "
                 f"{type(e).__name__}: {e}") from e
+
+    def _kv_bytes_per_token(self) -> float:
+        """Measured KV bytes per cached token, straight off the slot
+        cache's pool (+ scale) leaves — the unit that converts a byte
+        budget into admission depth and prices bytes-per-KV-block in the
+        bench rows. Int8 KV: 1 code byte per element plus the per-(slot,
+        position, head) scale, vs the fp pool's full element width."""
+        total = 0
+        for path, leaf in jax.tree_util.tree_flatten_with_path(self._cache)[0]:
+            name = _leaf_name(path)
+            if name in KV_LEAVES or name.endswith("_scale"):
+                total += leaf.size * leaf.dtype.itemsize
+        return total / float(self.slots * self.capacity)
 
     def _span(self, name: str):
         if self.telemetry is not None:
@@ -184,6 +267,7 @@ class ContinuousBatchingScheduler:
         some slot accepts all k drafts). Touches no request accounting,
         no histograms, and not the sampling rng stream."""
         set_default_kv_write(self.config.kv_write)
+        set_default_weight_dtype(self.config.weight_dtype)
         parked = np.full(self.slots, self.capacity, np.int64)
         rng = ((jax.random.PRNGKey(0),) if self.config.do_sample else ())
         C = self.config.prefill_chunk
@@ -196,7 +280,7 @@ class ContinuousBatchingScheduler:
         target_calls = ([("prefill", (ids, last_idx) + rng)]
                         + ([("verify", (block,))] if self.spec_k
                            else [("decode", (tok,) + rng)]))
-        per_role = [(self.fns, "_cache", self.engine.params, target_calls)]
+        per_role = [(self.fns, "_cache", self._serve_params, target_calls)]
         if self._drafter is not None:
             # the draft loop feeds decode a mesh-committed token (see
             # _spec_tick); every other tick input arrives uncommitted
@@ -244,6 +328,7 @@ class ContinuousBatchingScheduler:
         # lazily-traced programs must bind THIS scheduler's write mode even
         # if another scheduler re-installed the default since construction
         set_default_kv_write(self.config.kv_write)
+        set_default_weight_dtype(self.config.weight_dtype)
         if self.telemetry is not None:
             self.telemetry.begin_step(step_no)
         with self._span("serve_admit"):
@@ -289,7 +374,7 @@ class ContinuousBatchingScheduler:
             last_idx[i] = rem - 1
             write_pos[i] = self._lengths[i]
         cache = stamp_lengths(self._cache, write_pos)
-        args = (self.engine.params, cache, jax.numpy.asarray(ids),
+        args = (self._serve_params, cache, jax.numpy.asarray(ids),
                 jax.numpy.asarray(last_idx))
         if self.config.do_sample:
             self._rng, key = jax.random.split(self._rng)
@@ -330,7 +415,7 @@ class ContinuousBatchingScheduler:
             write_pos[i] = self._lengths[i]
             tokens[i] = self._next_token[i]
         cache = stamp_lengths(self._cache, write_pos)
-        args = (self.engine.params, cache, jax.numpy.asarray(tokens))
+        args = (self._serve_params, cache, jax.numpy.asarray(tokens))
         if self.config.do_sample:
             self._rng, key = jax.random.split(self._rng)
             self._cache, tok = self.fns["decode"](*args, key)
@@ -379,7 +464,7 @@ class ContinuousBatchingScheduler:
         with self._span("serve_spec_verify"):
             cache = stamp_lengths(self._cache, write_pos)
             self._cache, greedy = self.fns["verify"](
-                self.engine.params, cache, jax.numpy.asarray(block))
+                self._serve_params, cache, jax.numpy.asarray(block))
             greedy = np.asarray(greedy)  # [S, k+1] target argmax per position
         refeed = False
         now = self.clock()
@@ -487,14 +572,25 @@ class ContinuousBatchingScheduler:
         """Aggregate serving evidence: latency distributions, goodput
         inputs, speculation acceptance, pool accounting, tick mix."""
         done = [r for r in self.finished]
+        pool = dict(self.pool.counters())
+        # admission-depth units (satellite: visible in every bench row,
+        # not just the A/B summary): bytes per KV block and blocks per GB
+        # from the measured per-token cache footprint
+        block_bytes = max(1, int(round(self._kv_bytes_per_token()
+                                       * self.pool.block_size)))
+        pool["kv_block_bytes"] = block_bytes
+        pool["kv_blocks_per_gb"] = (1 << 30) // block_bytes
         out = {
             "finished": len(done),
             "refused": self.queue.refused,
             "generated_tokens": sum(len(r.output) for r in done),
             "ticks": dict(self.ticks),
-            "pool": self.pool.counters(),
+            "pool": pool,
             "kv_write": self.kv_write,
             "kv_write_source": self.kv_write_source,
+            "weight_dtype": self.weight_dtype,
+            "weight_dtype_source": self.weight_dtype_source,
+            "kv_quant": self.kv_quant,
             "ttft": self.ttft_hist.snapshot() if self.ttft_hist.count else None,
             "per_token": self.tok_hist.snapshot() if self.tok_hist.count else None,
         }

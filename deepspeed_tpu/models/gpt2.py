@@ -90,6 +90,15 @@ class GPT2Config:
     # dispatch/combine route pin ("dense"|"sorted"); None resolves through
     # DS_MOE_ROUTE env > engine "moe" config block > default (moe/routing.py)
     moe_route: Optional[str] = None
+    # graft-quant-serve: served weight dtype this module instance was BUILT
+    # for ("int8"|"int4"). None (training, lockstep generate) keeps the fp
+    # projections. Set explicitly by the serving scheduler / scenarios —
+    # never resolved from env here, because the param tree's code layout
+    # must match what the projections statically declare (int4 halves the
+    # contraction axis); the DS_SERVE_WQ env seam lives at the builder
+    # (serving/scheduler.py, analysis/scenarios.py), where drift changes
+    # which program gets traced and the cost gate catches it
+    serve_weight_dtype: Optional[str] = None
 
     @property
     def head_dim(self):
@@ -116,6 +125,36 @@ def _dense_init(scale=0.02):
     return dense_init(scale)
 
 
+_QUANT_BITS = {"int8": 8, "int4": 4}
+
+
+def _serve_quant_mode(module, cfg) -> str:
+    """Resolved weight dtype for a projection: quantized only when the
+    module was built for it (``serve_weight_dtype`` set) AND this scope's
+    scales ride along in the ``"quant"`` collection — leaves the skip list
+    (``ops/quantizer/weights.py``) keeps fp stay fp automatically."""
+    swd = getattr(cfg, "serve_weight_dtype", None)
+    if swd is None:
+        return "fp"
+    from deepspeed_tpu.inference.serving.config import resolve_weight_dtype
+    mode, _ = resolve_weight_dtype(swd)  # explicit layer; validates choice
+    if mode == "fp" or not module.has_variable("quant", "kernel_scale"):
+        return "fp"
+    return mode
+
+
+def _kv_quantize(vals):
+    """Per-(slot, token, head) symmetric int8 KV quantization through the
+    one grouped quantizer in the repo (``ops/quantizer/core``). The
+    last-axis form keeps the reduce on the (unsharded) head_dim axis, so
+    a head-sharded KV write on a tensor mesh quantizes in place instead
+    of all-gathering the pool. Returns (codes [b, l, h, d] int8,
+    scales [b, l, h, 1] in KV dtype)."""
+    from deepspeed_tpu.ops.quantizer.core import quantize_lastaxis
+    codes, scale = quantize_lastaxis(vals, num_bits=8)
+    return codes, scale.astype(vals.dtype)
+
+
 class QKVProj(nn.Module):
     """QKV projection over ONE fused ``[E, 3, H, D]`` parameter (the exact
     layout/init ``nn.DenseGeneral(features=(3, H, D))`` declared here
@@ -130,15 +169,28 @@ class QKVProj(nn.Module):
     def __call__(self, x):
         cfg = self.config
         unbox = lambda p: p.value if isinstance(p, nn.meta.AxisMetadata) else p
+        wq = _serve_quant_mode(self, cfg)
+        kshape = (cfg.n_embd, 3, cfg.n_head, cfg.head_dim)
+        if wq == "int4":
+            kshape = (cfg.n_embd // 2,) + kshape[1:]  # packed contraction axis
         kernel = unbox(self.param(
             "kernel", nn.with_logical_partitioning(_dense_init(), ("embed", None, "heads", "kv")),
-            (cfg.n_embd, 3, cfg.n_head, cfg.head_dim), cfg.param_dtype))
+            kshape, cfg.param_dtype))
         bias = unbox(self.param(
             "bias", nn.with_logical_partitioning(nn.initializers.zeros, (None, "heads", "kv")),
             (3, cfg.n_head, cfg.head_dim), cfg.param_dtype))
         x = x.astype(cfg.dtype)
-        kernel = kernel.astype(cfg.dtype)
         bias = bias.astype(cfg.dtype)
+        if wq != "fp":
+            # dequant fused into the GEMM; always the fused program form —
+            # the quantized serving program is one GEMM per projection
+            from deepspeed_tpu.ops.pallas.quant_matmul import quant_dense_general
+            qkv = quant_dense_general(x, kernel,
+                                      self.get_variable("quant", "kernel_scale"),
+                                      bits=_QUANT_BITS[wq], n_contract=1)
+            qkv = qkv + jnp.reshape(bias, (1,) * (qkv.ndim - bias.ndim) + bias.shape)
+            return qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        kernel = kernel.astype(cfg.dtype)
         contract = ((x.ndim - 1,), (0,))
         if cfg.attn_fused_qkv:
             qkv = jax.lax.dot_general(x, kernel, (contract, ((), ())))
@@ -165,15 +217,25 @@ class AttnOutProj(nn.Module):
     def __call__(self, x):
         cfg = self.config
         unbox = lambda p: p.value if isinstance(p, nn.meta.AxisMetadata) else p
+        wq = _serve_quant_mode(self, cfg)
+        kshape = (cfg.n_head, cfg.head_dim, cfg.n_embd)
+        if wq == "int4":
+            kshape = (cfg.n_head, cfg.head_dim // 2, cfg.n_embd)
         kernel = unbox(self.param(
             "kernel", nn.with_logical_partitioning(_dense_init(), ("heads", "kv", "embed")),
-            (cfg.n_head, cfg.head_dim, cfg.n_embd), cfg.param_dtype))
+            kshape, cfg.param_dtype))
         bias = unbox(self.param(
             "bias", nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
             (cfg.n_embd,), cfg.param_dtype))
         x = x.astype(cfg.dtype)
-        kernel = kernel.astype(cfg.dtype)
         bias = bias.astype(cfg.dtype)
+        if wq != "fp":
+            from deepspeed_tpu.ops.pallas.quant_matmul import quant_dense_general
+            out = quant_dense_general(x, kernel,
+                                      self.get_variable("quant", "kernel_scale"),
+                                      bits=_QUANT_BITS[wq], n_contract=2)
+            return out + bias
+        kernel = kernel.astype(cfg.dtype)
         if cfg.attn_fused_out:
             out = jax.lax.dot_general(
                 x, kernel, (((x.ndim - 2, x.ndim - 1), (0, 1)), ((), ())))
@@ -205,6 +267,18 @@ class SelfAttention(nn.Module):
                                      (b, cfg.n_positions, cfg.n_head, cfg.head_dim), k.dtype)
             cached_v = self.variable("cache", "cached_value", jnp.zeros,
                                      (b, cfg.n_positions, cfg.n_head, cfg.head_dim), v.dtype)
+            # int8 KV pools (graft-quant-serve, the serving default): codes
+            # plus per-(slot, position, head) scales, quantize-on-write /
+            # dequantize-on-read — PagedKVCache(quantize=True) applied to
+            # the per-slot cache. Only serving.make_slot_cache(kv_quant=
+            # True) builds these pools, so which path traces is decided by
+            # the provided cache dtype, statically.
+            kv_q = cached_k.value.dtype == jnp.int8
+            if kv_q:
+                k_scale = self.variable("cache", "cached_key_scale", jnp.zeros,
+                                        (b, cfg.n_positions, cfg.n_head, 1), k.dtype)
+                v_scale = self.variable("cache", "cached_value_scale", jnp.zeros,
+                                        (b, cfg.n_positions, cfg.n_head, 1), v.dtype)
             cache_index = self.variable("cache", "cache_index", lambda: jnp.zeros([], jnp.int32))
             idx = cache_index.value
             if idx.ndim:
@@ -219,6 +293,11 @@ class SelfAttention(nn.Module):
                 from deepspeed_tpu.inference.serving.config import resolve_kv_write
                 mode, _ = resolve_kv_write(getattr(cfg, "serve_kv_write", None))
                 pos = idx[:, None] + jnp.arange(l)[None, :]  # [b, l]
+                if kv_q:
+                    k_w, k_s = _kv_quantize(k)
+                    v_w, v_s = _kv_quantize(v)
+                else:
+                    k_w, v_w = k, v
                 if mode == "dense":
                     # masked full-pool rebuild: one [b, l, P] one-hot and a
                     # [b, P, h, d] temporary PER LAYER per tick — kept as the
@@ -227,18 +306,37 @@ class SelfAttention(nn.Module):
                     # zero, so parked slots still drop their writes)
                     onehot = jax.nn.one_hot(pos, cfg.n_positions, dtype=jnp.float32)
                     written = (onehot.sum(1) > 0)[..., None, None]  # [b, P, 1, 1]
-                    upd_k = jnp.einsum("blp,blhd->bphd", onehot, k.astype(jnp.float32))
-                    upd_v = jnp.einsum("blp,blhd->bphd", onehot, v.astype(jnp.float32))
-                    cached_k.value = jnp.where(written, upd_k.astype(k.dtype), cached_k.value)
-                    cached_v.value = jnp.where(written, upd_v.astype(v.dtype), cached_v.value)
+
+                    def _dense_put(pool, vals, round_int=False):
+                        upd = jnp.einsum("blp,blhd->bphd", onehot,
+                                         vals.astype(jnp.float32))
+                        if round_int:
+                            # int8 codes survive the fp32 einsum exactly
+                            # (±127 ≪ 2^24); rint guards the cast back
+                            upd = jnp.rint(upd)
+                        return jnp.where(written, upd.astype(pool.dtype), pool)
+
+                    cached_k.value = _dense_put(cached_k.value, k_w, round_int=kv_q)
+                    cached_v.value = _dense_put(cached_v.value, v_w, round_int=kv_q)
+                    if kv_q:
+                        k_scale.value = _dense_put(k_scale.value, k_s)
+                        v_scale.value = _dense_put(v_scale.value, v_s)
                 else:
                     bidx = jnp.arange(b)[:, None]
                     # default scatter mode drops out-of-bounds updates —
                     # exactly the parked-slot contract
-                    cached_k.value = cached_k.value.at[bidx, pos].set(k)
-                    cached_v.value = cached_v.value.at[bidx, pos].set(v)
+                    cached_k.value = cached_k.value.at[bidx, pos].set(k_w)
+                    cached_v.value = cached_v.value.at[bidx, pos].set(v_w)
+                    if kv_q:
+                        k_scale.value = k_scale.value.at[bidx, pos].set(k_s)
+                        v_scale.value = v_scale.value.at[bidx, pos].set(v_s)
                 decode_lengths = idx + l
             else:
+                if kv_q:
+                    raise NotImplementedError(
+                        "int8 KV pools are a per-slot serving cache "
+                        "(make_slot_cache(kv_quant=True)); lockstep decode "
+                        "uses fp KV")
                 cached_k.value = jax.lax.dynamic_update_slice(cached_k.value, k, (0, idx, 0, 0))
                 cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, v, (0, idx, 0, 0))
                 # per-sequence live-length vector — the flash backend's decode
@@ -246,7 +344,12 @@ class SelfAttention(nn.Module):
                 # validity mask from it
                 decode_lengths = jnp.broadcast_to(idx + l, (b,))
             cache_index.value = idx + l
-            k, v = cached_k.value, cached_v.value
+            if kv_q:
+                # gather-dequant: attention reads fp values, HBM holds codes
+                k = cached_k.value.astype(q.dtype) * k_scale.value
+                v = cached_v.value.astype(q.dtype) * v_scale.value
+            else:
+                k, v = cached_k.value, cached_v.value
             causal = False
         from deepspeed_tpu.models.common import attention_geometry_kwargs
         attn_out = dot_product_attention(q,
@@ -264,25 +367,58 @@ class SelfAttention(nn.Module):
         return out
 
 
+class QuantDense(nn.Module):
+    """Drop-in for ``nn.Dense`` (identical param names/shapes/init/
+    partitioning, so checkpoints and shardings are unchanged) that adds
+    the quantized serving path: when built with ``serve_weight_dtype``
+    and this scope carries quant scales, the kernel arrives as int8/int4
+    codes and dequant fuses into the GEMM
+    (``ops/pallas/quant_matmul.py``)."""
+
+    config: GPT2Config
+    features: int
+    kernel_axes: Any = ("embed", "mlp")
+    bias_axes: Any = ("mlp",)
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        unbox = lambda p: p.value if isinstance(p, nn.meta.AxisMetadata) else p
+        wq = _serve_quant_mode(self, cfg)
+        in_features = x.shape[-1]
+        kshape = (in_features // 2 if wq == "int4" else in_features, self.features)
+        kernel = unbox(self.param(
+            "kernel", nn.with_logical_partitioning(_dense_init(), self.kernel_axes),
+            kshape, cfg.param_dtype))
+        bias = unbox(self.param(
+            "bias", nn.with_logical_partitioning(nn.initializers.zeros, self.bias_axes),
+            (self.features,), cfg.param_dtype))
+        x = x.astype(cfg.dtype)
+        bias = bias.astype(cfg.dtype)
+        if wq != "fp":
+            from deepspeed_tpu.ops.pallas.quant_matmul import quant_dense_general
+            out = quant_dense_general(x, kernel,
+                                      self.get_variable("quant", "kernel_scale"),
+                                      bits=_QUANT_BITS[wq], n_contract=1)
+            return out + bias
+        out = jax.lax.dot_general(x, kernel.astype(cfg.dtype),
+                                  (((x.ndim - 1,), (0,)), ((), ())))
+        return out + bias
+
+
 class MLP(nn.Module):
     config: GPT2Config
 
     @nn.compact
     def __call__(self, x, *, deterministic: bool = True):
         cfg = self.config
-        h = nn.Dense(features=4 * cfg.n_embd,
-                     dtype=cfg.dtype,
-                     param_dtype=cfg.param_dtype,
-                     kernel_init=nn.with_logical_partitioning(_dense_init(), ("embed", "mlp")),
-                     bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("mlp",)),
-                     name="c_fc")(x)
+        h = QuantDense(cfg, features=4 * cfg.n_embd,
+                       kernel_axes=("embed", "mlp"), bias_axes=("mlp",),
+                       name="c_fc")(x)
         h = jax.nn.gelu(h, approximate=True)
-        h = nn.Dense(features=cfg.n_embd,
-                     dtype=cfg.dtype,
-                     param_dtype=cfg.param_dtype,
-                     kernel_init=nn.with_logical_partitioning(_dense_init(), ("mlp", "embed")),
-                     bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
-                     name="c_proj")(h)
+        h = QuantDense(cfg, features=cfg.n_embd,
+                       kernel_axes=("mlp", "embed"), bias_axes=("embed",),
+                       name="c_proj")(h)
         if not deterministic and cfg.dropout > 0.0:
             h = nn.Dropout(rate=cfg.dropout)(h, deterministic=False)
         return h

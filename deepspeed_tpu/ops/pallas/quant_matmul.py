@@ -1,0 +1,177 @@
+"""Fused per-group dequant GEMM for weight-quantized serving programs
+(graft-quant-serve; reference ``csrc/transformer/inference/`` int8 path).
+
+The served kernel arrives as int8 codes (int4: packed two-per-byte along
+the contraction axis, ``ops/quantizer/weights.py`` layout) plus per-
+(K-group, output-column) scales ``[G, N]``. The GEMM reads codes from HBM
+— one byte (half a byte) per weight instead of two or four — and dequant
+happens on the way into the MXU, never as a materialized fp copy of the
+whole kernel:
+
+* ``impl="xla"`` (default off-TPU): unpack + broadcast-scale + dot. XLA
+  fuses the dequant into the matmul prologue; runs everywhere.
+* ``impl="pallas"``: grid ``(N-blocks, K-groups)``; each step DMAs one
+  ``[K/G, bn]`` code block and its ``[1, bn]`` scale row, dequantizes in
+  VMEM, and accumulates the partial product into the output block in
+  fp32 (``@pl.when`` k==0 init, the standard accumulation idiom). Block
+  boundaries align with scale groups by construction — one scale row per
+  accumulation step. Interpret mode makes it CPU-testable.
+
+Forward-only on purpose: serving programs never differentiate.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deepspeed_tpu.ops.quantizer.weights import unpack_rows
+
+IMPL_CHOICES = ("xla", "pallas")
+
+#: output-column block cap (fp32 accumulator block stays a few hundred KB)
+MAX_BN = 512
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def resolve_impl(kernel: str) -> str:
+    """Map an impl choice ("auto"|"xla"|"pallas") to a concrete impl for
+    the current backend (the ``moe_dispatch.resolve_impl`` convention)."""
+    if kernel == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    if kernel not in IMPL_CHOICES:
+        raise ValueError(f"quant_matmul impl must be one of {IMPL_CHOICES} "
+                         f"(or 'auto'), got {kernel!r}")
+    return kernel
+
+
+def _col_block(n: int) -> int:
+    """Largest divisor of N at most MAX_BN, so output blocks tile N
+    exactly and no step straddles a scale row."""
+    bn = min(n, MAX_BN)
+    while n % bn != 0:
+        bn -= 1
+    return bn
+
+
+def _unpack_block(q: jax.Array) -> jax.Array:
+    """In-kernel row unpack: packed ``[bk/2, bn]`` → int8 codes
+    ``[bk, bn]`` (low nibble = even row, high nibble = odd row;
+    arithmetic shift then mask, sign-extend > 7)."""
+    lo = q & 0xF
+    hi = (q >> 4) & 0xF
+    lo = jnp.where(lo > 7, lo - 16, lo)
+    hi = jnp.where(hi > 7, hi - 16, hi)
+    return jnp.stack([lo, hi], axis=1).reshape(2 * q.shape[0], q.shape[1])
+
+
+def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, *, bits):
+    gi = pl.program_id(1)
+    q = w_ref[...]
+    if bits == 4:
+        q = _unpack_block(q)
+    # dequant on the way into the MXU: fp32 scale multiply, then the
+    # activation dtype so bf16 serving feeds bf16 operands (fp32 accum)
+    w = (q.astype(jnp.float32) * s_ref[...]).astype(x_ref.dtype)
+    part = jax.lax.dot_general(x_ref[...], w, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+    @pl.when(gi == 0)
+    def _init():
+        o_ref[...] = part
+
+    @pl.when(gi != 0)
+    def _accum():
+        o_ref[...] += part
+
+
+def _pallas_quant_matmul(x: jax.Array, qw: jax.Array, scale: jax.Array,
+                         bits: int, interpret: Optional[bool]) -> jax.Array:
+    if interpret is None:
+        interpret = _interpret_default()
+    m, k = x.shape
+    g, n = scale.shape
+    bk = k // g
+    bkw = bk // 2 if bits == 4 else bk
+    bn = _col_block(n)
+
+    out = pl.pallas_call(
+        functools.partial(_qmm_kernel, bits=bits),
+        grid=(n // bn, g),
+        in_specs=[
+            pl.BlockSpec((m, bk), lambda j, gi: (0, gi)),
+            pl.BlockSpec((bkw, bn), lambda j, gi: (gi, j)),
+            pl.BlockSpec((1, bn), lambda j, gi: (gi, j)),
+        ],
+        out_specs=pl.BlockSpec((m, bn), lambda j, gi: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(x, qw, scale)
+    return out.astype(x.dtype)
+
+
+def _xla_quant_matmul(x: jax.Array, qw: jax.Array, scale: jax.Array,
+                      bits: int) -> jax.Array:
+    k = x.shape[1]
+    q = unpack_rows(qw) if bits == 4 else qw
+    g, n = scale.shape
+    w = (q.astype(jnp.float32).reshape(g, k // g, n) * scale[:, None, :])
+    w = w.reshape(k, n).astype(x.dtype)
+    out = jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    return out.astype(x.dtype)
+
+
+def quant_matmul(x: jax.Array, qw: jax.Array, scale: jax.Array, *,
+                 bits: int = 8, impl: str = "auto",
+                 interpret: Optional[bool] = None) -> jax.Array:
+    """``x [M, K] @ dequant(qw, scale) [K, N]`` → ``[M, N]`` in x's dtype.
+
+    ``qw`` is ``[K, N]`` int8 codes (bits=8) or ``[K/2, N]`` packed
+    nibbles (bits=4, ``weights.pack_rows`` layout); ``scale`` is
+    ``[G, N]`` fp32 with G dividing K."""
+    if bits not in (8, 4):
+        raise ValueError(f"quant_matmul supports bits in (8, 4), got {bits}")
+    k = x.shape[1]
+    g = scale.shape[0]
+    if k % g != 0:
+        raise ValueError(f"group count {g} must divide K={k}")
+    kw = qw.shape[0] * (2 if bits == 4 else 1)
+    if kw != k:
+        raise ValueError(f"code rows {qw.shape[0]}"
+                         f"{' (x2 packed)' if bits == 4 else ''} do not match "
+                         f"x's contraction K={k}")
+    resolved = resolve_impl(impl)
+    if resolved == "pallas":
+        return _pallas_quant_matmul(x, qw, scale, bits, interpret)
+    return _xla_quant_matmul(x, qw, scale, bits)
+
+
+def quant_dense_general(x: jax.Array, qkernel: jax.Array, scale: jax.Array, *,
+                        bits: int = 8, n_contract: int = 1,
+                        impl: str = "auto",
+                        interpret: Optional[bool] = None) -> jax.Array:
+    """``dot_general`` over a quantized kernel: contracts x's trailing
+    ``n_contract`` dims against the kernel's leading ``n_contract`` dims
+    (int4: the last contraction axis is stored halved). Output shape is
+    ``x.shape[:-n_contract] + qkernel.shape[n_contract:]`` — the
+    projection shapes ``models/gpt2.py`` declares."""
+    bshape = x.shape[:x.ndim - n_contract]
+    k = 1
+    for d in x.shape[x.ndim - n_contract:]:
+        k *= d
+    out_dims = qkernel.shape[n_contract:]
+    n = 1
+    for d in out_dims:
+        n *= d
+    out = quant_matmul(x.reshape(-1, k), qkernel.reshape(-1, n), scale,
+                       bits=bits, impl=impl, interpret=interpret)
+    return out.reshape(*bshape, *out_dims)

@@ -2,10 +2,14 @@
 ``csrc/quantization``)."""
 
 from deepspeed_tpu.ops.quantizer.core import (QuantParams, dequantize, fake_quantize, pack_int4,
-                                              quantize, quantized_reduction, swizzle_quant, unpack_int4)
+                                              quantize, quantize_lastaxis, quantized_reduction,
+                                              swizzle_quant, unpack_int4)
+from deepspeed_tpu.ops.quantizer.weights import (QUANT_PARITY_MAX_ABS, dequantize_params,
+                                                 quantize_params)
 
 # reference `ds_quantizer` entry (ops/quantizer/quantizer.py): QAT fake-quant
 ds_quantizer = fake_quantize
 
-__all__ = ["QuantParams", "quantize", "dequantize", "fake_quantize", "pack_int4", "unpack_int4",
-           "swizzle_quant", "quantized_reduction", "ds_quantizer"]
+__all__ = ["QuantParams", "QUANT_PARITY_MAX_ABS", "quantize", "quantize_lastaxis",
+           "dequantize", "fake_quantize", "pack_int4", "unpack_int4", "quantize_params",
+           "dequantize_params", "swizzle_quant", "quantized_reduction", "ds_quantizer"]

@@ -78,6 +78,23 @@ def quantize(x: jax.Array,
     return q.astype(jnp.uint8), QuantParams(scale=scale, offset=lo)
 
 
+def quantize_lastaxis(x: jax.Array, num_bits: int = 8) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric grouped quantization with one group per TRAILING-axis
+    vector — identical math to ``quantize(x, num_groups=prod(x.shape[:-1]))``
+    but shape- and sharding-preserving: the absmax reduce stays on the last
+    axis instead of flattening to ``[groups, group_size]``, so a
+    head-sharded ``[b, l, h, d]`` KV write quantizes in place on a tensor
+    mesh (no GSPMD all-gather of the pool — the ``serve_quant_decode_step``
+    R009 guarantee). Returns (int8 codes shaped like ``x``, fp32 scales
+    ``x.shape[:-1] + (1,)``)."""
+    qmax = float(2**(num_bits - 1) - 1)
+    flat = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(flat), axis=-1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / qmax, 1.0)
+    q = jnp.clip(jnp.rint(flat / scale), -qmax, qmax)
+    return q.astype(jnp.int8), scale
+
+
 def dequantize(q: jax.Array, params: QuantParams, shape=None) -> jax.Array:
     """Inverse of :func:`quantize` (reference ``dequantize.cu``)."""
     flat = q.astype(jnp.float32)
@@ -91,6 +108,10 @@ def dequantize(q: jax.Array, params: QuantParams, shape=None) -> jax.Array:
 def pack_int4(q: jax.Array) -> jax.Array:
     """Pack int4 codes (int8 storage, range ±7 or 0..15) two-per-byte along
     the last dim (must be even)."""
+    if q.shape[-1] % 2 != 0:
+        raise ValueError(f"pack_int4 needs an even trailing dim to pair "
+                         f"nibbles; got shape {tuple(q.shape)} — pad the "
+                         f"last axis or regroup before packing")
     lo = q[..., 0::2] & 0xF
     hi = q[..., 1::2] & 0xF
     return (lo | (hi << 4)).astype(jnp.int8)

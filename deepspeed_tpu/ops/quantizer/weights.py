@@ -1,0 +1,182 @@
+"""graft-quant-serve: per-group weight quantization for serving programs.
+
+``quantize_params`` converts a served param tree into (a) a tree of int8
+codes — int4 packed two-per-byte along the contraction axis — that is
+shape-compatible with the model's ``"params"`` collection, and (b) a
+mirror ``"quant"`` collection of per-group scales the modules read via
+``self.get_variable("quant", "kernel_scale")``. Dequant then fuses into
+the GEMM (``ops/pallas/quant_matmul.py``): decode moves one byte (or half
+a byte) per weight instead of two or four, which is the whole point of
+quantized serving on a bandwidth-bound decode step.
+
+Scope discipline (LLM.int8()/AWQ convention, reference
+``csrc/transformer/inference/``): projection **kernels only**. Embeddings,
+positional tables, LM heads, norms, and biases stay fp — they are a small
+fraction of the bytes and a large fraction of the quality risk. MoE
+subtrees are skipped too (router logits are precision-sensitive).
+
+Grouping: a kernel is viewed as ``[K, N]`` (K = flattened contraction
+dims, N = flattened output dims) and scaled per (K-group, output column)
+— ``scales[G, N]`` fp32, symmetric absmax, the grouped variant of
+``ops/quantizer/core.quantize`` whose groups run along the contraction
+axis so the GEMM kernel can apply one scale row per accumulation block.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.quantizer.core import divisor_groups, pack_int4, unpack_int4
+
+#: committed quantized-vs-fp logit parity envelope for the serving path
+#: (max |logit delta| on the pinned "test" config rig, measured by
+#: tests/unit/inference/test_quant_serving.py and enforced there — the
+#: PARITY_MAX_ULP pattern from tools/parity_check.py applied to serving).
+#: Measured on the pinned container: int8 0.006, int4 0.131; committed
+#: with ~4-8x headroom for seed variation. Int4 is wider by construction:
+#: 3-bit-mantissa codes through 2 layers of GEMMs.
+QUANT_PARITY_MAX_ABS = {"int8": 0.05, "int4": 0.5}
+
+#: param leaves whose path contains any of these tokens are never
+#: quantized, whatever their name/shape
+SKIP_TOKENS = ("wte", "wpe", "embed", "lm_head", "head", "moe", "router")
+
+#: scale-leaf name in the mirror "quant" collection
+SCALE_NAME = "kernel_scale"
+
+QMAX = {8: 127.0, 4: 7.0}
+
+
+def quant_bits(weight_dtype: str) -> int:
+    if weight_dtype not in ("int8", "int4"):
+        raise ValueError(f"no bit width for weight_dtype {weight_dtype!r}")
+    return 8 if weight_dtype == "int8" else 4
+
+
+def contract_dims(leaf_ndim: int) -> int:
+    """Contraction-dim count for a projection kernel, the GPT-2 family
+    layout rule: 2-D ``[in, out]`` and 4-D fused-QKV ``[E, 3, H, D]``
+    contract one leading dim; 3-D attn-out ``[H, D, E]`` contracts two."""
+    return 2 if leaf_ndim == 3 else 1
+
+
+def pack_rows(codes2d: jax.Array) -> jax.Array:
+    """Pack int4 codes ``[K, N]`` two-per-byte along K → ``[K//2, N]``
+    (row pair ``(2i, 2i+1)`` → low/high nibble of packed row ``i``);
+    :func:`core.pack_int4` transposed so the pairing runs along the
+    contraction axis the GEMM accumulates over."""
+    return jnp.swapaxes(pack_int4(jnp.swapaxes(codes2d, 0, 1)), 0, 1)
+
+
+def unpack_rows(packed2d: jax.Array) -> jax.Array:
+    """Inverse of :func:`pack_rows`: ``[K//2, N]`` → sign-extended int8
+    codes ``[K, N]``."""
+    return jnp.swapaxes(unpack_int4(jnp.swapaxes(packed2d, 0, 1)), 0, 1)
+
+
+def eligible(path, leaf) -> bool:
+    """Quantize only floating projection kernels outside the skip list."""
+    if path[-1] != "kernel" or leaf.ndim < 2:
+        return False
+    if not jnp.issubdtype(leaf.dtype, jnp.floating):
+        return False
+    joined = "/".join(str(p).lower() for p in path)
+    return not any(tok in joined for tok in SKIP_TOKENS)
+
+
+def quantize_leaf(leaf: jax.Array, bits: int, group_size: int):
+    """One kernel → (codes shaped like the serving module declares them,
+    scales ``[G, N]`` fp32). Int4 packs along the last contraction axis,
+    halving that axis in the stored shape."""
+    nc = contract_dims(leaf.ndim)
+    shape = tuple(leaf.shape)
+    k = 1
+    for d in shape[:nc]:
+        k *= d
+    w = leaf.reshape(k, -1).astype(jnp.float32)
+    g = divisor_groups(k, group_size)
+    qmax = QMAX[bits]
+    wg = w.reshape(g, k // g, w.shape[1])
+    absmax = jnp.max(jnp.abs(wg), axis=1)  # [g, N]
+    scale = jnp.where(absmax > 0, absmax / qmax, 1.0).astype(jnp.float32)
+    codes = jnp.clip(jnp.rint(wg / scale[:, None, :]), -qmax, qmax)
+    codes = codes.astype(jnp.int8).reshape(k, -1)
+    if bits == 4:
+        if shape[nc - 1] % 2 != 0:
+            raise ValueError(f"int4 packing needs an even contraction axis; "
+                             f"kernel shape {shape} has {shape[nc - 1]} at "
+                             f"axis {nc - 1}")
+        codes = pack_rows(codes)
+        shape = shape[:nc - 1] + (shape[nc - 1] // 2,) + shape[nc:]
+    return codes.reshape(shape), scale
+
+
+def dequantize_leaf(codes: jax.Array, scale: jax.Array, bits: int,
+                    dtype=jnp.float32) -> jax.Array:
+    """Full-kernel dequant view (tests / XLA reference; the serving GEMM
+    never materializes this for the whole tree)."""
+    nc = contract_dims(codes.ndim)
+    shape = tuple(codes.shape)
+    k = 1
+    for d in shape[:nc]:
+        k *= d
+    q2d = codes.reshape(k, -1)
+    if bits == 4:
+        q2d = unpack_rows(q2d)
+        k *= 2
+        shape = shape[:nc - 1] + (shape[nc - 1] * 2,) + shape[nc:]
+    g = scale.shape[0]
+    w = q2d.astype(jnp.float32).reshape(g, k // g, -1) * scale[:, None, :]
+    return w.reshape(shape).astype(dtype)
+
+
+def quantize_params(params, weight_dtype: str, group_size: int = 64):
+    """Quantize a served param tree.
+
+    Returns ``(qparams, qscales)``: ``qparams`` mirrors ``params`` with
+    eligible kernels replaced by codes (int8 same-shape; int4 packed,
+    contraction axis halved) and everything else passed through
+    unchanged; ``qscales`` is the sparse mirror tree holding a
+    ``kernel_scale`` leaf at each quantized kernel's scope — the value
+    for the ``"quant"`` collection in ``module.apply``.
+    """
+    if weight_dtype == "fp":
+        return params, None
+    bits = quant_bits(weight_dtype)
+
+    def walk(tree, path):
+        q, s = {}, {}
+        for name, leaf in tree.items():
+            sub = path + (name,)
+            if isinstance(leaf, dict) or hasattr(leaf, "items"):
+                qc, sc = walk(leaf, sub)
+                q[name] = qc
+                if sc:
+                    s[name] = sc
+            elif eligible(sub, leaf):
+                q[name], s[SCALE_NAME] = quantize_leaf(leaf, bits, group_size)
+            else:
+                q[name] = leaf
+        return q, s
+
+    qparams, qscales = walk(params, ())
+    return qparams, qscales
+
+
+def dequantize_params(qparams, qscales, weight_dtype: str, dtype=jnp.float32):
+    """Inverse view of :func:`quantize_params` (tests / debugging)."""
+    if qscales is None:
+        return qparams
+    bits = quant_bits(weight_dtype)
+
+    def walk(qt, st):
+        out = {}
+        for name, leaf in qt.items():
+            if isinstance(leaf, dict) or hasattr(leaf, "items"):
+                out[name] = walk(leaf, st.get(name, {}) if st else {})
+            elif name == "kernel" and st and SCALE_NAME in st:
+                out[name] = dequantize_leaf(leaf, st[SCALE_NAME], bits, dtype)
+            else:
+                out[name] = leaf
+        return out
+
+    return walk(qparams, qscales)

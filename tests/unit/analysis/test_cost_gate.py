@@ -28,14 +28,18 @@ def graft_lint():
     return mod
 
 
+_ENVS = (routing.ENV_ROUTE, "DS_PIPE_ACT_BUDGET_MB", "DS_PIPE_SCHEDULE",
+         "DS_SERVE_KV_WRITE", "DS_SERVE_WQ")
+
+
 @pytest.fixture(autouse=True)
 def _clean():
-    for env in (routing.ENV_ROUTE, "DS_PIPE_ACT_BUDGET_MB", "DS_PIPE_SCHEDULE"):
+    for env in _ENVS:
         os.environ.pop(env, None)
     set_topology(None)
     routing.set_default_route(None, None)
     yield
-    for env in (routing.ENV_ROUTE, "DS_PIPE_ACT_BUDGET_MB", "DS_PIPE_SCHEDULE"):
+    for env in _ENVS:
         os.environ.pop(env, None)
     set_topology(None)
     routing.set_default_route(None, None)
@@ -54,7 +58,8 @@ def test_committed_cost_baseline_covers_the_matrix():
     # the gate scenarios must be banked or the ratchet has no teeth
     for name in ("moe_ep_step", "pipe_chunked_step", "pipe_1f1b_step",
                  "zero3_train_step", "train_batch_parity",
-                 "serve_decode_step", "reshard_resume"):
+                 "serve_decode_step", "serve_quant_decode_step",
+                 "reshard_resume"):
         assert name in programs, name
         assert programs[name]["peak_bytes"] > 0
         assert "collective_counts" in programs[name]
@@ -70,6 +75,19 @@ def test_committed_cost_baseline_covers_the_matrix():
     from deepspeed_tpu.analysis.scenarios import SERVE_DECODE_BUDGET_MB
     assert (programs["serve_decode_step"]["peak_transient_bytes"]
             <= SERVE_DECODE_BUDGET_MB * 2**20)
+    # graft-quant-serve's headline A/B, banked: the quantized decode tick
+    # moves strictly fewer compiled wire bytes AND holds a far smaller
+    # peak than the fp tick, under its own committed budget (PERF.md §PR16)
+    from deepspeed_tpu.analysis.scenarios import SERVE_QUANT_DECODE_BUDGET_MB
+    quant = programs["serve_quant_decode_step"]
+    assert quant["bytes_moved"]["compiled"] < (
+        programs["serve_decode_step"]["bytes_moved"]["compiled"])
+    assert quant["peak_bytes"] < programs["serve_decode_step"]["peak_bytes"]
+    assert quant["peak_transient_bytes"] <= SERVE_QUANT_DECODE_BUDGET_MB * 2**20
+    assert quant["collective_counts"]["compiled"]["all_reduce"] == 5
+    # exactly the two argmax gathers — one more would mean GSPMD started
+    # re-gathering the int8 codes or the KV pool every tick
+    assert quant["collective_counts"]["compiled"]["all_gather"] == 2
     # the banked 1F1B transient must sit strictly below both the chunked
     # schedule's transient AND its own committed budget — the ratchet-DOWN
     # this PR's schedule refactor banked (PERF.md §PR11)
@@ -175,6 +193,42 @@ def test_serve_kv_write_env_drift_exits_1(graft_lint, tmp_path, monkeypatch):
     from deepspeed_tpu.analysis.scenarios import SERVE_DECODE_BUDGET_MB
     assert (report["cost"]["serve_decode_step"]
             ["memory"]["peak_transient_bytes"] > SERVE_DECODE_BUDGET_MB * 2**20)
+
+
+def test_serve_wq_env_drift_exits_1(graft_lint, tmp_path, monkeypatch):
+    """DS_SERVE_WQ=fp against the committed-int8 quantized serving
+    scenario: the builder resolves the env layer, so the traced program
+    swings back to full-width fp kernels — peak bytes jump past the R013
+    ratchet tolerance while the scenario's ``serve_weight_dtype`` metadata
+    stays the committed intent (``resolve_intended_weight_dtype`` skips
+    env). The graft-quant-serve seeded regression."""
+    monkeypatch.setenv("DS_SERVE_WQ", "fp")
+    rc = graft_lint.run(["--cost", "--scenarios", "serve_quant_decode_step",
+                         "--no-ast", "--out", str(tmp_path), "-q"])
+    assert rc == 1
+    report = _report(tmp_path)
+    hits = report["programs"]["serve_quant_decode_step"]["summary"]["rule_hits"]
+    assert hits.get("R013"), hits
+    # the committed fp->int8 saving, forfeited by the drift: measured peak
+    # exceeds the banked quantized peak well past tolerance
+    path = os.path.join(REPO, "analysis_results", "cost_baseline.json")
+    with open(path) as fh:
+        banked = json.load(fh)["programs"]["serve_quant_decode_step"]
+    measured = report["cost"]["serve_quant_decode_step"]["memory"]["peak_bytes"]
+    assert measured > banked["peak_bytes"] * 1.05
+
+
+def test_serve_quant_scenario_clean_on_committed_intent(graft_lint, tmp_path):
+    """The committed int8 configuration passes the full cost gate, and the
+    traced program really is the quantized one: int8 weight codes show up
+    as a peak-bytes drop vs the fp serving tick, not just metadata."""
+    rc = graft_lint.run(["--cost", "--scenarios", "serve_quant_decode_step",
+                         "--no-ast", "--out", str(tmp_path), "-q"])
+    assert rc == 0
+    report = _report(tmp_path)
+    cost = report["cost"]["serve_quant_decode_step"]
+    assert cost["memory"]["peak_transient_bytes"] > 0
+    assert cost["collectives"]["compiled"]["counts"].get("all_reduce") == 5
 
 
 def test_serve_scenario_clean_on_committed_write(graft_lint, tmp_path):

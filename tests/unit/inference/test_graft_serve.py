@@ -244,7 +244,8 @@ def test_config_kv_write_reaches_the_traced_program():
 
     assert run("dense") == run(None)  # semantically identical writes
     # two modes on one engine = two program sets, never a shared trace
-    assert {k[-1] for k in engine._serve_cache} == {"dense", "scatter"}
+    # (key layout: ..., kv_write, weight_dtype — kv_write is second-to-last)
+    assert {k[-2] for k in engine._serve_cache} == {"dense", "scatter"}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,125 @@
+"""The fused dequant GEMM (ops/pallas/quant_matmul.py): Pallas kernel
+(interpret mode on CPU) vs the XLA fallback vs a full-dequant reference,
+int8 and packed-int4, plus the ``quant_dense_general`` shape contract the
+gpt2 projections rely on."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.pallas.quant_matmul import (quant_dense_general,
+                                                   quant_matmul, resolve_impl)
+from deepspeed_tpu.ops.quantizer.weights import (dequantize_leaf, pack_rows,
+                                                 quantize_leaf)
+
+
+def _case(m, k, n, bits, group_size, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((m, k)), dtype)
+    w = jnp.asarray(rng.standard_normal((k, n)), jnp.float32)
+    codes, scale = quantize_leaf(w, bits, group_size)
+    ref = x @ dequantize_leaf(codes, scale, bits, dtype).astype(dtype)
+    return x, codes, scale, ref
+
+
+@pytest.mark.parametrize("bits", [8, 4])
+@pytest.mark.parametrize("m,k,n,gs", [(8, 64, 32, 16), (1, 128, 64, 64),
+                                      (5, 32, 16, 32)])
+def test_xla_impl_matches_full_dequant_reference(bits, m, k, n, gs):
+    x, codes, scale, ref = _case(m, k, n, bits, gs, seed=bits * m)
+    out = quant_matmul(x, codes, scale, bits=bits, impl="xla")
+    assert out.dtype == x.dtype
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("bits", [8, 4])
+@pytest.mark.parametrize("m,k,n,gs", [(8, 64, 32, 16), (4, 128, 128, 64)])
+def test_pallas_interpret_matches_xla(bits, m, k, n, gs):
+    """The acceptance gate: the Pallas kernel (interpret mode — the same
+    kernel body the TPU compiles) is forward-parity with the XLA
+    fallback."""
+    x, codes, scale, ref = _case(m, k, n, bits, gs, seed=7)
+    out = quant_matmul(x, codes, scale, bits=bits, impl="pallas",
+                       interpret=True)
+    xla = quant_matmul(x, codes, scale, bits=bits, impl="xla")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(xla),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pallas_interpret_bf16_activations():
+    x, codes, scale, ref = _case(8, 64, 32, 8, 16, dtype=jnp.bfloat16)
+    out = quant_matmul(x, codes, scale, bits=8, impl="pallas", interpret=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_quant_dense_general_qkv_shape():
+    """4-D [E, 3, H, D] kernel, 1 contraction dim: the fused QKV
+    projection's exact call."""
+    rng = np.random.default_rng(1)
+    E, H, D = 32, 4, 8
+    x = jnp.asarray(rng.standard_normal((2, 5, E)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((E, 3, H, D)), jnp.float32)
+    codes, scale = quantize_leaf(w, 8, 16)
+    out = quant_dense_general(x, codes, scale, bits=8, n_contract=1)
+    assert out.shape == (2, 5, 3, H, D)
+    ref = jnp.einsum("bse,ethd->bsthd",
+                     x, dequantize_leaf(codes, scale, 8, jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_quant_dense_general_attn_out_shape():
+    """3-D [H, D, E] kernel, 2 contraction dims: the attention
+    out-projection's exact call."""
+    rng = np.random.default_rng(2)
+    E, H, D = 32, 4, 8
+    x = jnp.asarray(rng.standard_normal((2, 5, H, D)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((H, D, E)), jnp.float32)
+    codes, scale = quantize_leaf(w, 8, 16)
+    out = quant_dense_general(x, codes, scale, bits=8, n_contract=2)
+    assert out.shape == (2, 5, E)
+    ref = jnp.einsum("bshd,hde->bse",
+                     x, dequantize_leaf(codes, scale, 8, jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_quant_dense_general_int4_packed_kernel():
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((3, 64)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((64, 32)), jnp.float32)
+    codes, scale = quantize_leaf(w, 4, 16)
+    assert codes.shape == (32, 32)  # packed: contraction axis halved
+    out = quant_dense_general(x, codes, scale, bits=4, n_contract=1)
+    ref = x @ dequantize_leaf(codes, scale, 4, jnp.float32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pack_rows_layout_is_what_the_kernel_unpacks():
+    """pack_rows pairs ADJACENT K rows into one byte; the kernel's
+    in-register unpack must invert it exactly."""
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.integers(-7, 8, (16, 8)), jnp.int8)
+    from deepspeed_tpu.ops.quantizer.weights import unpack_rows
+    np.testing.assert_array_equal(np.asarray(unpack_rows(pack_rows(q))),
+                                  np.asarray(q))
+
+
+def test_resolve_impl_and_validation():
+    assert resolve_impl("auto") in ("xla", "pallas")
+    with pytest.raises(ValueError):
+        resolve_impl("cuda")
+    x = jnp.zeros((2, 64), jnp.float32)
+    codes, scale = quantize_leaf(jnp.zeros((64, 32), jnp.float32), 8, 16)
+    with pytest.raises(ValueError):
+        quant_matmul(x, codes, scale, bits=5)
+    with pytest.raises(ValueError):  # K mismatch
+        quant_matmul(jnp.zeros((2, 32), jnp.float32), codes, scale, bits=8)

@@ -1,0 +1,190 @@
+"""graft-quant-serve weight quantization (ops/quantizer/weights.py):
+int4 pack/unpack round-trip properties over random shapes (including the
+odd-trailing-dim refusal edge), per-group dequant error bands, the
+``quantize_params`` skip rules, and the shape contract the gpt2
+projections statically declare (int4 halves the contraction axis)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.quantizer import pack_int4, unpack_int4
+from deepspeed_tpu.ops.quantizer.core import quantize, quantize_lastaxis
+from deepspeed_tpu.ops.quantizer.weights import (contract_dims, dequantize_leaf,
+                                                 dequantize_params, eligible,
+                                                 quantize_leaf, quantize_params)
+
+
+# ---------------------------------------------------------------------------
+# pack/unpack round-trip properties
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("shape", [(2,), (8,), (3, 4), (2, 3, 6), (1, 16),
+                                   (5, 2), (4, 4, 4, 2)])
+def test_pack_int4_roundtrip_symmetric(shape):
+    rng = np.random.default_rng(hash(shape) % 2**31)
+    q = jnp.asarray(rng.integers(-7, 8, shape), jnp.int8)
+    np.testing.assert_array_equal(np.asarray(unpack_int4(pack_int4(q))),
+                                  np.asarray(q))
+
+
+@pytest.mark.parametrize("shape", [(4,), (3, 8), (2, 2, 6)])
+def test_pack_int4_roundtrip_asymmetric(shape):
+    """Asymmetric (unsigned 0..15) codes round-trip with
+    ``symmetric=False`` — no sign extension of the high nibbles."""
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.integers(0, 16, shape), jnp.int8)
+    out = unpack_int4(pack_int4(q), symmetric=False)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(q))
+
+
+@pytest.mark.parametrize("shape", [(3,), (4, 5), (2, 3, 7), (1,)])
+def test_pack_int4_odd_trailing_dim_refused(shape):
+    """An odd trailing dim cannot pair nibbles — refused loudly, never
+    silently truncated (the caller pads or regroups)."""
+    q = jnp.zeros(shape, jnp.int8)
+    with pytest.raises(ValueError, match="even trailing dim"):
+        pack_int4(q)
+
+
+def test_pack_int4_halves_bytes():
+    q = jnp.asarray(np.random.default_rng(2).integers(-7, 8, (6, 8)), jnp.int8)
+    packed = pack_int4(q)
+    assert packed.shape == (6, 4) and packed.dtype == jnp.int8
+    assert packed.nbytes * 2 == q.nbytes
+
+
+# ---------------------------------------------------------------------------
+# dequant error bands per group size
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("group_size", [16, 64, 128])
+@pytest.mark.parametrize("bits,wd", [(8, "int8"), (4, "int4")])
+def test_quantize_leaf_error_band(group_size, bits, wd):
+    """Per-group symmetric absmax error bound: |x - dq(q(x))| <= scale/2
+    per group, scale = group absmax / qmax. Finer groups give tighter
+    bands because each group's absmax is closer to its members."""
+    rng = np.random.default_rng(group_size * bits)
+    leaf = jnp.asarray(rng.standard_normal((256, 32)), jnp.float32)
+    codes, scale = quantize_leaf(leaf, bits, group_size)
+    back = dequantize_leaf(codes, scale, bits, jnp.float32)
+    assert back.shape == leaf.shape
+    groups = scale.shape[0]
+    err = np.abs(np.asarray(back - leaf)).reshape(groups, -1, leaf.shape[1])
+    bound = np.asarray(scale)[:, None, :] / 2 + 1e-7
+    assert (err <= bound).all()
+
+
+def test_finer_groups_tighter_error():
+    rng = np.random.default_rng(0)
+    leaf = jnp.asarray(rng.standard_normal((256, 16)) *
+                       np.exp(rng.standard_normal((256, 16))), jnp.float32)
+
+    def max_err(gs):
+        codes, scale = quantize_leaf(leaf, 4, gs)
+        return float(jnp.abs(dequantize_leaf(codes, scale, 4, jnp.float32)
+                             - leaf).max())
+
+    assert max_err(16) <= max_err(256)
+
+
+def test_quantize_lastaxis_matches_grouped_quantize():
+    """The sharding-preserving last-axis form is the SAME math as
+    ``quantize(num_groups=prod(leading))`` — codes and scales bit-equal."""
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((4, 2, 8, 16)), jnp.float32)
+    codes, scale = quantize_lastaxis(x, num_bits=8)
+    assert codes.shape == x.shape and scale.shape == x.shape[:-1] + (1,)
+    ref_codes, ref_params = quantize(x, num_bits=8, symmetric=True,
+                                     num_groups=4 * 2 * 8)
+    np.testing.assert_array_equal(np.asarray(codes).reshape(-1, 16),
+                                  np.asarray(ref_codes))
+    np.testing.assert_allclose(np.asarray(scale).reshape(-1, 1),
+                               np.asarray(ref_params.scale))
+
+
+# ---------------------------------------------------------------------------
+# quantize_params: skip rules + the projection shape contract
+# ---------------------------------------------------------------------------
+def _toy_params():
+    rng = np.random.default_rng(7)
+
+    def w(*shape):
+        return jnp.asarray(rng.standard_normal(shape), jnp.float32)
+
+    return {
+        "wte": {"embedding": w(64, 32)},
+        "h_0": {
+            "attn": {"qkv": {"kernel": w(32, 3, 4, 8), "bias": w(3, 4, 8)},
+                     "out": {"kernel": w(4, 8, 32), "bias": w(32)}},
+            "mlp": {"c_fc": {"kernel": w(32, 128), "bias": w(128)},
+                    "c_proj": {"kernel": w(128, 32), "bias": w(32)}},
+            "ln_1": {"scale": w(32), "bias": w(32)},
+        },
+        "lm_head": {"kernel": w(32, 64)},
+    }
+
+
+def test_quantize_params_skips_embeddings_norms_and_head():
+    params = _toy_params()
+    qparams, qscales = quantize_params(params, "int8", group_size=16)
+    # embeddings / head / norms / biases stay fp, bit-identical
+    np.testing.assert_array_equal(np.asarray(qparams["wte"]["embedding"]),
+                                  np.asarray(params["wte"]["embedding"]))
+    np.testing.assert_array_equal(np.asarray(qparams["lm_head"]["kernel"]),
+                                  np.asarray(params["lm_head"]["kernel"]))
+    assert qparams["h_0"]["ln_1"]["scale"].dtype == jnp.float32
+    assert qparams["h_0"]["attn"]["qkv"]["bias"].dtype == jnp.float32
+    # projection kernels become int8 codes, same shape as declared
+    for scope in (("attn", "qkv"), ("attn", "out"), ("mlp", "c_fc"),
+                  ("mlp", "c_proj")):
+        leaf = qparams["h_0"][scope[0]][scope[1]]["kernel"]
+        orig = params["h_0"][scope[0]][scope[1]]["kernel"]
+        assert leaf.dtype == jnp.int8 and leaf.shape == orig.shape
+        # the scale mirror is sparse: only quantized scopes carry one
+        assert "kernel_scale" in qscales["h_0"][scope[0]][scope[1]]
+    assert "wte" not in qscales and "lm_head" not in qscales
+
+
+def test_quantize_params_int4_halves_contraction_axis():
+    params = _toy_params()
+    qparams, _ = quantize_params(params, "int4", group_size=16)
+    # 1 contraction dim for 2-D/4-D kernels, 2 for the 3-D out-proj
+    assert qparams["h_0"]["attn"]["qkv"]["kernel"].shape == (16, 3, 4, 8)
+    assert qparams["h_0"]["attn"]["out"]["kernel"].shape == (4, 4, 32)
+    assert qparams["h_0"]["mlp"]["c_fc"]["kernel"].shape == (16, 128)
+    assert qparams["h_0"]["mlp"]["c_proj"]["kernel"].shape == (64, 32)
+
+
+@pytest.mark.parametrize("wd", ["int8", "int4"])
+def test_dequantize_params_within_band(wd):
+    params = _toy_params()
+    qparams, qscales = quantize_params(params, wd, group_size=16)
+    back = dequantize_params(qparams, qscales, wd)
+    k = np.asarray(params["h_0"]["mlp"]["c_fc"]["kernel"])
+    bk = np.asarray(back["h_0"]["mlp"]["c_fc"]["kernel"])
+    qmax = 127.0 if wd == "int8" else 7.0
+    # per-group bound, loosened to the global worst group scale
+    assert np.abs(bk - k).max() <= np.abs(k).max() / qmax + 1e-6
+
+
+def test_quantize_params_fp_is_identity():
+    params = _toy_params()
+    qparams, qscales = quantize_params(params, "fp")
+    assert qparams is params and qscales is None
+
+
+def test_contract_dims_and_eligibility():
+    assert contract_dims(2) == 1 and contract_dims(4) == 1
+    assert contract_dims(3) == 2  # [H, D, E] out-proj contracts (H, D)
+    w = jnp.zeros((8, 8), jnp.float32)
+    assert eligible(("h_0", "mlp", "c_fc", "kernel"), w)
+    assert not eligible(("wte", "kernel"), w)           # embedding scope
+    assert not eligible(("lm_head", "kernel"), w)       # head scope
+    assert not eligible(("h_0", "mlp", "c_fc", "bias"), jnp.zeros((8,)))
+    assert not eligible(("h_0", "c", "kernel"), jnp.zeros((8,), jnp.float32))
+    assert not eligible(("h", "kernel"), jnp.zeros((8, 8), jnp.int8))
+
+
+def test_quantize_leaf_int4_odd_contraction_refused():
+    with pytest.raises(ValueError):
+        quantize_leaf(jnp.zeros((7, 8), jnp.float32), 4, 64)

@@ -340,6 +340,20 @@ SERVE_RUNGS = {
                            "SERVE_QPS": "8", "SERVE_REQUESTS": "48",
                            "SERVE_PROMPT": "64", "SERVE_NEW": "32",
                            "SERVE_SPEC": "0"},
+    # graft-quant-serve A/B (ISSUE 16): fp vs int8/int4 weights + int8 KV
+    # on the same trace under the SAME KV byte budget (unset POOL_BYTES =
+    # half the fp full-context footprint, so fp is admission-starved at
+    # saturation while quant holds every slot). Rows carry blocks-per-GB
+    # and the comparison row carries goodput ratio + token-level greedy
+    # match of the quantized arm vs fp (PERF.md §PR16).
+    "serve_qps_wq8": {"SERVE_MODE": "quant_ab", "SERVE_SLOTS": "8",
+                      "SERVE_QPS": "16", "SERVE_REQUESTS": "48",
+                      "SERVE_PROMPT": "64", "SERVE_NEW": "32",
+                      "SERVE_WQ": "int8"},
+    "serve_qps_wq4": {"SERVE_MODE": "quant_ab", "SERVE_SLOTS": "8",
+                      "SERVE_QPS": "16", "SERVE_REQUESTS": "48",
+                      "SERVE_PROMPT": "64", "SERVE_NEW": "32",
+                      "SERVE_WQ": "int4"},
 }
 
 

@@ -30,6 +30,18 @@ Env: SERVE_MODEL=test|125m|350m...   model family config
      SERVE_SPEC=0 SERVE_SPEC_K=4    speculative decoding (KD student
                                     drafter, half the target's layers)
      SERVE_POOL_TOKENS=0            KV pool budget (0 = slots x context)
+     SERVE_POOL_BYTES=0             KV pool BYTE budget (wins over tokens;
+                                    the quant A/B's shared-HBM constraint)
+     SERVE_WQ=fp                    served weight dtype (fp|int8|int4) for
+                                    continuous rows; quant_ab's quant arm
+                                    uses int8 unless int4 is set here
+     SERVE_KV_QUANT=1               int8 KV pools for continuous rows
+                                    (the graft-quant-serve serving default)
+  SERVE_MODE may also name "quant_ab": the graft-quant-serve comparison —
+  the SAME trace served twice, fp weights + fp KV vs int8 weights + int8
+  KV, under the SAME KV byte budget (SERVE_POOL_BYTES), reporting
+  blocks-per-GB, goodput ratio at the offered load, and the token-level
+  greedy match rate of the quantized arm against fp (PERF.md §PR16).
      SERVE_TELEMETRY=0              per-tick spans + serve events to a
                                     graft-trace JSONL run dir (drift
                                     summary rides the continuous row)
@@ -60,6 +72,9 @@ CHUNK = int(os.environ.get("SERVE_CHUNK", "16"))
 SPEC = os.environ.get("SERVE_SPEC", "0") == "1"
 SPEC_K = int(os.environ.get("SERVE_SPEC_K", "4"))
 POOL_TOKENS = int(os.environ.get("SERVE_POOL_TOKENS", "0"))
+POOL_BYTES = int(os.environ.get("SERVE_POOL_BYTES", "0"))
+WQ = os.environ.get("SERVE_WQ", "fp")
+KV_QUANT = os.environ.get("SERVE_KV_QUANT", "1") == "1"
 TELEMETRY = os.environ.get("SERVE_TELEMETRY", "0") == "1"
 SEED = int(os.environ.get("SERVE_SEED", "0"))
 
@@ -125,10 +140,11 @@ def _lat_row(hist):
             if k in ("p50", "p90", "p99", "min", "max", "mean")}
 
 
-def serve_evidence(engine, slots):
+def serve_evidence(engine, slots, wq="fp", kv_quant=False):
     """Static lint + cost evidence for the decode program this run serves
     (the perf-ladder contract: a banked latency row must prove its
-    program passes the same gates CI enforces)."""
+    program passes the same gates CI enforces). ``wq``/``kv_quant`` price
+    the QUANTIZED program when a quantized row banks evidence."""
     try:
         import jax
         import jax.numpy as jnp
@@ -140,11 +156,15 @@ def serve_evidence(engine, slots):
                                                               make_apply_fn)
 
         slots = engine._pow2_bucket(slots)  # price the program actually served
-        cache = make_slot_cache(engine.module, slots)
-        decode = build_decode_step(make_apply_fn(engine.module, engine._mparams),
+        module, params = engine.module, engine.params
+        if wq != "fp":
+            from deepspeed_tpu.inference.serving.scheduler import _quant_view
+            module, params = _quant_view(module, params, wq, 64)
+        cache = make_slot_cache(module, slots, kv_quant=kv_quant)
+        decode = build_decode_step(make_apply_fn(module, engine._mparams),
                                    False, 1.0, 0, 1.0)
         tokens = jnp.zeros((slots,), jnp.int32)
-        jaxpr = jax.make_jaxpr(decode)(engine.params, cache, tokens)
+        jaxpr = jax.make_jaxpr(decode)(params, cache, tokens)
         info = ProgramInfo(name="serve_decode", jaxpr=jaxpr, kind="serve_decode")
         findings, _ = analysis.run_program_rules(info)
         mem = estimate_memory(info)
@@ -152,12 +172,15 @@ def serve_evidence(engine, slots):
         return {"serve_lint": analysis.summarize(findings),
                 "serve_cost_peak_bytes": mem.peak_bytes,
                 "serve_cost_transient_bytes": mem.peak_transient_bytes,
-                "serve_kv_write": mode, "serve_kv_write_source": src}
+                "serve_kv_write": mode, "serve_kv_write_source": src,
+                "serve_weight_dtype": wq, "serve_kv_quant": kv_quant}
     except Exception as e:  # evidence must never kill a run
         return {"serve_evidence_error": f"{type(e).__name__}: {str(e)[:120]}"}
 
 
-def run_continuous(engine, cfg, trace, drafter=None, telemetry=None):
+def run_continuous(engine, cfg, trace, drafter=None, telemetry=None,
+                   wq=None, kv_quant=None, pool_bytes=None, label="continuous",
+                   collect_outputs=False):
     from deepspeed_tpu.inference.serving import (ContinuousBatchingScheduler,
                                                  Request, ServingConfig)
 
@@ -165,6 +188,13 @@ def run_continuous(engine, cfg, trace, drafter=None, telemetry=None):
     scfg = ServingConfig(
         slots=SLOTS, page_size=16,
         kv_pool_tokens=POOL_TOKENS or None,
+        kv_pool_bytes=(POOL_BYTES or None) if pool_bytes is None else pool_bytes,
+        # explicit wq (the quant_ab arms) is passed verbatim as the config
+        # layer; env-driven runs map SERVE_WQ=fp to None. DS_SERVE_WQ
+        # still outranks either — the drift seam is deliberate, and lint
+        # (not the bench) is what catches a leaked env
+        weight_dtype=(None if WQ == "fp" else WQ) if wq is None else wq,
+        kv_quant=KV_QUANT if kv_quant is None else kv_quant,
         prefill_chunk=CHUNK if CHUNK > 0 else n_positions,
         speculation={"enabled": drafter is not None, "k": SPEC_K})
     sched = ContinuousBatchingScheduler(engine, scfg, drafter=drafter,
@@ -177,12 +207,15 @@ def run_continuous(engine, cfg, trace, drafter=None, telemetry=None):
 
     t0 = time.monotonic()
     i = 0
+    reqs = []
     while i < len(trace) or sched.in_flight or len(sched.queue):
         now = time.monotonic() - t0
         while i < len(trace) and trace[i][0] <= now:
             _, prompt, new = trace[i]
-            sched.submit(Request(prompt=prompt, max_new_tokens=new,
-                                 arrival_time=t0 + trace[i][0]))
+            r = Request(prompt=prompt, max_new_tokens=new,
+                        arrival_time=t0 + trace[i][0])
+            sched.submit(r)
+            reqs.append(r)
             i += 1
         if sched.in_flight or len(sched.queue):
             sched.step()
@@ -191,14 +224,19 @@ def run_continuous(engine, cfg, trace, drafter=None, telemetry=None):
     wall = time.monotonic() - t0
     stats = sched.stats()
     row = {
-        "mode": "continuous", "wall_s": round(wall, 3),
+        "mode": label, "wall_s": round(wall, 3),
         "finished": stats["finished"], "refused": stats["refused"],
         "goodput_tok_s": round(stats["generated_tokens"] / wall, 1),
         "ttft": _lat_row(stats["ttft"]), "per_token": _lat_row(stats["per_token"]),
         "ticks": stats["ticks"], "pool": stats["pool"],
+        "weight_dtype": stats["weight_dtype"],
+        "weight_dtype_source": stats["weight_dtype_source"],
+        "kv_quant": stats["kv_quant"],
         "chunked_prefill": CHUNK > 0, "prefill_chunk": CHUNK or n_positions,
         "slots": sched.slots,
     }
+    if collect_outputs:
+        row["_outputs"] = [list(r.output) for r in reqs]
     if drafter is not None:
         row["speculation"] = {"k": SPEC_K,
                               "drafted": stats["drafted"],
@@ -208,6 +246,81 @@ def run_continuous(engine, cfg, trace, drafter=None, telemetry=None):
     if telemetry is not None and telemetry.enabled:
         row["telemetry"] = telemetry.drift_summary()
     return row
+
+
+def _token_match(quant_outputs, fp_outputs):
+    """Token-level greedy match of the quantized arm against fp — the
+    speculative-acceptance metric applied across serving stacks: per
+    request, the longest common prefix counts as accepted (a diverged
+    token invalidates its suffix exactly as a rejected draft would)."""
+    accepted = total = 0
+    exact = 0
+    for q, f in zip(quant_outputs, fp_outputs):
+        n = 0
+        for a, b in zip(q, f):
+            if a != b:
+                break
+            n += 1
+        accepted += n
+        total += max(len(f), len(q))
+        exact += int(q == f and len(q) > 0)
+    return {"token_match_rate": round(accepted / max(total, 1), 4),
+            "exact_output_requests": exact, "requests": len(fp_outputs)}
+
+
+def quant_ab(engine, cfg, trace, header, drafter=None):
+    """The graft-quant-serve A/B (PERF.md §PR16): the same trace served by
+    the fp stack and by the int8-weight + int8-KV stack under the SAME KV
+    byte budget (SERVE_POOL_BYTES; defaults to the fp pool's full-context
+    footprint HALVED, so the budget is genuinely scarce for fp). Reports
+    blocks-per-GB, goodput ratio, and the token-level greedy match."""
+    budget = POOL_BYTES
+    if not budget:
+        fp_probe = _probe_kv_bytes_per_token(engine, cfg)
+        budget = int(SLOTS * cfg.n_positions * fp_probe) // 2
+        print(f"# quant_ab: SERVE_POOL_BYTES unset, using half the fp "
+              f"full-context footprint = {budget} bytes", flush=True)
+    wq = WQ if WQ != "fp" else "int8"
+    arms = {}
+    for label, arm_wq, kvq in (("fp", "fp", False), ("quant", wq, True)):
+        row = run_continuous(engine, cfg, trace, drafter=drafter, wq=arm_wq,
+                             kv_quant=kvq, pool_bytes=budget,
+                             label=f"quant_ab:{label}", collect_outputs=True)
+        row.update(serve_evidence(engine, SLOTS, wq=arm_wq, kv_quant=kvq))
+        arms[label] = row
+        printable = dict(header, **{k: v for k, v in row.items()
+                                    if not k.startswith("_")})
+        print(json.dumps(printable), flush=True)
+    fp_row, q_row = arms["fp"], arms["quant"]
+    comparison = {
+        "comparison": "quant_vs_fp", "qps": QPS, "weight_dtype": wq,
+        "kv_pool_bytes": budget,
+        "kv_blocks_fp": fp_row["pool"]["num_blocks"],
+        "kv_blocks_quant": q_row["pool"]["num_blocks"],
+        "kv_blocks_per_gb_fp": fp_row["pool"]["kv_blocks_per_gb"],
+        "kv_blocks_per_gb_quant": q_row["pool"]["kv_blocks_per_gb"],
+        "goodput_fp_tok_s": fp_row["goodput_tok_s"],
+        "goodput_quant_tok_s": q_row["goodput_tok_s"],
+        "goodput_ratio": round(q_row["goodput_tok_s"]
+                               / max(fp_row["goodput_tok_s"], 1e-9), 3),
+        "greedy_match": _token_match(q_row["_outputs"], fp_row["_outputs"]),
+        "quant_beats_fp_goodput":
+            q_row["goodput_tok_s"] > fp_row["goodput_tok_s"],
+        "quant_more_blocks_per_gb":
+            q_row["pool"]["kv_blocks_per_gb"] > fp_row["pool"]["kv_blocks_per_gb"],
+    }
+    print(json.dumps(comparison), flush=True)
+    return comparison
+
+
+def _probe_kv_bytes_per_token(engine, cfg):
+    """The fp cache's per-token KV footprint, measured the same way the
+    scheduler's byte-budget sizing measures it."""
+    from deepspeed_tpu.inference.serving import ServingConfig
+    from deepspeed_tpu.inference.serving.scheduler import ContinuousBatchingScheduler
+    probe = ContinuousBatchingScheduler(
+        engine, ServingConfig(slots=SLOTS, kv_quant=False))
+    return probe._kv_bytes_per_token()
 
 
 def run_static(engine, cfg, trace):
@@ -264,9 +377,11 @@ def main():
     # knob incompatibilities are knowable from env alone — fail them
     # BEFORE paying minutes of engine build + compile + continuous replay
     modes = ["continuous", "static"] if MODES == "both" else MODES.split(",")
-    unknown = [m for m in modes if m not in ("continuous", "static")]
+    unknown = [m for m in modes if m not in ("continuous", "static", "quant_ab")]
     if unknown:
         raise SystemExit(f"unknown SERVE_MODE entry {unknown[0]!r}")
+    if WQ not in ("fp", "int8", "int4"):
+        raise SystemExit(f"SERVE_WQ must be fp|int8|int4, got {WQ!r}")
     if LONG_EVERY and "static" in modes:
         raise SystemExit(
             "static mode cannot batch ragged prompts (SERVE_LONG_EVERY): "
@@ -283,7 +398,7 @@ def main():
     trace = poisson_trace(rng, cfg.vocab_size)
 
     drafter = None
-    if SPEC and "continuous" in modes:
+    if SPEC and ("continuous" in modes or "quant_ab" in modes):
         d_module, d_params, teacher_layers = build_drafter(engine, cfg, n_positions)
         drafter = (d_module, d_params)
         print(f"# drafter: {d_module.config.n_layer}-layer KD student seeded "
@@ -309,7 +424,12 @@ def main():
         if mode == "continuous":
             row = run_continuous(engine, cfg, trace, drafter=drafter,
                                  telemetry=telemetry)
-            row.update(serve_evidence(engine, SLOTS))
+            row.update(serve_evidence(engine, SLOTS,
+                                      wq=row["weight_dtype"],
+                                      kv_quant=row["kv_quant"]))
+        elif mode == "quant_ab":
+            quant_ab(engine, cfg, trace, header, drafter=drafter)
+            continue
         else:
             row = run_static(engine, cfg, trace)
         rows[mode] = dict(header, **row)
