@@ -1,11 +1,11 @@
 """Elastic training (reference ``deepspeed/elasticity/``)."""
 
-from deepspeed_tpu.elasticity.elastic_agent import (DSElasticAgent, read_heartbeat,
-                                                    touch_heartbeat)
+from deepspeed_tpu.elasticity.elastic_agent import (DSElasticAgent, heartbeat_age,
+                                                    read_heartbeat, touch_heartbeat)
 from deepspeed_tpu.elasticity.elasticity import (ElasticityConfig, ElasticityConfigError,
                                                  ElasticityError, ElasticityIncompatibleWorldSize,
                                                  compute_elastic_config, elasticity_enabled)
 
 __all__ = ["compute_elastic_config", "elasticity_enabled", "ElasticityConfig", "ElasticityError",
            "ElasticityConfigError", "ElasticityIncompatibleWorldSize", "DSElasticAgent",
-           "touch_heartbeat", "read_heartbeat"]
+           "touch_heartbeat", "read_heartbeat", "heartbeat_age"]

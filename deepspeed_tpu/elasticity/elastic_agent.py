@@ -116,6 +116,24 @@ def read_heartbeat(path: Optional[str] = None) -> Optional[Dict]:
     return data if isinstance(data, dict) else None
 
 
+def heartbeat_age(path: Optional[str] = None,
+                  now: Optional[float] = None) -> Optional[float]:
+    """Seconds since the heartbeat file was last touched, or None when
+    there is no file (never started / already reaped). Mtime is the
+    liveness clock — the payload's ``monotonic`` field is the *writer's*
+    clock and only comparable in-host; mtime staleness is what both the
+    supervisor's hang detector and the fleet router's liveness probe
+    compare against their timeout."""
+    path = path or os.environ.get(HEARTBEAT_ENV)
+    if not path:
+        return None
+    try:
+        mtime = os.stat(path).st_mtime
+    except OSError:
+        return None
+    return max(0.0, (now if now is not None else time.time()) - mtime)
+
+
 class DSElasticAgent:
     """Supervise a training command; on death or heartbeat silence, restart
     it at the next world size.

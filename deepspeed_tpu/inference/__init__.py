@@ -1,7 +1,7 @@
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.inference.engine import InferenceEngine
 from deepspeed_tpu.inference.entry import init_inference
-from deepspeed_tpu.inference import serving
+from deepspeed_tpu.inference import fleet, serving
 
 __all__ = ["DeepSpeedInferenceConfig", "InferenceEngine", "init_inference",
-           "serving"]
+           "fleet", "serving"]

@@ -1,0 +1,29 @@
+"""graft-fleet: multi-replica serving — router, autoscaler, live KV
+migration (ISSUE 17 / ROADMAP item 1, the "millions of users" layer
+above one graft-serve process).
+
+* :mod:`protocol` — the line-delimited JSON wire format workers speak.
+* :mod:`replica` — replica handles: in-process (:class:`LocalReplica`,
+  SimClock-testable) and subprocess (:class:`SubprocessReplica`, real
+  pipes + PR-13 heartbeat liveness).
+* :mod:`router` — :class:`FleetRouter`: least-loaded dispatch from the
+  replicas' own tick signals, at-most-once completion accounting,
+  death recovery (bundle re-admission / re-dispatch).
+* :mod:`autoscaler` — :class:`Autoscaler`: hysteretic replica-count
+  decisions from the same ``serve_tick`` signals, offline-replayable.
+* :mod:`migrate` — the KV migration codec over the PR-9 manifest+digest
+  machinery (save/load/verify bundles, scheduler restore).
+* :mod:`worker` — ``python -m deepspeed_tpu.inference.fleet.worker``.
+"""
+
+from deepspeed_tpu.inference.fleet.autoscaler import AutoscalePolicy, Autoscaler
+from deepspeed_tpu.inference.fleet.migrate import (load_bundle,
+                                                   make_bundle_migrate,
+                                                   receive_bundle,
+                                                   restore_into, save_bundle)
+from deepspeed_tpu.inference.fleet.replica import LocalReplica, SubprocessReplica
+from deepspeed_tpu.inference.fleet.router import FleetRouter
+
+__all__ = ["AutoscalePolicy", "Autoscaler", "FleetRouter", "LocalReplica",
+           "SubprocessReplica", "load_bundle", "make_bundle_migrate",
+           "receive_bundle", "restore_into", "save_bundle"]

@@ -1,0 +1,191 @@
+"""graft-fleet live KV migration codec: scheduler payloads ⇄ a durable,
+digest-verified bundle directory.
+
+A SIGTERM'd replica exports every in-flight request
+(``scheduler.export_inflight``: host bookkeeping + the slot's committed
+KV rows, quantized KV riding as-is — PR-16 codes + scales are just
+smaller rows) and lands them as ONE bundle directory through the PR-9
+checkpoint machinery:
+
+* each request's arrays in ``req_<origin_id>.npz`` (prompt, output,
+  token_times, and every KV leaf keyed by its cache ``keystr`` path);
+* scalar bookkeeping in ``bundle.json``;
+* ``manifest.json`` with the file inventory AND per-leaf
+  shape/dtype/sha256 of the KV pytree (``state_leaf_entries``);
+* published crash-atomically (``staging → fsync → rename``), so a
+  receiver never observes a partial bundle.
+
+The receiver verifies twice: ``verify_checkpoint_dir`` (file inventory,
+before deserializing anything) and ``verify_state_leaves`` (per-leaf
+digests over the DESERIALIZED arrays — the end-to-end "bit-exact KV"
+proof the acceptance criterion names). Verification failure raises
+``CheckpointCorruptError``→``MigrationError``; capacity shortfalls on
+the receiver are NOT errors — ``restore_into`` returns the refused
+payloads so the router re-dispatches them elsewhere.
+"""
+
+import json
+import os
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.inference.serving.scheduler import MigrationError
+from deepspeed_tpu.runtime.resilience.manifest import (CheckpointCorruptError,
+                                                       atomic_publish,
+                                                       build_manifest,
+                                                       staging_path,
+                                                       state_leaf_entries,
+                                                       verify_checkpoint_dir,
+                                                       verify_state_leaves,
+                                                       write_manifest)
+from deepspeed_tpu.utils.logging import log_dist
+
+BUNDLE_META = "bundle.json"
+BUNDLE_VERSION = 1
+
+#: payload fields that are plain JSON scalars/lists (everything else —
+#: prompt, kv — travels in the npz)
+_SCALAR_FIELDS = ("request_id", "state", "max_new_tokens", "eos_token_id",
+                  "arrival_time", "output", "prefill_pos", "first_token_time",
+                  "token_times", "drafted_tokens", "accepted_tokens", "meta",
+                  "length", "next_token", "kv_quant", "weight_dtype",
+                  "capacity", "spec_k")
+
+
+def _npz_name(origin_id: int) -> str:
+    return f"req_{int(origin_id)}.npz"
+
+
+def _kv_state(payloads: List[dict]) -> Dict[str, Dict[str, Dict[str, np.ndarray]]]:
+    """The bundle's KV arrays as one nested pytree keyed by origin id —
+    the structure ``state_leaf_entries`` digests at save and the receiver
+    re-digests after deserialization (same structure ⇒ same leaf keys)."""
+    return {str(p["request_id"]): {role: dict(leaves)
+                                   for role, leaves in p["kv"].items()}
+            for p in payloads}
+
+
+def save_bundle(payloads: List[dict], bundle_dir: str) -> str:
+    """Land ``payloads`` (from ``scheduler.export_inflight``) as a
+    published bundle directory; returns ``bundle_dir``. Crash-atomic: a
+    kill mid-save leaves only an inert staging dir, never a torn bundle."""
+    if not payloads:
+        raise MigrationError("empty migration payload list — nothing to bundle")
+    base = os.path.dirname(os.path.abspath(bundle_dir)) or "."
+    os.makedirs(base, exist_ok=True)
+    staging = staging_path(base, os.path.basename(bundle_dir))
+    os.makedirs(staging, exist_ok=True)
+    meta = {"version": BUNDLE_VERSION, "requests": []}
+    for p in payloads:
+        rec = {k: p[k] for k in _SCALAR_FIELDS}
+        rec["npz"] = _npz_name(p["request_id"])
+        arrays = {"prompt": np.asarray(p["prompt"], np.int32)}
+        for role, leaves in p["kv"].items():
+            for key, arr in leaves.items():
+                arrays[f"{role}::{key}"] = np.asarray(arr)
+        np.savez(os.path.join(staging, rec["npz"]), **arrays)
+        meta["requests"].append(rec)
+    with open(os.path.join(staging, BUNDLE_META), "w") as fh:
+        json.dump(meta, fh, indent=1)
+    manifest = build_manifest(staging,
+                              leaf_entries=state_leaf_entries(_kv_state(payloads)),
+                              extra={"kind": "kv_migration_bundle"})
+    write_manifest(staging, manifest)
+    atomic_publish(staging, bundle_dir)
+    log_dist(f"graft-fleet: migration bundle published at {bundle_dir} "
+             f"({len(payloads)} requests)")
+    return bundle_dir
+
+
+def load_bundle(bundle_dir: str) -> List[dict]:
+    """Read + verify a bundle back into scheduler payloads.
+
+    Two integrity gates, both PR-9 machinery: the file inventory BEFORE
+    deserializing (truncation/bit-flip caught without touching numpy) and
+    the per-leaf KV digests AFTER (the npz decode round trip proven, not
+    assumed). Either failing raises :class:`MigrationError` — garbage KV
+    must never reach a slot."""
+    try:
+        manifest = verify_checkpoint_dir(bundle_dir)
+    except CheckpointCorruptError as e:
+        raise MigrationError(f"migration bundle failed integrity "
+                             f"verification: {e}") from e
+    meta_path = os.path.join(bundle_dir, BUNDLE_META)
+    try:
+        with open(meta_path) as fh:
+            meta = json.load(fh)
+    except (OSError, ValueError) as e:
+        raise MigrationError(f"unreadable bundle meta {meta_path}: {e}") from e
+    payloads: List[dict] = []
+    for rec in meta.get("requests", []):
+        with np.load(os.path.join(bundle_dir, rec["npz"])) as npz:
+            kv: Dict[str, Dict[str, np.ndarray]] = {}
+            prompt = None
+            for key in npz.files:
+                if key == "prompt":
+                    prompt = np.asarray(npz[key], np.int32)
+                    continue
+                role, _, leaf_key = key.partition("::")
+                kv.setdefault(role, {})[leaf_key] = np.asarray(npz[key])
+        p = {k: rec[k] for k in _SCALAR_FIELDS}
+        p["prompt"] = prompt
+        p["kv"] = kv
+        payloads.append(p)
+    try:
+        verify_state_leaves(_kv_state(payloads), manifest, bundle_dir)
+    except CheckpointCorruptError as e:
+        raise MigrationError(f"migrated KV failed digest verification: "
+                             f"{e}") from e
+    return payloads
+
+
+def restore_into(scheduler, payloads: List[dict],
+                 bundle_dir: str = "") -> Tuple[List, List[dict]]:
+    """Admit verified payloads into ``scheduler``; returns ``(admitted
+    requests, refused payloads)``. Capacity refusals (no free slot / pool
+    blocks) come back as payloads for the router to place elsewhere;
+    compat mismatches raise (``admit_migrated``'s contract)."""
+    admitted, refused = [], []
+    for p in payloads:
+        req = scheduler.admit_migrated(p)
+        if req is None:
+            refused.append(p)
+        else:
+            admitted.append(req)
+    if scheduler.telemetry is not None:
+        scheduler.telemetry.emit("serve_migrate_in", migrated=len(admitted),
+                                 refused=len(refused), bundle=str(bundle_dir))
+    return admitted, refused
+
+
+def receive_bundle(scheduler, bundle_dir: str) -> Tuple[List, List[dict]]:
+    """The receiver's whole path: verify, deserialize, re-digest, admit."""
+    return restore_into(scheduler, load_bundle(bundle_dir), bundle_dir)
+
+
+def make_bundle_migrate(bundle_dir: str) -> Callable:
+    """A ``scheduler.serve(migrate=...)`` hook that lands in-flight work
+    at ``bundle_dir``. Export happens WITHOUT releasing slots; only a
+    successfully published bundle releases them — a failed save leaves
+    the scheduler able to fall back to the PR-14 drain."""
+    def _migrate(scheduler, signal: str) -> dict:
+        payloads = scheduler.export_inflight(release=False)
+        if not payloads:
+            return {"migrated": 0, "bundle": bundle_dir}
+        try:
+            save_bundle(payloads, bundle_dir)
+        except MigrationError:
+            raise
+        except Exception as e:  # noqa: BLE001 — any save failure means drain
+            raise MigrationError(f"bundle save failed: "
+                                 f"{type(e).__name__}: {e}") from e
+        scheduler.release_inflight()
+        return {"migrated": len(payloads), "bundle": bundle_dir}
+    return _migrate
+
+
+def bundle_rids(payloads: List[dict]) -> List[Optional[str]]:
+    """The fleet-wide ids riding each payload's ``meta`` (None for
+    requests submitted outside a router)."""
+    return [p.get("meta", {}).get("fleet_rid") for p in payloads]

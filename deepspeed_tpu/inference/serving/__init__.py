@@ -2,6 +2,10 @@
 speculative decoding (ISSUE 14 / ROADMAP item 1)."""
 
 from deepspeed_tpu.inference.serving.blocks import BlockPool
+from deepspeed_tpu.inference.serving.events import (SERVE_EVENT_SCHEMAS,
+                                                    iter_serve_events,
+                                                    last_tick_signals,
+                                                    validate_event)
 from deepspeed_tpu.inference.serving.config import (ENV_KV_WRITE,
                                                     ENV_WEIGHT_DTYPE,
                                                     ServingConfig,
@@ -19,15 +23,19 @@ from deepspeed_tpu.inference.serving.programs import (make_slot_cache,
 from deepspeed_tpu.inference.serving.queue import RequestQueue
 from deepspeed_tpu.inference.serving.request import (ACTIVE, FINISHED, PREFILL,
                                                      QUEUED, REFUSED, Request)
-from deepspeed_tpu.inference.serving.scheduler import ContinuousBatchingScheduler
+from deepspeed_tpu.inference.serving.scheduler import (MIGRATABLE_STATES,
+                                                       ContinuousBatchingScheduler,
+                                                       MigrationError)
 
 __all__ = [
     "ACTIVE", "FINISHED", "PREFILL", "QUEUED", "REFUSED",
     "BlockPool", "ContinuousBatchingScheduler", "ENV_KV_WRITE",
-    "ENV_WEIGHT_DTYPE", "Request",
-    "RequestQueue", "ServingConfig", "SpeculationConfig", "make_slot_cache",
+    "ENV_WEIGHT_DTYPE", "MIGRATABLE_STATES", "MigrationError", "Request",
+    "RequestQueue", "SERVE_EVENT_SCHEMAS", "ServingConfig",
+    "SpeculationConfig", "iter_serve_events", "last_tick_signals",
+    "make_slot_cache",
     "resolve_intended_kv_write", "resolve_intended_weight_dtype",
     "resolve_kv_write", "resolve_weight_dtype", "serve_programs",
     "set_default_kv_write", "set_default_weight_dtype", "slot_capacity",
-    "stamp_lengths",
+    "stamp_lengths", "validate_event",
 ]

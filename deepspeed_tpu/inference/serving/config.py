@@ -171,6 +171,16 @@ class ServingConfig(DeepSpeedConfigModel):
     #: codes + per-(slot, position, head) scales, quantize-on-write /
     #: dequantize-on-read). False keeps fp KV for parity debugging
     kv_quant: bool = True
+    #: emit a schema'd ``serve_tick`` telemetry event (queue depth,
+    #: in-flight slots, TTFT p50/p99, BlockPool fragmentation — the
+    #: fleet router/autoscaler input signals) every N ticks; 0 disables.
+    #: Events are buffered (window-cadence flush), not fsynced per tick
+    tick_telemetry_every: int = Field(1, ge=0)
+    #: cadence (seconds) of the serving-role heartbeat block
+    #: (``touch_heartbeat`` payload: slots in flight, queue depth, last
+    #: tick monotonic) — a no-op unless running under a supervisor that
+    #: set ``DS_ELASTIC_HEARTBEAT_FILE``
+    heartbeat_interval: float = Field(1.0, ge=0.0)
     #: sampling (scheduler-global; speculation requires greedy)
     do_sample: bool = False
     temperature: float = 1.0

@@ -1,0 +1,76 @@
+"""Schema'd graft-trace events for the serving path (graft-fleet).
+
+``scheduler.stats()`` always computed the per-tick load signals — queue
+depth, in-flight slots, TTFT percentiles, BlockPool fragmentation — but
+until graft-fleet nothing landed them in the telemetry sink. The fleet
+router and autoscaler are pure *consumers* of these events: a replica's
+``serve_tick`` JSONL line and the ``tick`` message it sends the router
+over its pipe carry the SAME payload (``scheduler.signals()``), so the
+autoscale decision is reproducible offline from the run directory alone.
+
+Schema discipline mirrors ``telemetry/sink.py``: adding fields is free
+(readers ignore unknown keys), removing/renaming one bumps
+``TELEMETRY_SCHEMA_VERSION``. ``validate_event`` is the tier-1 gate that
+keeps producers honest — every event the serving path emits must carry
+at least its documented field set.
+"""
+
+from typing import Dict, Iterable, Optional
+
+#: required fields per serving event kind (the documented schema: each
+#: producer must supply at least these; ``t`` and ``event`` are stamped
+#: by the sink itself)
+SERVE_EVENT_SCHEMAS: Dict[str, frozenset] = {
+    # one per scheduler tick (cadence: ServingConfig.tick_telemetry_every)
+    # — the router/autoscaler input signals, straight from signals()
+    "serve_tick": frozenset({
+        "tick", "kind", "queue_depth", "in_flight", "slots", "free_slots",
+        "ttft_p50", "ttft_p99", "pool_free_blocks",
+        "pool_fragmentation_tokens",
+    }),
+    # terminal accounting of a preemption drain (PR 14 contract)
+    "serve_drain": frozenset({"signal", "in_flight", "refused"}),
+    # per-request retirement row
+    "serve_request": frozenset({"request_id", "state", "prompt_len",
+                                "new_tokens"}),
+    # live KV migration: SIGTERM'd replica hands in-flight work off
+    "serve_migrate_out": frozenset({"signal", "migrated", "bundle"}),
+    # peer accepted a migration bundle (digest-verified restore)
+    "serve_migrate_in": frozenset({"migrated", "refused", "bundle"}),
+    # one per restored request on the receiving replica
+    "serve_admit_migrated": frozenset({"request_id", "migrated_from",
+                                       "state", "length"}),
+}
+
+
+def validate_event(record: Dict, kind: Optional[str] = None) -> None:
+    """Raise ``ValueError`` when ``record`` does not carry the documented
+    field set for its serving event kind. Unknown kinds pass (schema
+    covers serving events only; readers must ignore foreign events)."""
+    k = kind or record.get("event")
+    want = SERVE_EVENT_SCHEMAS.get(k)
+    if want is None:
+        return
+    missing = sorted(want - set(record))
+    if missing:
+        raise ValueError(f"serving event {k!r} missing fields {missing} "
+                         f"(got {sorted(record)})")
+
+
+def iter_serve_events(path: str, kinds: Optional[Iterable[str]] = None):
+    """Yield serving events from a telemetry JSONL run file (torn tails
+    skipped — same contract as ``sink.iter_events``)."""
+    from deepspeed_tpu.runtime.telemetry.sink import iter_events
+    want = set(kinds) if kinds is not None else set(SERVE_EVENT_SCHEMAS)
+    for rec in iter_events(path):
+        if rec.get("event") in want:
+            yield rec
+
+
+def last_tick_signals(path: str) -> Optional[Dict]:
+    """The newest ``serve_tick`` event in a replica's telemetry JSONL —
+    what a file-tailing autoscaler (no pipe to the replica) reads."""
+    last = None
+    for rec in iter_serve_events(path, kinds=("serve_tick",)):
+        last = rec
+    return last

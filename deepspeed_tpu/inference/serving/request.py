@@ -43,6 +43,11 @@ class Request:
     drafted_tokens: int = 0
     accepted_tokens: int = 0
 
+    # opaque caller annotations riding the request (graft-fleet: the
+    # router's fleet-wide id, a migrated request's origin id) — never
+    # read by the scheduler itself
+    meta: dict = dataclasses.field(default_factory=dict)
+
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
         if self.prompt.size == 0:

@@ -52,6 +52,21 @@ from deepspeed_tpu.runtime.telemetry.metrics import Histogram
 from deepspeed_tpu.utils.logging import log_dist
 
 
+class MigrationError(RuntimeError):
+    """Live KV migration refused or failed verification (graft-fleet).
+
+    Raised loudly instead of degrading: a half-migrated request is worse
+    than a drained one, so callers (``serve``'s migrate hook, the fleet
+    router) fall back to the PR-14 drain contract when they see it."""
+
+
+#: request states the migration codec can serialize: a PREFILL request's
+#: state is fully described by (prompt, prefill_pos, committed KV); an
+#: ACTIVE one adds (output, next_token). QUEUED requests never migrate —
+#: they have no KV and are simply re-admitted by the router.
+MIGRATABLE_STATES = (PREFILL, ACTIVE)
+
+
 def _quant_view(module, params, weight_dtype: str, group_size: int):
     """graft-quant-serve: the (quant module, params bundle) pair a
     quantized serving path closes over. The module is rebuilt with
@@ -357,7 +372,55 @@ class ContinuousBatchingScheduler:
         self.ticks[kind] += 1
         if self.telemetry is not None:
             self.telemetry.end_step(step_no)
+            every = self.config.tick_telemetry_every
+            if every and step_no % every == 0:
+                # the fleet router/autoscaler input signals, landed as a
+                # schema'd JSONL event (events.SERVE_EVENT_SCHEMAS);
+                # buffered — the window flush syncs, not every tick
+                self.telemetry.emit("serve_tick", flush=False,
+                                    tick=step_no, kind=kind, **self.signals())
+        self._touch_serving_heartbeat(step_no)
         return kind
+
+    # ------------------------------------------------------------------
+    # load signals (graft-fleet: the router/autoscaler currency)
+    # ------------------------------------------------------------------
+    def signals(self) -> dict:
+        """The per-tick load signals ``stats()`` always computed but never
+        published: queue depth, in-flight slots, TTFT p50/p99, BlockPool
+        occupancy/fragmentation. This exact dict is (a) the ``serve_tick``
+        telemetry event body, (b) the replica's ``tick`` protocol message
+        to the fleet router, and (c) the autoscaler's decision input."""
+        ttft = self.ttft_hist
+        return {
+            "queue_depth": len(self.queue),
+            "in_flight": len(self.in_flight),
+            "slots": self.slots,
+            "free_slots": len(self._free_slots()),
+            "finished": len(self.finished),
+            "ttft_p50": ttft.percentile(50) if ttft.count else None,
+            "ttft_p99": ttft.percentile(99) if ttft.count else None,
+            "pool_free_blocks": self.pool.free_blocks,
+            "pool_fragmentation_tokens": self.pool.fragmentation_tokens(),
+        }
+
+    def _touch_serving_heartbeat(self, tick: int) -> None:
+        """Refresh the PR-13 supervisor heartbeat with a serving role
+        block (slots in flight, queue depth, last tick monotonic) at
+        ``heartbeat_interval`` cadence. A no-op outside a supervised
+        process (no ``DS_ELASTIC_HEARTBEAT_FILE``) — the env check is the
+        first thing ``touch_heartbeat`` does."""
+        import os
+        from deepspeed_tpu.elasticity.elastic_agent import (HEARTBEAT_ENV,
+                                                            touch_heartbeat)
+        if not os.environ.get(HEARTBEAT_ENV):
+            return
+        touch_heartbeat(
+            min_interval=self.config.heartbeat_interval,
+            payload={"role": "serving", "tick": tick,
+                     "slots_in_flight": len(self.in_flight),
+                     "queue_depth": len(self.queue),
+                     "last_tick_monotonic": time.monotonic()})
 
     # -- prefill -------------------------------------------------------
     def _prefill_tick(self, slots: List[int]) -> None:
@@ -499,6 +562,201 @@ class ContinuousBatchingScheduler:
                 self._drafter_cache, _ = self.dfns["verify"](
                     d_params, d_cache, jax.numpy.asarray(block))
 
+    # ------------------------------------------------------------------
+    # live KV migration (graft-fleet)
+    # ------------------------------------------------------------------
+    def _kv_slot_leaves(self, cache, slot: int, length: int) -> Dict[str, np.ndarray]:
+        """Host copies of one slot's committed KV rows — every pool leaf
+        (``KV_LEAVES``) plus its kv_quant ``*_scale`` companion, keyed by
+        the leaf's ``keystr`` path so target and drafter caches (same leaf
+        names, different depths) stay unambiguous. Only ``[:length]`` rows
+        travel: everything past the committed prefix is scratch."""
+        out: Dict[str, np.ndarray] = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(cache)[0]:
+            name = _leaf_name(path)
+            if name in KV_LEAVES or name.endswith("_scale"):
+                host = np.asarray(jax.device_get(leaf))
+                # np.array copy=True, NOT ascontiguousarray: a row-prefix
+                # slice is already contiguous, so ascontiguousarray would
+                # return a zero-copy VIEW into the device buffer — which
+                # the next donated decode step frees under the payload
+                out[jax.tree_util.keystr(path)] = np.array(
+                    host[slot, :length], copy=True)
+        return out
+
+    def _restore_slot_kv(self, cache, slot: int, leaves: Dict[str, np.ndarray],
+                         length: int):
+        """Write migrated KV rows back into one slot of ``cache`` on
+        device (``.at[slot, :length].set``). Refuses — ``MigrationError``
+        — on a missing/mis-shaped/mis-typed leaf rather than serving a
+        half-restored cache.
+
+        The write must stay on device: a ``device_put`` of a host-mutated
+        copy is zero-copy on the CPU backend, so the restored leaf would
+        alias numpy-owned memory — and the next decode step DONATES the
+        cache, handing XLA a buffer it doesn't own to free (heap
+        corruption, found the hard way). ``.at[].set`` yields an
+        XLA-owned buffer on the leaf's existing placement."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(cache)
+        new_leaves = []
+        for path, leaf in flat:
+            name = _leaf_name(path)
+            if name not in KV_LEAVES and not name.endswith("_scale"):
+                new_leaves.append(leaf)
+                continue
+            key = jax.tree_util.keystr(path)
+            src = leaves.get(key)
+            if src is None:
+                raise MigrationError(f"migration bundle missing KV leaf {key}")
+            src = np.asarray(src)
+            want_shape = (length,) + tuple(leaf.shape[2:])
+            want_dtype = np.dtype(leaf.dtype)
+            if src.shape != want_shape or src.dtype != want_dtype:
+                raise MigrationError(
+                    f"KV leaf {key} mismatch: bundle {src.dtype}{src.shape} "
+                    f"vs cache row {want_dtype}{want_shape} — replicas must "
+                    f"share kv_quant/geometry to migrate")
+            new_leaves.append(leaf.at[slot, :length].set(jax.numpy.asarray(src)))
+        return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+    def export_inflight(self, release: bool = True) -> List[dict]:
+        """Serialize every in-flight request — host bookkeeping plus its
+        committed per-slot KV — into migration payloads a peer's
+        :meth:`admit_migrated` restores bit-exactly.
+
+        Refusal conditions (``MigrationError``, loudly, BEFORE any slot is
+        released): sampled decoding (the scheduler-global rng stream is
+        not per-request state), or a request outside ``MIGRATABLE_STATES``.
+        Greedy decoding is what makes the contract checkable: the migrated
+        continuation must be bit-identical to the uninterrupted run.
+
+        ``release=True`` (the SIGTERM path) frees each exported request's
+        pool blocks and parks its slot, so the drain loop sees an empty
+        scheduler and exits without generating further tokens here."""
+        if self.config.do_sample:
+            raise MigrationError(
+                "sampled decoding cannot migrate: the sampling rng stream is "
+                "scheduler-global, not per-request — drain instead")
+        for req in self.in_flight:
+            if req.state not in MIGRATABLE_STATES:
+                raise MigrationError(f"request {req.request_id} in state "
+                                     f"{req.state!r} is not migratable")
+        payloads: List[dict] = []
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            length = int(self._lengths[slot])
+            kv = {"target": self._kv_slot_leaves(self._cache, slot, length)}
+            if self._drafter is not None:
+                kv["drafter"] = self._kv_slot_leaves(self._drafter_cache,
+                                                     slot, length)
+            payloads.append({
+                "request_id": req.request_id,
+                "state": req.state,
+                "prompt": np.asarray(req.prompt, np.int32),
+                "max_new_tokens": req.max_new_tokens,
+                "eos_token_id": req.eos_token_id,
+                "arrival_time": req.arrival_time,
+                "output": list(req.output),
+                "prefill_pos": req.prefill_pos,
+                "first_token_time": req.first_token_time,
+                "token_times": list(req.token_times),
+                "drafted_tokens": req.drafted_tokens,
+                "accepted_tokens": req.accepted_tokens,
+                "meta": dict(req.meta),
+                "length": length,
+                "next_token": int(self._next_token[slot]),
+                # compat envelope: the importer refuses on any mismatch
+                "kv_quant": self.kv_quant,
+                "weight_dtype": self.weight_dtype,
+                "capacity": self.capacity,
+                "spec_k": self.spec_k,
+                "kv": kv,
+            })
+            if release:
+                self.pool.free(req.request_id)
+                self._slot_req[slot] = None
+                self._lengths[slot] = self.capacity  # park
+        return payloads
+
+    def release_inflight(self) -> int:
+        """Free every in-flight request's pool blocks and park its slot —
+        the post-export half of a migrate-out, split from
+        :meth:`export_inflight(release=False)` so a failed bundle save
+        leaves the requests still serveable here (drain fallback)."""
+        n = 0
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            self.pool.free(req.request_id)
+            self._slot_req[slot] = None
+            self._lengths[slot] = self.capacity  # park
+            n += 1
+        return n
+
+    def admit_migrated(self, payload: dict) -> Optional[Request]:
+        """Admit one migrated request into a free slot, restoring its KV.
+
+        Returns the (re-identified) local :class:`Request`, or ``None``
+        when this replica has no free slot / pool blocks for its worst
+        case — a *capacity* refusal the router retries elsewhere, distinct
+        from the *compat* refusals (kv_quant / weight dtype / speculation
+        geometry mismatch) that raise :class:`MigrationError` because no
+        retry can fix them. The request gets a FRESH local id (both
+        processes count from 0 — the wire id would collide) with the
+        origin id kept in ``meta["migrated_from"]`` for at-most-once
+        completion accounting."""
+        for knob in ("kv_quant", "weight_dtype", "spec_k", "capacity"):
+            if payload.get(knob) != getattr(self, knob):
+                raise MigrationError(
+                    f"migration compat mismatch on {knob}: bundle "
+                    f"{payload.get(knob)!r} vs replica {getattr(self, knob)!r}")
+        if payload["state"] not in MIGRATABLE_STATES:
+            raise MigrationError(f"bundle request state {payload['state']!r} "
+                                 f"is not migratable")
+        free = self._free_slots()
+        if not free:
+            return None
+        req = Request(prompt=payload["prompt"],
+                      max_new_tokens=payload["max_new_tokens"],
+                      eos_token_id=payload["eos_token_id"],
+                      arrival_time=payload["arrival_time"])
+        if not self.pool.can_allocate(req.total_tokens):
+            return None
+        req.meta.update(payload.get("meta", {}))
+        req.meta["migrated_from"] = payload["request_id"]
+        req.state = payload["state"]
+        req.output = [int(t) for t in payload["output"]]
+        req.prefill_pos = int(payload["prefill_pos"])
+        req.first_token_time = payload["first_token_time"]
+        req.token_times = list(payload["token_times"])
+        req.drafted_tokens = int(payload["drafted_tokens"])
+        req.accepted_tokens = int(payload["accepted_tokens"])
+        length = int(payload["length"])
+        slot = free[0]
+        # KV restore first — a MigrationError here must leave the replica
+        # untouched (no reserved blocks, no occupied slot)
+        cache = self._restore_slot_kv(self._cache, slot,
+                                      payload["kv"]["target"], length)
+        d_cache = None
+        if self._drafter is not None:
+            d_cache = self._restore_slot_kv(self._drafter_cache, slot,
+                                            payload["kv"]["drafter"], length)
+        self._cache = cache
+        if d_cache is not None:
+            self._drafter_cache = d_cache
+        self.pool.reserve(req.request_id, req.total_tokens)
+        self.pool.advance(req.request_id, length)
+        self._slot_req[slot] = req
+        self._lengths[slot] = length
+        self._next_token[slot] = payload["next_token"]
+        if self.telemetry is not None:
+            self.telemetry.emit("serve_admit_migrated",
+                                request_id=req.request_id,
+                                migrated_from=payload["request_id"],
+                                state=req.state, length=length)
+        return req
+
     # -- retire --------------------------------------------------------
     def _maybe_finish(self, slot: int, now: float) -> None:
         req = self._slot_req[slot]
@@ -532,7 +790,7 @@ class ContinuousBatchingScheduler:
             n += 1
         return n
 
-    def serve(self, requests=(), guard=None) -> int:
+    def serve(self, requests=(), guard=None, migrate=None) -> int:
         """Serve ``requests`` to completion under a preemption guard.
 
         SIGTERM/SIGINT mid-serve triggers the drain contract (reusing
@@ -540,7 +798,16 @@ class ContinuousBatchingScheduler:
         terminally REFUSE everything still queued, FINISH every in-flight
         request, and return ``DEFAULT_PREEMPT_EXIT_CODE`` (143) so a
         supervisor reads preemption, not success. Returns 0 on a normal
-        complete drain."""
+        complete drain.
+
+        ``migrate`` (graft-fleet): optional ``migrate(scheduler, signal)
+        -> {"migrated": int, "bundle": str}`` hook tried on preemption
+        AFTER the queue is refused. On success (the hook exported every
+        in-flight request — :meth:`export_inflight` released the slots)
+        a ``serve_migrate_out`` event lands and the loop exits without
+        generating further tokens here; on :class:`MigrationError` the
+        PR-14 drain contract resumes untouched — in-flight requests
+        finish locally."""
         from deepspeed_tpu.runtime.resilience.signals import (
             DEFAULT_PREEMPT_EXIT_CODE, PreemptionGuard)
         own_guard = guard is None
@@ -561,6 +828,19 @@ class ContinuousBatchingScheduler:
                         self.telemetry.emit("serve_drain", signal=preempted,
                                             in_flight=len(self.in_flight),
                                             refused=len(refused))
+                    if migrate is not None and self.in_flight:
+                        try:
+                            out = migrate(self, preempted)
+                        except MigrationError as e:
+                            log_dist(f"graft-serve: migration refused "
+                                     f"({e}) — draining instead")
+                        else:
+                            if self.telemetry is not None:
+                                self.telemetry.emit(
+                                    "serve_migrate_out", signal=preempted,
+                                    migrated=int(out.get("migrated", 0)),
+                                    bundle=str(out.get("bundle", "")))
+                            continue  # slots released — loop re-checks
                 self.step(admit=preempted is None)
         finally:
             if own_guard:

@@ -280,14 +280,20 @@ class RuntimeTelemetry:
         self.sink.flush()
 
     # -- raw events ----------------------------------------------------
-    def emit(self, kind: str, **fields) -> None:
+    def emit(self, kind: str, /, flush: bool = True, **fields) -> None:
         """Write one structured event (checkpoint publish, xla trace
-        window, resilience fallback, ...). No-op when disabled."""
+        window, resilience fallback, ...). No-op when disabled.
+
+        ``kind`` is positional-only so an event may carry a field named
+        ``kind`` (``serve_tick`` reports its tick kind that way).
+        ``flush=False`` buffers the line until the next window flush —
+        for per-tick cadenced events (graft-fleet ``serve_tick``) where
+        an fsync per record would tax the serving hot path."""
         if not self.enabled:
             return
         rec = {"event": kind}
         rec.update(fields)
-        self.sink.write(rec, flush=True)
+        self.sink.write(rec, flush=flush)
 
     # -- summaries -----------------------------------------------------
     def drift_summary(self) -> Dict[str, Any]:

@@ -295,6 +295,23 @@ def test_sigterm_mid_serve_drains_and_exits_143(tmp_path):
     assert row["ok"], row
 
 
+def test_replica_sigterm_migrates_inflight_kv(tmp_path):
+    """graft-fleet SIGTERM contract: every in-flight request's KV moves
+    to the peer through a digest-verified bundle, nothing is dropped,
+    and the migrated continuations are bit-identical (greedy parity) to
+    an uninterrupted run."""
+    row = fault_bench.scenario_replica_sigterm_migrate(str(tmp_path))
+    assert row["ok"], row
+
+
+def test_replica_sigkill_readmits_at_most_once(tmp_path):
+    """graft-fleet SIGKILL contract: the router's liveness sweep
+    re-admits orphaned requests on the surviving replica, delivery stays
+    at-most-once, zero dropped, TTFT spike bounded."""
+    row = fault_bench.scenario_replica_sigkill_readmit(str(tmp_path))
+    assert row["ok"], row
+
+
 # ---------------------------------------------------------------------------
 # heartbeat cadence (satellite: wired + off the hot path)
 # ---------------------------------------------------------------------------

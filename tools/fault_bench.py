@@ -18,6 +18,12 @@ fails.
 |                        | agent relaunches on 8 (graft-elastic)       | checkpoint; curve in envelope; W->W'->W      |
 |                        |                                             | leaf digests bit-identical                   |
 | scale-down (4 -> 2)    | same, relaunched on 2 virtual devices       | same contract in the gather direction        |
+| SIGTERM fleet replica  | sigterm one of two router-driven replicas   | in-flight KV migrates to the peer through a  |
+|                        | mid-flight (graft-fleet)                    | digest-verified bundle; zero dropped; greedy |
+|                        |                                             | parity with an uninterrupted run             |
+| SIGKILL fleet replica  | hard-kill a replica, no drain, no bundle    | router re-admits orphaned requests on the    |
+|                        |                                             | peer at-most-once; zero dropped; bounded     |
+|                        |                                             | TTFT spike                                   |
 
 Run: python tools/fault_bench.py            (scenario subset: FAULT_SCENARIOS=...)
 Tests import the scenario functions directly (tests/unit/resilience/).
@@ -568,9 +574,140 @@ def scenario_serve_drain(workdir):
                 f"rc={p.returncode} {drain}", ok)
 
 
+# -- fleet migration scenarios (graft-fleet, in-process) ---------------------
+#
+# Deliberately LocalReplica-based: the SIGTERM/SIGKILL paths these assert
+# are method calls replaying exactly what fleet/worker.py does on the real
+# signals, so the migration/readmission *contracts* are provable with one
+# shared engine and zero subprocess compile windows. The real-pipes twin
+# lives in tests/unit/inference/test_fleet.py under @pytest.mark.slow.
+
+_FLEET_FIXTURE = None
+
+
+def _fleet_fixture(n_prompts=6, max_new=12):
+    """One tiny inference engine shared by every scheduler (compiled
+    programs paid once per process), plus the uninterrupted single-replica
+    reference outputs that migration parity is asserted against."""
+    global _FLEET_FIXTURE
+    if _FLEET_FIXTURE is not None:
+        return _FLEET_FIXTURE
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.serving import (ContinuousBatchingScheduler,
+                                                 Request, ServingConfig)
+    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+
+    cfg = get_gpt2_config("test", n_positions=128, dtype=None)
+    engine = deepspeed_tpu.init_inference(GPT2LMHeadModel(cfg),
+                                          replace_with_kernel_inject=True,
+                                          max_out_tokens=128)
+
+    def mk_sched():
+        return ContinuousBatchingScheduler(
+            engine, ServingConfig(slots=4, prefill_chunk=16, kv_quant=True))
+
+    rng = np.random.default_rng(17)
+    prompts = [rng.integers(0, cfg.vocab_size, (24,)).astype(np.int32)
+               for _ in range(n_prompts)]
+    ref_sched = mk_sched()
+    refs = [Request(prompt=p, max_new_tokens=max_new) for p in prompts]
+    for r in refs:
+        ref_sched.submit(r)
+    ref_sched.run_until_drained()
+    ref_ttft_p99 = ref_sched.signals()["ttft_p99"]
+    _FLEET_FIXTURE = (mk_sched, prompts, [list(r.output) for r in refs],
+                      max_new, ref_ttft_p99)
+    return _FLEET_FIXTURE
+
+
+def _fleet_pair(mk_sched):
+    from deepspeed_tpu.inference.fleet import FleetRouter, LocalReplica
+    router = FleetRouter()
+    replicas = {n: LocalReplica(n, mk_sched()) for n in ("r0", "r1")}
+    for n, r in replicas.items():
+        router.add_replica(n, r)
+    return router, replicas
+
+
+def scenario_replica_sigterm_migrate(workdir):
+    """SIGTERM one of two fleet replicas mid-flight: every in-flight
+    request's KV must migrate through a digest-verified bundle to the
+    peer (capacity overflow re-dispatched, never dropped) and every
+    output must be bit-identical to an uninterrupted run."""
+    from deepspeed_tpu.runtime.resilience.manifest import (
+        CheckpointCorruptError, verify_checkpoint_dir)
+    mk_sched, prompts, ref_out, max_new, _ = _fleet_fixture()
+    router, replicas = _fleet_pair(mk_sched)
+    rids = [router.submit(p, max_new) for p in prompts]
+    for _ in range(6):          # genuinely in flight on both replicas
+        router.step()
+    victim = replicas["r0"]
+    inflight_before = len(victim.scheduler.in_flight)
+    bundle = os.path.join(workdir, "fleet_sigterm.bundle")
+    victim.sigterm(bundle)
+    router.run_until_complete(max_rounds=5000)
+    st = router.stats()
+    try:                         # the published bundle is manifest-verified
+        verify_checkpoint_dir(bundle)
+        digest = "verified"
+    except (CheckpointCorruptError, FileNotFoundError) as e:
+        digest = f"corrupt: {str(e)[:80]}"
+    parity = all(router.completed[rid]["output"] == ref_out[i]
+                 for i, rid in enumerate(rids) if rid in router.completed)
+    ok = (st["completed"] == len(prompts) and st["pending"] == 0
+          and st["failed"] == 0 and st["duplicate_completions"] == 0
+          and inflight_before >= 1 and digest == "verified" and parity)
+    return _row("replica_sigterm_migrate",
+                "in-flight KV migrated (digest-verified), zero dropped, "
+                "greedy parity with uninterrupted run",
+                f"{st} in_flight_at_sigterm={inflight_before} "
+                f"bundle={digest} parity={parity}", ok,
+                migrated=inflight_before)
+
+
+def scenario_replica_sigkill_readmit(workdir):
+    """SIGKILL a fleet replica mid-flight: no drain, no bundle — the
+    router's liveness sweep must re-admit every orphaned request on the
+    peer with at-most-once delivery (duplicates counted, never
+    double-delivered), zero dropped, and a bounded TTFT spike."""
+    mk_sched, prompts, ref_out, max_new, ref_p99 = _fleet_fixture()
+    router, replicas = _fleet_pair(mk_sched)
+    rids = [router.submit(p, max_new) for p in prompts]
+    for _ in range(4):
+        router.step()
+    victim = next((r for r in replicas.values()
+                   if len(r.scheduler.in_flight)),
+                  replicas["r0"])
+    victim.sigkill()
+    router.run_until_complete(max_rounds=5000)
+    st = router.stats()
+    parity = all(router.completed[rid]["output"] == ref_out[i]
+                 for i, rid in enumerate(rids) if rid in router.completed)
+    ttfts = [router.completed[rid]["stats"].get("ttft")
+             for rid in router.completed]
+    ttft_max = max((t for t in ttfts if t is not None), default=None)
+    # re-admitted requests re-run from the prompt, so their TTFT absorbs
+    # the time lost to the kill — the spike must stay bounded (a scenario
+    # that takes seconds end-to-end, not an unbounded wait), not zero
+    ttft_bounded = ttft_max is not None and ttft_max < 30.0
+    ok = (st["completed"] == len(prompts) and st["pending"] == 0
+          and st["failed"] == 0 and st["readmitted"] >= 1
+          and parity and ttft_bounded)
+    return _row("replica_sigkill_readmit",
+                "orphaned requests re-admitted at-most-once, zero dropped, "
+                "bounded TTFT spike, greedy parity",
+                f"{st} parity={parity} ttft_max={ttft_max} "
+                f"ref_ttft_p99={ref_p99}", ok,
+                readmitted=st["readmitted"],
+                duplicates=st["duplicate_completions"])
+
+
 SCENARIOS = {
     "torn_save": scenario_torn_save,
     "serve_drain": scenario_serve_drain,
+    "replica_sigterm_migrate": scenario_replica_sigterm_migrate,
+    "replica_sigkill_readmit": scenario_replica_sigkill_readmit,
     "truncate": lambda wd: scenario_corrupt_checkpoint(wd, "truncate"),
     "bitflip": lambda wd: scenario_corrupt_checkpoint(wd, "bitflip"),
     "all_corrupt": scenario_all_corrupt,

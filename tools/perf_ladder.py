@@ -354,6 +354,29 @@ SERVE_RUNGS = {
                       "SERVE_QPS": "16", "SERVE_REQUESTS": "48",
                       "SERVE_PROMPT": "64", "SERVE_NEW": "32",
                       "SERVE_WQ": "int4"},
+    # graft-fleet scaling rungs (ISSUE 17): the SAME trace through a
+    # FleetRouter over N real worker subprocesses (fleet/worker.py; each
+    # builds + warms its own engine off the clock). The x1/x2/x4 trio
+    # regenerates the PERF.md §PR17 goodput-scaling row at pinned TTFT
+    # p99; the smoke rung proves the subprocess plumbing in seconds on
+    # any backend before a window pays for the real trio.
+    "serve_fleet_smoke": {"SERVE_MODE": "fleet", "SERVE_MODEL": "test",
+                          "SERVE_REPLICAS": "2", "SERVE_QPS": "16",
+                          "SERVE_REQUESTS": "12", "SERVE_PROMPT": "16",
+                          "SERVE_NEW": "8", "SERVE_SLOTS": "4",
+                          "SERVE_CHUNK": "8"},
+    "serve_fleet_x1": {"SERVE_MODE": "fleet", "SERVE_REPLICAS": "1",
+                       "SERVE_QPS": "16", "SERVE_REQUESTS": "64",
+                       "SERVE_PROMPT": "64", "SERVE_NEW": "32",
+                       "SERVE_SLOTS": "8"},
+    "serve_fleet_x2": {"SERVE_MODE": "fleet", "SERVE_REPLICAS": "2",
+                       "SERVE_QPS": "16", "SERVE_REQUESTS": "64",
+                       "SERVE_PROMPT": "64", "SERVE_NEW": "32",
+                       "SERVE_SLOTS": "8"},
+    "serve_fleet_x4": {"SERVE_MODE": "fleet", "SERVE_REPLICAS": "4",
+                       "SERVE_QPS": "16", "SERVE_REQUESTS": "64",
+                       "SERVE_PROMPT": "64", "SERVE_NEW": "32",
+                       "SERVE_SLOTS": "8"},
 }
 
 

@@ -42,6 +42,19 @@ Env: SERVE_MODEL=test|125m|350m...   model family config
   KV, under the SAME KV byte budget (SERVE_POOL_BYTES), reporting
   blocks-per-GB, goodput ratio at the offered load, and the token-level
   greedy match rate of the quantized arm against fp (PERF.md §PR16).
+  SERVE_MODE may also name "fleet" (or pass --fleet): the graft-fleet
+  scaling row — the SAME trace replayed through a FleetRouter over
+  SERVE_REPLICAS subprocess workers (fleet/worker.py, compile off the
+  clock), reporting aggregate goodput + TTFT p99 so 1/2/4-replica runs
+  show the scaling claim (PERF.md §PR17). Fleet is subprocess-only and
+  must be the sole mode in the run.
+     SERVE_REPLICAS=2               fleet mode: worker process count
+     SERVE_TICK_MS=0                fleet mode: emulated per-tick device
+                                    time per replica (FLEET_TICK_SLEEP_MS)
+                                    — a 1-core CPU rig cannot overlap N
+                                    replicas' compute, so the scaling row
+                                    runs in the device-bound regime a
+                                    real per-replica accelerator gives
      SERVE_TELEMETRY=0              per-tick spans + serve events to a
                                     graft-trace JSONL run dir (drift
                                     summary rides the continuous row)
@@ -77,6 +90,8 @@ WQ = os.environ.get("SERVE_WQ", "fp")
 KV_QUANT = os.environ.get("SERVE_KV_QUANT", "1") == "1"
 TELEMETRY = os.environ.get("SERVE_TELEMETRY", "0") == "1"
 SEED = int(os.environ.get("SERVE_SEED", "0"))
+REPLICAS = int(os.environ.get("SERVE_REPLICAS", "2"))
+TICK_MS = float(os.environ.get("SERVE_TICK_MS", "0"))
 
 
 def build_engine(n_positions):
@@ -369,6 +384,82 @@ def run_static(engine, cfg, trace):
             "batch": SLOTS}
 
 
+def run_fleet(cfg, trace, n_positions):
+    """The graft-fleet scaling row: replay the shared Poisson trace
+    through a FleetRouter over ``REPLICAS`` real worker subprocesses.
+    Engine build + warmup happen in each worker BEFORE the clock starts
+    (``wait_ready``), so the timed window measures serving, not XLA.
+    TTFT is the per-request value each worker's scheduler measured
+    (dispatch is immediate, so worker admission ≈ router arrival)."""
+    import shutil
+    import tempfile
+
+    from deepspeed_tpu.inference.fleet import FleetRouter, SubprocessReplica
+    from deepspeed_tpu.runtime.telemetry import Histogram
+
+    workdir = tempfile.mkdtemp(prefix="ds_tpu_fleet_")
+    env = {"FLEET_MODEL": MODEL, "FLEET_POSITIONS": str(n_positions),
+           "FLEET_SLOTS": str(SLOTS),
+           "FLEET_CHUNK": str(CHUNK if CHUNK > 0 else n_positions),
+           "FLEET_KV_QUANT": "1" if KV_QUANT else "0"}
+    if TICK_MS:
+        env["FLEET_TICK_SLEEP_MS"] = str(TICK_MS)
+    if TELEMETRY:
+        env["FLEET_TELEMETRY_DIR"] = os.environ.get(
+            "SERVE_TELEMETRY_DIR", "/tmp/ds_tpu_serve_telemetry")
+    router = FleetRouter(heartbeat_timeout=120.0)
+    replicas = [SubprocessReplica(f"w{i}", os.path.join(workdir, f"w{i}"),
+                                  env=env)
+                for i in range(REPLICAS)]
+    try:
+        for r in replicas:
+            r.wait_ready(timeout=600.0)
+            router.add_replica(r.name, r)
+        print(f"# fleet: {REPLICAS} replica(s) ready, replaying trace",
+              flush=True)
+        t0 = time.monotonic()
+        i = 0
+        while i < len(trace) or router.pending:
+            now = time.monotonic() - t0
+            while i < len(trace) and trace[i][0] <= now:
+                _, prompt, new = trace[i]
+                router.submit(prompt, new)
+                i += 1
+            router.poll()
+            if not router.pending and i < len(trace):
+                time.sleep(min(max(trace[i][0] - now, 0.0), 0.05))
+            else:
+                time.sleep(0.005)
+        wall = time.monotonic() - t0
+        ttft_h = Histogram()
+        tokens_out = 0
+        for rec in router.completed.values():
+            st = rec.get("stats") or {}
+            if st.get("ttft") is not None:
+                ttft_h.record(st["ttft"])
+            tokens_out += st.get("new_tokens") or len(rec.get("output") or [])
+        rstats = router.stats()
+        return {
+            "mode": f"fleet:{REPLICAS}", "replicas": REPLICAS,
+            "wall_s": round(wall, 3),
+            "finished": rstats["completed"], "failed": rstats["failed"],
+            "duplicate_completions": rstats["duplicate_completions"],
+            "readmitted": rstats["readmitted"],
+            "completed_by": rstats["completed_by"],
+            "ticks_by": {r.name: r.ticks_seen for r in replicas},
+            "goodput_tok_s": round(tokens_out / wall, 1),
+            "ttft": _lat_row(ttft_h),
+            "slots_per_replica": SLOTS, "kv_quant": KV_QUANT,
+            "chunked_prefill": CHUNK > 0,
+            "prefill_chunk": CHUNK or n_positions,
+            "emulated_tick_ms": TICK_MS or None,
+        }
+    finally:
+        for r in replicas:
+            r.close()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def main():
     import jax
 
@@ -377,9 +468,17 @@ def main():
     # knob incompatibilities are knowable from env alone — fail them
     # BEFORE paying minutes of engine build + compile + continuous replay
     modes = ["continuous", "static"] if MODES == "both" else MODES.split(",")
-    unknown = [m for m in modes if m not in ("continuous", "static", "quant_ab")]
+    if "--fleet" in sys.argv:
+        modes = ["fleet"]
+    unknown = [m for m in modes
+               if m not in ("continuous", "static", "quant_ab", "fleet")]
     if unknown:
         raise SystemExit(f"unknown SERVE_MODE entry {unknown[0]!r}")
+    if "fleet" in modes and modes != ["fleet"]:
+        raise SystemExit("fleet mode runs alone (workers own the engines; "
+                         "there is no parent engine to share with other modes)")
+    if REPLICAS < 1:
+        raise SystemExit(f"SERVE_REPLICAS must be >= 1, got {REPLICAS}")
     if WQ not in ("fp", "int8", "int4"):
         raise SystemExit(f"SERVE_WQ must be fp|int8|int4, got {WQ!r}")
     if LONG_EVERY and "static" in modes:
@@ -393,7 +492,14 @@ def main():
 
     enable_compile_cache()
     n_positions = max((PROMPT * 4 if LONG_EVERY else PROMPT) + NEW + 1, 128)
-    engine, cfg = build_engine(n_positions)
+    if modes == ["fleet"]:
+        # workers build their own engines; the parent only needs the
+        # vocab size to synthesize the trace
+        from deepspeed_tpu.models import get_gpt2_config
+        engine, cfg = None, get_gpt2_config(MODEL, n_positions=n_positions,
+                                            dtype=None)
+    else:
+        engine, cfg = build_engine(n_positions)
     rng = np.random.default_rng(SEED)
     trace = poisson_trace(rng, cfg.vocab_size)
 
@@ -430,6 +536,8 @@ def main():
         elif mode == "quant_ab":
             quant_ab(engine, cfg, trace, header, drafter=drafter)
             continue
+        elif mode == "fleet":
+            row = run_fleet(cfg, trace, n_positions)
         else:
             row = run_static(engine, cfg, trace)
         rows[mode] = dict(header, **row)
