@@ -28,6 +28,7 @@ from deepspeed_tpu.analysis.memory import MemoryEstimate, estimate_memory
 from deepspeed_tpu.analysis.cost import (CostInfo, build_cost, cost_baseline_from,
                                          cost_engine_program, load_cost_baseline,
                                          r013_cost_ratchet, run_cost_rules,
+                                         static_price_from_jaxpr,
                                          static_price_from_programs)  # registers R009-R013
 from deepspeed_tpu.analysis.search import (SPACES, Candidate, SearchSpace,
                                            enumerate_candidates, flops_proxy,
@@ -36,6 +37,14 @@ from deepspeed_tpu.analysis.search import (SPACES, Candidate, SearchSpace,
                                            r014_search_frontier, run_space,
                                            search_artifact_from,
                                            verify_spaces)  # registers R014
+from deepspeed_tpu.analysis.calibrate import (CalibrationError, calibrated_seconds,
+                                              calibration_entry, calibration_from,
+                                              collect_samples,
+                                              default_calibration_path, fit_entry,
+                                              fit_groups, load_calibration,
+                                              naive_seconds, r016_calibration_drift,
+                                              residual_summary,
+                                              verify_calibration)  # registers R016
 from deepspeed_tpu.analysis.report import (baseline_from, build_report, load_baseline,
                                            matrix_signature, new_errors, rules_markdown,
                                            summarize, write_report)
@@ -48,10 +57,14 @@ __all__ = [
     "MemoryEstimate", "estimate_memory",
     "CostInfo", "build_cost", "run_cost_rules", "r013_cost_ratchet",
     "load_cost_baseline", "cost_baseline_from", "cost_engine_program",
-    "static_price_from_programs",
+    "static_price_from_jaxpr", "static_price_from_programs",
     "SPACES", "Candidate", "SearchSpace", "enumerate_candidates", "flops_proxy",
     "gate_space_names", "load_search_artifact", "pareto", "price_candidate",
     "r014_search_frontier", "run_space", "search_artifact_from", "verify_spaces",
+    "CalibrationError", "calibrated_seconds", "calibration_entry",
+    "calibration_from", "collect_samples", "default_calibration_path",
+    "fit_entry", "fit_groups", "load_calibration", "naive_seconds",
+    "r016_calibration_drift", "residual_summary", "verify_calibration",
     "baseline_from", "build_report", "load_baseline", "matrix_signature",
     "new_errors", "rules_markdown", "summarize", "write_report",
 ]

@@ -546,26 +546,38 @@ def cost_engine_program(engine, example_batch, compile: bool = False,  # noqa: A
     }
 
 
-def static_price_from_programs(programs: Dict) -> Dict[str, Any]:
-    """The step program's static price from a prior
-    ``engine.traced_programs(batch, lower=False)`` result — jaxpr-only,
-    no StableHLO lowering (the same fast path graft-search prices
-    candidates on). This is what the telemetry run header stamps so
-    every run's JSONL carries the prediction its drift events are
-    measured against: ``flops_proxy`` (trip-count-weighted dot FLOPs),
-    liveness ``peak_bytes``/``peak_transient_bytes``, analytic jaxpr-layer
-    ``bytes_moved``, and the eqn count (the R015 identity metric)."""
+def static_price_from_jaxpr(closed_jaxpr, metadata: Optional[Dict] = None,
+                            name: str = "program",
+                            kind: str = "train_step") -> Dict[str, Any]:
+    """Jaxpr-only static price of ONE closed jaxpr — no StableHLO
+    lowering (the same fast path graft-search prices candidates on):
+    ``flops_proxy`` (trip-count-weighted dot FLOPs), liveness
+    ``peak_bytes``/``peak_transient_bytes``, analytic jaxpr-layer
+    ``bytes_moved``, and the eqn count (the R015 identity metric). The
+    shared pricer behind the training run header AND the serving
+    scheduler's program price — both stamp this dict so graft-calibrate
+    fits every scope in the same units."""
     from deepspeed_tpu.analysis.search import flops_proxy
 
-    step = programs["train_step"]
-    info = ProgramInfo(name="engine_train_step", jaxpr=step["jaxpr"],
-                       kind="train_step", metadata=step["metadata"])
+    metadata = metadata or {}
+    info = ProgramInfo(name=name, jaxpr=closed_jaxpr, kind=kind,
+                       metadata=metadata)
     mem = estimate_memory(info)
     analyzer = ProgramAnalyzer(info)
-    ops = hlo_cost.jaxpr_collectives(analyzer, step["metadata"].get("mesh_axes"))
+    ops = hlo_cost.jaxpr_collectives(analyzer, metadata.get("mesh_axes"))
     inv = hlo_cost.inventory(ops)
-    return {"flops_proxy": int(flops_proxy(step["jaxpr"])),
+    return {"flops_proxy": int(flops_proxy(closed_jaxpr)),
             "peak_bytes": int(mem.peak_bytes),
             "peak_transient_bytes": int(mem.peak_transient_bytes),
             "bytes_moved": int(sum(e["bytes_moved"] for e in inv.values())),
             "eqns": int(mem.eqns)}
+
+
+def static_price_from_programs(programs: Dict) -> Dict[str, Any]:
+    """The step program's static price from a prior
+    ``engine.traced_programs(batch, lower=False)`` result. This is what
+    the telemetry run header stamps so every run's JSONL carries the
+    prediction its drift events are measured against."""
+    step = programs["train_step"]
+    return static_price_from_jaxpr(step["jaxpr"], metadata=step["metadata"],
+                                   name="engine_train_step", kind="train_step")

@@ -420,10 +420,20 @@ def pareto(candidates: Dict[str, dict], objectives) -> Tuple[List[str], Dict[str
     return frontier, dominated_by
 
 
-def run_space(space_or_name, log=None) -> dict:
+def run_space(space_or_name, log=None, calibration=None) -> dict:
     """Enumerate + price + frontier one space. The returned dict is the
     committed artifact's per-space entry — pure data, no timestamps, so
-    two runs of unchanged code compare equal (the determinism contract)."""
+    two runs of unchanged code compare equal (the determinism contract).
+
+    ``calibration`` (a loaded ``cost_calibration.json`` artifact) adds the
+    measured-mode leg: every candidate additionally priced in predicted
+    wall **seconds** under the fitted coefficients, ``predicted_seconds``
+    joins the run's objective tuple (run-time only — the declared
+    ``space.signature()`` never hashes it, so calibrated and uncalibrated
+    runs of the same declaration share a space_sig), the frontier is
+    recomputed over the extended objectives, and ``seconds_rank`` records
+    the frontier in calibrated-seconds order with full provenance — the
+    total order the proxy objectives could not give."""
     space = SPACES[space_or_name] if isinstance(space_or_name, str) else space_or_name
     candidates = {}
     for i, cand in enumerate(enumerate_candidates(space)):
@@ -433,14 +443,54 @@ def run_space(space_or_name, log=None) -> dict:
     frontier, dominated_by = pareto(candidates, space.objectives)
     for cid, doms in dominated_by.items():
         candidates[cid]["dominated_by"] = doms
-    return {"space_sig": space.signature(),
-            "model": {"name": space.model_name, "micro_bs": space.micro_bs,
-                      "seq": space.seq, "dtype": space.dtype},
-            "axes": {k: list(v) for k, v in space.axes.items()},
-            "objectives": list(space.objectives),
-            "gate": space.gate,
-            "candidates": candidates,
-            "frontier": frontier}
+    result = {"space_sig": space.signature(),
+              "model": {"name": space.model_name, "micro_bs": space.micro_bs,
+                        "seq": space.seq, "dtype": space.dtype},
+              "axes": {k: list(v) for k, v in space.axes.items()},
+              "objectives": list(space.objectives),
+              "gate": space.gate,
+              "candidates": candidates,
+              "frontier": frontier}
+    if calibration is not None:
+        _apply_calibration(result, calibration, log=log)
+    return result
+
+
+def _apply_calibration(result: dict, calibration: dict, log=None) -> dict:
+    """Price every candidate of a freshly-run space in calibrated seconds
+    and re-rank. All-or-nothing per space: if any candidate is unpriceable
+    (a ``None`` coefficient meets a nonzero feature) the objective is not
+    half-added — a frontier mixing priced and unpriced members would be
+    incomparable. No matching calibration entry is a loud no-op."""
+    from deepspeed_tpu.analysis.calibrate import calibrated_seconds, calibration_entry
+
+    entry, key = calibration_entry(calibration, scope="train_step")
+    if entry is None:
+        if log:
+            log(f"  no calibration entry for {key} — seconds objective skipped")
+        return result
+    candidates = result["candidates"]
+    seconds = {cid: calibrated_seconds(c["metrics"], entry["coeffs"])
+               for cid, c in candidates.items()}
+    if any(s is None for s in seconds.values()):
+        if log:
+            log(f"  calibration {key} cannot price every candidate — "
+                f"seconds objective skipped")
+        return result
+    for cid, c in candidates.items():
+        c["metrics"]["predicted_seconds"] = seconds[cid]
+        c.pop("dominated_by", None)
+    objectives = list(result["objectives"]) + ["predicted_seconds"]
+    frontier, dominated_by = pareto(candidates, objectives)
+    for cid, doms in dominated_by.items():
+        candidates[cid]["dominated_by"] = doms
+    result["objectives"] = objectives
+    result["frontier"] = frontier
+    # stable sort: ties in calibrated seconds keep the proxy
+    # (enumeration) order, so the re-rank is a refinement, not a shuffle
+    result["seconds_rank"] = sorted(frontier, key=lambda cid: seconds[cid])
+    result["calibration"] = {"key": key, "coeffs": dict(entry["coeffs"])}
+    return result
 
 
 # ---------------------------------------------------------------------------
@@ -576,11 +626,19 @@ def gate_space_names() -> List[str]:
 
 
 def verify_spaces(artifact_path: str, names: Optional[List[str]] = None,
-                  log=None) -> List[Finding]:
+                  log=None, calibration=None) -> List[Finding]:
     """Re-price ``names`` (default: every gate space) and judge them with
     R014 against the committed artifact — the shared entry point for the
-    lint CLI and tools/graft_search.py's verify mode."""
+    lint CLI and tools/graft_search.py's verify mode. ``calibration``
+    defaults to the committed ``cost_calibration.json`` so a re-priced
+    space carries the same ``predicted_seconds`` objective the banked one
+    does; an absent artifact degrades to proxy-only pricing (R014's drift
+    check skips objectives only one side carries)."""
     artifact = load_search_artifact(artifact_path)
+    if calibration is None:
+        from deepspeed_tpu.analysis.calibrate import load_calibration
+        calibration = load_calibration()
     names = list(names if names is not None else gate_space_names())
-    current = {name: run_space(name, log=log) for name in names}
+    current = {name: run_space(name, log=log, calibration=calibration)
+               for name in names}
     return r014_search_frontier(artifact, current)

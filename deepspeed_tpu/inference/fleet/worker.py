@@ -60,13 +60,20 @@ def build_scheduler():
         telemetry = RuntimeTelemetry(TelemetryConfig(
             enabled=True, output_path=tdir,
             job_name=os.environ.get("FLEET_NAME", f"replica_{os.getpid()}")))
-        telemetry.write_run_header({"bench": "fleet_worker",
-                                    "model": model, "pid": os.getpid()})
     scfg = ServingConfig(
         slots=int(os.environ.get("FLEET_SLOTS", "4")),
         prefill_chunk=int(os.environ.get("FLEET_CHUNK", "16")),
         kv_quant=os.environ.get("FLEET_KV_QUANT", "1") == "1")
     sched = ContinuousBatchingScheduler(engine, scfg, telemetry=telemetry)
+    if telemetry is not None:
+        # the run header carries the serving program's static price +
+        # backend/scope so this replica's JSONL is a graft-calibrate fit
+        # source (scope serve_decode) exactly like a training run's
+        import jax
+        telemetry.write_run_header(
+            {"bench": "fleet_worker", "model": model, "pid": os.getpid(),
+             "backend": jax.default_backend(), "scope": "serve_decode"},
+            static_price=sched.serving_static_price())
     sched.warmup()
     return sched, telemetry
 

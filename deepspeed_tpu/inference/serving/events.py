@@ -26,7 +26,7 @@ SERVE_EVENT_SCHEMAS: Dict[str, frozenset] = {
     "serve_tick": frozenset({
         "tick", "kind", "queue_depth", "in_flight", "slots", "free_slots",
         "ttft_p50", "ttft_p99", "pool_free_blocks",
-        "pool_fragmentation_tokens",
+        "pool_fragmentation_tokens", "achieved_tok_s",
     }),
     # terminal accounting of a preemption drain (PR 14 contract)
     "serve_drain": frozenset({"signal", "in_flight", "refused"}),

@@ -222,6 +222,9 @@ class ContinuousBatchingScheduler:
         self.ttft_hist = Histogram()
         self.tok_hist = Histogram()
         self.ticks = {"prefill": 0, "decode": 0, "spec": 0, "idle": 0}
+        # achieved-throughput clock zero: the first non-idle tick, so an
+        # idle replica's achieved_tok_s reads None instead of decaying
+        self._serve_t0: Optional[float] = None
         self.drafted_total = 0
         self.accepted_total = 0
         self.finished: List[Request] = []
@@ -369,6 +372,8 @@ class ContinuousBatchingScheduler:
             self._decode_ticks_since_prefill += 1
         else:
             kind = "idle"
+        if kind != "idle" and self._serve_t0 is None:
+            self._serve_t0 = self.clock()
         self.ticks[kind] += 1
         if self.telemetry is not None:
             self.telemetry.end_step(step_no)
@@ -402,7 +407,48 @@ class ContinuousBatchingScheduler:
             "ttft_p99": ttft.percentile(99) if ttft.count else None,
             "pool_free_blocks": self.pool.free_blocks,
             "pool_fragmentation_tokens": self.pool.fragmentation_tokens(),
+            "achieved_tok_s": self._achieved_tok_s(),
         }
+
+    def _achieved_tok_s(self) -> Optional[float]:
+        """Run-to-date generated tokens per wall second since the first
+        non-idle tick (finished + in-flight outputs) — the measured side
+        graft-calibrate fits against the ``serve_decode`` static price the
+        fleet worker stamps. ``None`` until the replica has both tokens
+        and wall time, so a cold replica never reports a fake zero rate."""
+        if self._serve_t0 is None:
+            return None
+        wall = self.clock() - self._serve_t0
+        tokens = (sum(len(r.output) for r in self.finished)
+                  + sum(len(r.output) for r in self.in_flight))
+        if wall <= 0 or not tokens:
+            return None
+        return tokens / wall
+
+    def serving_static_price(self) -> dict:
+        """Static price of the steady-state serving program (the verify
+        pass under speculation, plain decode otherwise) — jaxpr-only, the
+        exact dict ``static_price_from_jaxpr`` gives a train step, so the
+        fleet worker can stamp it into its telemetry run header and
+        serving programs enter the graft-calibrate fit in the same units
+        as training steps. Degrades to an ``{"error": ...}`` stamp (the
+        engine run-header contract) rather than refusing to serve."""
+        try:
+            from deepspeed_tpu.analysis.cost import static_price_from_jaxpr
+            name = "verify" if self.spec_k else "decode"
+            if self.spec_k:
+                args = (jax.numpy.zeros((self.slots, self.spec_k + 1),
+                                        jax.numpy.int32),)
+            else:
+                args = (jax.numpy.zeros((self.slots,), jax.numpy.int32),)
+                if self.config.do_sample:
+                    args += (jax.random.PRNGKey(0),)
+            closed = jax.make_jaxpr(self.fns[name])(
+                self._serve_params, self._cache, *args)
+            return static_price_from_jaxpr(closed, name=f"serve_{name}",
+                                           kind="serve_decode")
+        except Exception as e:  # pricing must never take the replica down
+            return {"error": f"{type(e).__name__}: {str(e)[:200]}"}
 
     def _touch_serving_heartbeat(self, tick: int) -> None:
         """Refresh the PR-13 supervisor heartbeat with a serving role

@@ -1,0 +1,203 @@
+"""Property tests for the graft-calibrate fitter
+(deepspeed_tpu/analysis/calibrate.py): synthetic telemetry generated from
+KNOWN coefficients is recovered within tolerance (noisy, outlier-laden,
+multi-scope, rank-deficient), degenerate inputs refuse loudly instead of
+extrapolating, two fits over the same data are byte-identical, and the
+sample collector reads raw telemetry JSONL and the ``trace_report
+--drift`` sidecar into the same sample set."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.analysis import calibrate as cal
+
+BASE_S = 0.01
+S_PER_FLOP = 2.0e-12
+S_PER_BYTE = 5.0e-11
+
+
+def synth(n=12, noise=0.02, base=BASE_S, a=S_PER_FLOP, b=0.0, seed=0):
+    """Samples from known coefficients with multiplicative gaussian noise.
+    flops spans an order of magnitude; bytes (when b != 0) varies on an
+    independent schedule so the two columns are not collinear."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        f = (i + 1) * 1e9
+        m = ((i * 7) % n + 1) * 1e8 if b else 0
+        y = (base + a * f + b * m) * (1.0 + rng.normal(0.0, noise))
+        out.append({"flops_proxy": int(f), "bytes_moved": int(m),
+                    "measured_s": float(y)})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# recovery
+# ---------------------------------------------------------------------------
+def test_known_coefficients_recovered():
+    entry = cal.fit_entry(synth())
+    c = entry["coeffs"]
+    assert c["base_s"] == pytest.approx(BASE_S, rel=0.05)
+    assert c["s_per_flop"] == pytest.approx(S_PER_FLOP, rel=0.05)
+    assert c["s_per_byte"] is None  # bytes never moved: unidentifiable
+    assert entry["fit"]["median_abs_rel_err"] < 0.05
+
+
+def test_two_coefficient_recovery():
+    entry = cal.fit_entry(synth(n=16, b=S_PER_BYTE, noise=0.01))
+    c = entry["coeffs"]
+    assert c["s_per_flop"] == pytest.approx(S_PER_FLOP, rel=0.1)
+    assert c["s_per_byte"] == pytest.approx(S_PER_BYTE, rel=0.1)
+
+
+def test_outlier_robustness():
+    """One 10x-corrupted sample (a paused-host window) must not drag the
+    slope — the Huber IRLS downweights it where plain lstsq would not."""
+    samples = synth(n=14, noise=0.01)
+    samples[3] = dict(samples[3], measured_s=samples[3]["measured_s"] * 10)
+    c = cal.fit_entry(samples)["coeffs"]
+    assert c["s_per_flop"] == pytest.approx(S_PER_FLOP, rel=0.1)
+    assert c["base_s"] == pytest.approx(BASE_S, rel=0.3)
+
+
+def test_rank_deficient_column_is_unidentified_not_zero():
+    """An all-zero feature column yields coefficient None — distinct from
+    a fitted 0.0 — and calibrated_seconds refuses (None) exactly when a
+    price exercises the unidentified feature."""
+    entry = cal.fit_entry(synth())
+    coeffs = entry["coeffs"]
+    assert coeffs["s_per_byte"] is None
+    assert cal.calibrated_seconds({"flops_proxy": 2e9, "bytes_moved": 0},
+                                  coeffs) is not None
+    assert cal.calibrated_seconds({"flops_proxy": 2e9, "bytes_moved": 1e8},
+                                  coeffs) is None
+
+
+def test_multi_scope_groups_fit_independently():
+    groups = {"cpu/train_step": synth(seed=1),
+              "cpu/serve_decode": synth(base=0.002, a=8e-12, seed=2)}
+    entries, refused = cal.fit_groups(groups)
+    assert not refused
+    assert entries["cpu/train_step"]["coeffs"]["s_per_flop"] == \
+        pytest.approx(S_PER_FLOP, rel=0.05)
+    assert entries["cpu/serve_decode"]["coeffs"]["s_per_flop"] == \
+        pytest.approx(8e-12, rel=0.05)
+
+
+# ---------------------------------------------------------------------------
+# refusals (loud, never extrapolating)
+# ---------------------------------------------------------------------------
+def test_fewer_than_min_samples_refuses():
+    with pytest.raises(cal.CalibrationError, match="minimum"):
+        cal.fit_entry(synth(n=cal.MIN_SAMPLES - 1))
+
+
+def test_single_point_degenerate_refuses():
+    """Many windows of the SAME config: constant flops column — a slope
+    through one x-value is pure extrapolation and must refuse."""
+    samples = [dict(s, flops_proxy=10**9) for s in synth(n=8)]
+    with pytest.raises(cal.CalibrationError, match="constant"):
+        cal.fit_entry(samples)
+
+
+def test_fit_groups_collects_refusals():
+    entries, refused = cal.fit_groups({"cpu/train_step": synth(),
+                                       "cpu/starved": synth(n=2)})
+    assert "cpu/train_step" in entries
+    assert "cpu/starved" in refused and "minimum" in refused["cpu/starved"]
+
+
+# ---------------------------------------------------------------------------
+# determinism + artifact plumbing
+# ---------------------------------------------------------------------------
+def test_fit_is_byte_deterministic():
+    a = cal.fit_entry(synth())
+    b = cal.fit_entry(synth())
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    # and refitting the entry's own embedded samples reproduces it — the
+    # property R016's hermetic self-consistency check is built on
+    c = cal.fit_entry(a["samples"])
+    assert json.dumps(a, sort_keys=True) == json.dumps(c, sort_keys=True)
+
+
+def test_artifact_unknown_keys_rejected(tmp_path):
+    art = cal.calibration_from({"cpu/train_step": cal.fit_entry(synth())})
+    art["surprise"] = 1
+    p = tmp_path / "cost_calibration.json"
+    p.write_text(json.dumps(art))
+    with pytest.raises(ValueError, match="unknown top-level"):
+        cal.load_calibration(str(p))
+    art.pop("surprise")
+    art["entries"]["cpu/train_step"]["extra"] = 1
+    p.write_text(json.dumps(art))
+    with pytest.raises(ValueError, match="unknown keys"):
+        cal.load_calibration(str(p))
+
+
+def test_artifact_merge_semantics(tmp_path):
+    prior = cal.calibration_from({"cpu/train_step": cal.fit_entry(synth())})
+    merged = cal.calibration_from(
+        {"cpu/serve_decode": cal.fit_entry(synth(seed=3))}, prior=prior)
+    assert set(merged["entries"]) == {"cpu/serve_decode", "cpu/train_step"}
+
+
+# ---------------------------------------------------------------------------
+# sample collection: telemetry JSONL + trace_report --drift sidecar
+# ---------------------------------------------------------------------------
+def _write_run_jsonl(path, price, meds, run=None):
+    recs = [{"event": "run_start", "schema": 1,
+             "run": dict({"backend": "cpu", "config_sig": "sig0"}, **(run or {})),
+             "static_price": price}]
+    for i, med in enumerate(meds):
+        recs.append({"event": "drift", "step": (i + 1) * 4, "window_steps": 4,
+                     "median_step_s": med, "predicted": price,
+                     "measured": {}, "ratios": {}})
+    path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+
+
+def test_collect_drops_first_window_and_groups_by_scope(tmp_path):
+    price = {"flops_proxy": 10**9, "bytes_moved": 0, "peak_bytes": 1,
+             "peak_transient_bytes": 1, "eqns": 5}
+    _write_run_jsonl(tmp_path / "a.jsonl", price, [0.5, 0.011, 0.012])
+    _write_run_jsonl(tmp_path / "b.jsonl", price, [0.4, 0.02],
+                     run={"scope": "serve_decode"})
+    groups = cal.collect_samples([str(tmp_path / "a.jsonl"),
+                                  str(tmp_path / "b.jsonl")])
+    # first (compile-polluted) window dropped from each multi-window run
+    assert [s["measured_s"] for s in groups["cpu/train_step"]] == [0.011, 0.012]
+    assert [s["measured_s"] for s in groups["cpu/serve_decode"]] == [0.02]
+
+
+def test_collect_skips_unpriced_runs(tmp_path):
+    _write_run_jsonl(tmp_path / "bad.jsonl", {"error": "boom"}, [0.5, 0.01])
+    assert cal.collect_samples([str(tmp_path / "bad.jsonl")]) == {}
+
+
+def test_drift_sidecar_equivalent_to_jsonl(tmp_path):
+    """tools/trace_report.py --drift writes {run, predicted, windows, ...};
+    collect_samples must read it into the SAME samples as the raw JSONL it
+    came from (the satellite contract: the drift table no longer dies in
+    stdout)."""
+    price = {"flops_proxy": 3 * 10**9, "bytes_moved": 0}
+    jsonl = tmp_path / "telemetry.jsonl"
+    _write_run_jsonl(jsonl, price, [0.6, 0.031, 0.033])
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "trace_report_cal",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "..", "..", "..", "tools", "trace_report.py"))
+    tr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tr)
+    rc = tr.main([str(tmp_path), "--drift"])
+    assert rc == 0
+    sidecar = tmp_path / "drift.json"
+    assert sidecar.exists()
+    from_jsonl = cal.collect_samples([str(jsonl)])
+    from_sidecar = cal.collect_samples([str(sidecar)])
+    strip = lambda groups: {k: [{f: s[f] for f in ("flops_proxy", "bytes_moved",
+                                                   "measured_s")}
+                                for s in v] for k, v in groups.items()}
+    assert strip(from_jsonl) == strip(from_sidecar)

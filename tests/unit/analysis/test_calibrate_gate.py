@@ -1,0 +1,107 @@
+"""The tier-1 graft-calibrate gate: the COMMITTED
+``analysis_results/cost_calibration.json`` is hermetically self-consistent
+(every entry refits byte-identically from its own embedded samples), the
+committed search artifact's calibrated re-rank matches a recompute under
+the committed coefficients, a perturbed-coefficient fixture fails
+``tools/graft_calibrate.py verify`` with rc 1 through the real CLI, and
+R016 is registered and visible in ``graft_lint --list``.  No telemetry
+runs are needed on the test rig — that is the point of embedding the
+training samples in the artifact."""
+
+import copy
+import importlib.util
+import json
+import os
+
+import pytest
+
+from deepspeed_tpu import analysis
+from deepspeed_tpu.analysis import calibrate as cal
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+CALIBRATION = os.path.join(REPO, "analysis_results", "cost_calibration.json")
+SEARCH = os.path.join(REPO, "analysis_results", "search_pareto.json")
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"{name}_gate", os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def graft_calibrate():
+    return _load_tool("graft_calibrate")
+
+
+def test_committed_calibration_verifies_clean():
+    """R016 over the committed artifacts, exactly as graft_lint --cost
+    runs it (no fresh telemetry: drift checks skip, hermetic + re-rank
+    checks run)."""
+    findings = analysis.verify_calibration(calibration_path=CALIBRATION,
+                                           search_pareto_path=SEARCH)
+    errors = [f for f in findings if f.severity == analysis.ERROR]
+    assert not errors, [f.message for f in errors]
+
+
+def test_committed_entries_refit_byte_identically():
+    """Each committed entry must be exactly fit_entry(its own samples) —
+    the invariant that makes hand-edited coefficients detectable with no
+    telemetry on disk."""
+    art = cal.load_calibration(CALIBRATION)
+    assert art["entries"], "committed calibration has no entries"
+    for key, entry in art["entries"].items():
+        refit = cal.fit_entry(entry["samples"])
+        assert json.dumps(refit, sort_keys=True) == \
+            json.dumps(entry, sort_keys=True), f"{key} does not refit"
+
+
+def test_committed_search_artifact_is_calibrated():
+    """The banked frontier carries predicted_seconds + provenance, and
+    seconds_rank is the frontier sorted by seconds recomputed from the
+    committed coefficients (not merely the stored numbers)."""
+    art = analysis.load_search_artifact(SEARCH)
+    calib = cal.load_calibration(CALIBRATION)
+    space = art["spaces"]["350m_judged"]
+    assert "predicted_seconds" in space["objectives"]
+    entry, key = cal.calibration_entry(calib, scope="train_step")
+    assert space["calibration"]["key"] == key
+    for tag in space["frontier"]:
+        metrics = space["candidates"][tag]["metrics"]
+        want = cal.calibrated_seconds(metrics, entry["coeffs"])
+        assert metrics["predicted_seconds"] == pytest.approx(want, rel=1e-9)
+    rank = space["seconds_rank"]
+    assert sorted(rank) == sorted(space["frontier"])
+    secs = [space["candidates"][t]["metrics"]["predicted_seconds"]
+            for t in rank]
+    assert secs == sorted(secs), "seconds_rank is not sorted by seconds"
+
+
+def test_perturbed_fixture_fails_rc_1(graft_calibrate, tmp_path):
+    """A 1.3x nudge to one committed coefficient must fail the verify
+    CLI with rc 1 — through the same entrypoint CI runs."""
+    art = copy.deepcopy(cal.load_calibration(CALIBRATION))
+    key = sorted(art["entries"])[0]
+    coeffs = art["entries"][key]["coeffs"]
+    knob = next((k for k in ("s_per_flop", "s_per_byte", "base_s")
+                 if coeffs.get(k)), "base_s")
+    coeffs[knob] = (coeffs[knob] or 0.01) * 1.3
+    fixture = tmp_path / "cost_calibration.json"
+    fixture.write_text(json.dumps(art, indent=2) + "\n")
+    assert graft_calibrate.run(["verify", "--artifact", str(fixture),
+                                "--search-pareto", SEARCH, "-q"]) == 1
+
+
+def test_clean_verify_cli_rc_0(graft_calibrate):
+    assert graft_calibrate.run(["verify", "-q"]) == 0
+
+
+def test_r016_registered_and_listed():
+    assert "R016" in analysis.RULES
+    rule = analysis.RULES["R016"]
+    assert rule.severity == analysis.ERROR
+    md = analysis.rules_markdown()
+    assert "R016" in md

@@ -1,0 +1,144 @@
+"""graft-calibrate CLI: fit the static cost model against measured
+telemetry and bank/verify the committed calibration artifact.
+
+``fit`` collects samples from accumulated graft-trace runs — telemetry
+run dirs, raw ``telemetry.jsonl`` files, or the machine-readable drift
+sidecars ``tools/trace_report.py --drift`` writes — groups them per
+``<backend>/<scope>`` (training steps and graft-fleet serving ticks fit
+side by side), runs the robust least-squares fitter
+(deepspeed_tpu/analysis/calibrate.py), and prints the coefficients +
+residual evidence. ``--update`` banks the result into
+``analysis_results/cost_calibration.json`` (merge semantics — refitting
+one scope never drops another's entry).
+
+``verify`` is the R016 contract: exit 1 when the committed artifact is
+self-inconsistent (perturbed/hand-edited coefficients — checked
+hermetically by refitting the embedded samples), when its jax signature
+no longer matches, when the committed search frontier's
+``predicted_seconds`` re-rank is stale against the calibration, or —
+given telemetry runs as arguments — when fresh residuals drift past
+tolerance under the committed coefficients.
+
+Usage:
+  python tools/graft_calibrate.py fit runs/a runs/b          # fit + print
+  python tools/graft_calibrate.py fit runs/* --update        # bank
+  python tools/graft_calibrate.py verify                     # hermetic R016
+  python tools/graft_calibrate.py verify runs/*              # + residual drift
+"""
+
+import argparse
+import json
+import os
+import sys
+
+# CPU trace-only by design, same bootstrap as graft_lint / graft_search
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8").strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_ARTIFACT = os.path.join(REPO, "analysis_results", "cost_calibration.json")
+DEFAULT_SEARCH = os.path.join(REPO, "analysis_results", "search_pareto.json")
+
+
+def _fmt_coeff(v):
+    return "unidentified" if v is None else f"{v:.6g}"
+
+
+def _print_entry(key, entry):
+    c, fit = entry["coeffs"], entry["fit"]
+    print(f"  {key}: seconds = {_fmt_coeff(c['base_s'])} "
+          f"+ {_fmt_coeff(c['s_per_flop'])}·flops_proxy "
+          f"+ {_fmt_coeff(c['s_per_byte'])}·bytes_moved")
+    print(f"    {fit['samples']} samples, "
+          f"median|rel err| {fit.get('median_abs_rel_err', float('nan')):.3f}, "
+          f"p90 {fit.get('p90_abs_rel_err', float('nan')):.3f}"
+          + (f", clamped: {fit['clamped']}" if fit.get("clamped") else ""))
+
+
+def run(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="graft_calibrate", description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("mode", choices=("fit", "verify"))
+    ap.add_argument("runs", nargs="*",
+                    help="telemetry run dirs, telemetry.jsonl files, or "
+                         "trace_report --drift sidecar JSONs")
+    ap.add_argument("--update", action="store_true",
+                    help="(fit) bank the fitted entries into the committed "
+                         "artifact (merge semantics) instead of just printing")
+    ap.add_argument("--artifact", default=DEFAULT_ARTIFACT)
+    ap.add_argument("--search-pareto", default=DEFAULT_SEARCH,
+                    help="(verify) committed frontier to judge the "
+                         "predicted_seconds re-rank against")
+    ap.add_argument("--min-samples", type=int, default=None,
+                    help="(fit) override the fitter's minimum-sample refusal")
+    ap.add_argument("--tolerance", type=float, default=None,
+                    help="(verify) residual-drift tolerance override")
+    ap.add_argument("-q", "--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    import jax
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from deepspeed_tpu import analysis
+
+    log = None if args.quiet else (lambda s: print(f"  {s}", flush=True))
+
+    if args.mode == "fit":
+        if not args.runs:
+            print("graft-calibrate: fit needs at least one telemetry run",
+                  file=sys.stderr)
+            return 2
+        groups = analysis.collect_samples(args.runs)
+        if not groups:
+            print("graft-calibrate: no usable samples (runs need a stamped "
+                  "static price + drift windows)", file=sys.stderr)
+            return 2
+        kwargs = {} if args.min_samples is None else \
+            {"min_samples": args.min_samples}
+        entries, refused = analysis.fit_groups(groups, log=log, **kwargs)
+        for key in sorted(entries):
+            _print_entry(key, entries[key])
+        for key, why in sorted(refused.items()):
+            print(f"  {key}: REFUSED — {why}", file=sys.stderr)
+        if not entries:
+            print("graft-calibrate: every group refused to fit", file=sys.stderr)
+            return 1
+        if args.update:
+            prior = analysis.load_calibration(args.artifact)
+            artifact = analysis.calibration_from(entries, prior=prior)
+            os.makedirs(os.path.dirname(args.artifact), exist_ok=True)
+            with open(args.artifact, "w") as fh:
+                json.dump(artifact, fh, indent=2, sort_keys=False)
+                fh.write("\n")
+            print(f"calibration updated: {os.path.relpath(args.artifact, REPO)} "
+                  f"({len(entries)} entr{'y' if len(entries) == 1 else 'ies'} "
+                  f"refreshed, {len(artifact['entries'])} total)")
+        return 0
+
+    # verify: the R016 contract
+    findings = analysis.verify_calibration(
+        calibration_path=args.artifact,
+        search_pareto_path=args.search_pareto,
+        runs=args.runs or None, tolerance=args.tolerance, log=log)
+    errors = [f for f in findings if f.severity == analysis.ERROR]
+    for f in findings:
+        loc = f" @ {f.location}" if f.location else ""
+        print(f"  {f.severity:5s} {f.rule} [{f.scenario}]{loc}: {f.message}",
+              file=sys.stderr if f.severity == analysis.ERROR else sys.stdout)
+    if errors:
+        print(f"graft-calibrate: {len(errors)} ERROR finding(s) vs "
+              f"{os.path.relpath(args.artifact, REPO)} — refit and re-bank "
+              f"with fit --update", file=sys.stderr)
+        return 1
+    print("graft-calibrate: committed calibration verified clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())

@@ -28,7 +28,12 @@ it; seeded regression: ``DS_LMHEAD_CHUNK=16 python tools/graft_lint.py
 --cost`` (the env layer drifts every candidate's traced program, so the
 committed winners' prices move and R014 exits 1 — the DS_MOE_ROUTE
 pattern). Bank frontier changes with ``tools/graft_search.py --update``,
-never here.
+never here. The same full-matrix runs judge the committed measured-mode
+calibration with rule R016 (deepspeed_tpu/analysis/calibrate.py):
+perturbed coefficients, a stale jax signature, or a stale
+``predicted_seconds`` frontier re-rank vs
+``analysis_results/cost_calibration.json`` fail the gate; bank with
+``tools/graft_calibrate.py fit --update``.
 Seeded cost regressions: ``DS_MOE_ROUTE=dense`` (R009 route-signature
 drift + the dense-einsum memory delta), ``DS_PIPE_ACT_BUDGET_MB=2``
 on ``pipe_chunked_step`` (R010: the chunked schedule cannot fit the
@@ -127,6 +132,9 @@ def run(argv=None) -> int:
                     help="with --cost: skip the R014 search-frontier gate")
     ap.add_argument("--search-pareto",
                     default=os.path.join(REPO, "analysis_results", "search_pareto.json"))
+    ap.add_argument("--cost-calibration",
+                    default=os.path.join(REPO, "analysis_results",
+                                         "cost_calibration.json"))
     ap.add_argument("--list", action="store_true", help="print rules + scenarios and exit")
     ap.add_argument("--rules-md", action="store_true",
                     help="print the README rule table generated from the rule "
@@ -167,6 +175,7 @@ def run(argv=None) -> int:
         print("  bytes_moved{jaxpr,stablehlo,compiled}  analytic wire bytes (analysis/hlo_cost.py)")
         print("  collective counts per layer+kind   ratcheted by R013 vs cost_baseline.json")
         print("  frontier winners + price drift     ratcheted by R014 vs search_pareto.json")
+        print("  calibrated seconds + residual fit  ratcheted by R016 vs cost_calibration.json")
         return 0
 
     # ---- program layer -------------------------------------------------
@@ -228,6 +237,15 @@ def run(argv=None) -> int:
         for f in analysis.verify_spaces(
                 args.search_pareto,
                 log=(None if args.quiet else lambda s: print(f"  [search]{s}"))):
+            fs, metrics = per_program.setdefault(f.scenario, ([], {}))
+            fs.append(f)
+        # R016: the calibration artifact's own ratchet — hermetic
+        # self-consistency + the frontier's predicted_seconds re-rank
+        # provenance against the committed cost_calibration.json. Banking
+        # happens in tools/graft_calibrate.py fit --update, never here.
+        for f in analysis.verify_calibration(
+                calibration_path=args.cost_calibration,
+                search_pareto_path=args.search_pareto):
             fs, metrics = per_program.setdefault(f.scenario, ([], {}))
             fs.append(f)
 

@@ -54,20 +54,31 @@ def _fmt_bytes(n):
 def _print_space(name, result, quiet=False):
     cands = result["candidates"]
     frontier = set(result["frontier"])
+    calibrated = "predicted_seconds" in result["objectives"]
     print(f"space {name}: {len(cands)} candidates, "
           f"{len(frontier)} on the frontier "
           f"(objectives: {', '.join(result['objectives'])})")
     if quiet:
         return
-    header = f"  {'':1s} {'candidate':58s} {'transient':>9s} {'comms':>9s} {'dot-TFLOP':>9s}"
+    sec_hdr = f" {'pred-sec':>9s}" if calibrated else ""
+    header = (f"  {'':1s} {'candidate':58s} {'transient':>9s} {'comms':>9s} "
+              f"{'dot-TFLOP':>9s}{sec_hdr}")
     print(header)
     for cid, entry in cands.items():
         m = entry["metrics"]
         mark = "*" if cid in frontier else " "
         dom = ("" if cid in frontier
                else f"  << {entry.get('dominated_by', ['?'])[0]}")
+        sec = (f" {m['predicted_seconds']:9.4f}" if calibrated else "")
         print(f"  {mark} {cid:58s} {_fmt_bytes(m['peak_transient_bytes'])} "
-              f"{_fmt_bytes(m['bytes_moved'])} {m['flops_proxy'] / 1e12:9.3f}{dom}")
+              f"{_fmt_bytes(m['bytes_moved'])} {m['flops_proxy'] / 1e12:9.3f}"
+              f"{sec}{dom}")
+    if calibrated and result.get("seconds_rank"):
+        key = (result.get("calibration") or {}).get("key")
+        print(f"  frontier in calibrated seconds ({key}):")
+        for i, cid in enumerate(result["seconds_rank"]):
+            sec = cands[cid]["metrics"]["predicted_seconds"]
+            print(f"    #{i + 1} {cid} ({sec:.4f}s)")
 
 
 def run(argv=None) -> int:
@@ -95,6 +106,10 @@ def run(argv=None) -> int:
               f"valid: {sorted(analysis.SPACES)}", file=sys.stderr)
         return 2
 
+    # the committed calibration (if banked) adds the predicted_seconds
+    # objective + seconds_rank to every priced space
+    calibration = analysis.load_calibration()
+
     results = {}
     for name in names:
         t0 = time.time()
@@ -102,7 +117,7 @@ def run(argv=None) -> int:
         if not args.quiet:
             n = len(analysis.enumerate_candidates(analysis.SPACES[name]))
             print(f"# pricing {name} ({n} candidates)...", flush=True)
-        results[name] = analysis.run_space(name, log=log)
+        results[name] = analysis.run_space(name, log=log, calibration=calibration)
         if not args.quiet:
             print(f"# {name} priced in {time.time() - t0:.1f}s", flush=True)
         _print_space(name, results[name], quiet=args.quiet)

@@ -101,6 +101,7 @@ def run_rung(tag, model_name, mb, offload=False, steps=None, seq=None,
            **lint_evidence(engine, batch, programs),
            **cost_evidence(engine, batch, programs),
            **telemetry_evidence(engine),
+           **calibration_evidence(programs),
            **(retry_evidence_extra or {}),
            **(retry_evidence or {}))
 
@@ -214,6 +215,34 @@ def cost_evidence(engine, batch, programs=None):
         return cost_engine_program(engine, batch, programs=programs)
     except Exception as e:  # evidence must never kill a rung
         return {"cost_error": f"{type(e).__name__}: {str(e)[:120]}"}
+
+
+def calibration_evidence(programs):
+    """graft-calibrate evidence: the rung's step program priced in
+    predicted wall SECONDS under the committed measured-mode calibration
+    (analysis_results/cost_calibration.json), stamped next to the
+    measured ms — every banked row thereby carries the calibrated
+    model's claim so the drift between them is auditable per window
+    (rule R016 gates the artifact itself). Silently absent when no
+    calibration is banked or the entry can't price this program;
+    evidence must never kill a rung."""
+    if programs is None or os.environ.get("LADDER_COST", "1") != "1":
+        return {}
+    try:
+        from deepspeed_tpu.analysis import (calibrated_seconds,
+                                            calibration_entry,
+                                            load_calibration,
+                                            static_price_from_programs)
+        entry, key = calibration_entry(load_calibration())
+        if entry is None:
+            return {}
+        sec = calibrated_seconds(static_price_from_programs(programs),
+                                 entry["coeffs"])
+        if sec is None:
+            return {}
+        return {"predicted_step_s_calibrated": sec, "calibration_key": key}
+    except Exception as e:  # evidence must never kill a rung
+        return {"calibration_error": f"{type(e).__name__}: {str(e)[:120]}"}
 
 
 RUNGS = {
@@ -436,8 +465,13 @@ def _frontier_rungs():
     space = load_search_artifact(path).get("spaces", {}).get("350m_judged")
     if not space:
         return {}
+    # calibrated artifacts carry seconds_rank — the frontier re-ranked in
+    # predicted wall seconds under the committed cost calibration — so
+    # the window measures winners in the order the measured-mode model
+    # expects them to finish; uncalibrated artifacts keep proxy order
+    order = space.get("seconds_rank") or space["frontier"]
     rungs, seen_metrics = {}, {}
-    for cid in space["frontier"]:
+    for cid in order:
         entry = space["candidates"][cid]
         knobs, metrics = entry["knobs"], entry["metrics"]
         key = tuple(metrics.get(o) for o in space["objectives"])
@@ -457,12 +491,17 @@ def _frontier_rungs():
                 + ("" if knobs.get("optimizer", "fused") == "fused" else "_optchained"))
         tag = f"350m_search_{slug}"
         seen_metrics[key] = tag
+        evidence = {"search_candidate": cid,
+                    "search_space": "350m_judged",
+                    "search_priced_backend": "xla"}
+        if space.get("seconds_rank"):
+            evidence["search_predicted_seconds"] = metrics.get("predicted_seconds")
+            evidence["search_seconds_rank"] = order.index(cid) + 1
+            evidence["search_proxy_rank"] = space["frontier"].index(cid) + 1
         rungs[tag] = dict(
             model_name="350m", mb=space["model"]["micro_bs"],
             seq=space["model"]["seq"], ds=ds,
-            retry_evidence_extra={"search_candidate": cid,
-                                  "search_space": "350m_judged",
-                                  "search_priced_backend": "xla"})
+            retry_evidence_extra=evidence)
     return rungs
 
 

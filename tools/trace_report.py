@@ -11,8 +11,12 @@ Two modes over the run log ``runtime/telemetry`` writes:
 * ``--drift`` — summarize the predicted-vs-measured loop: the run
   header's static price (flops_proxy, liveness peak/transient bytes)
   against each window's measured median step time and memory peaks,
-  printed as a table plus one JSON summary line. This is the chip-window
-  view that banks *model error*, not just milliseconds.
+  printed as a table plus one JSON summary line, AND written as a
+  machine-readable sidecar (default ``<run_dir>/drift.json``, ``--out``
+  overrides, ``-`` suppresses) — the per-window predicted/measured/ratio
+  rows ``tools/graft_calibrate.py`` fits calibration coefficients from.
+  This is the chip-window view that banks *model error*, not just
+  milliseconds.
 
 This tool only READS json — no jax import, safe anywhere (including
 while a run is still writing; torn tail lines are skipped).
@@ -143,10 +147,13 @@ def main(argv=None) -> int:
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("path", help="telemetry run dir or telemetry.jsonl")
     ap.add_argument("--out", default=None,
-                    help="write the Chrome trace JSON here (default: "
-                         "<run_dir>/chrome_trace.json, or stdout for '-')")
+                    help="output path: the Chrome trace JSON (default "
+                         "<run_dir>/chrome_trace.json, '-' for stdout) or, "
+                         "with --drift, the JSON sidecar (default "
+                         "<run_dir>/drift.json, '-' to suppress)")
     ap.add_argument("--drift", action="store_true",
-                    help="print the predicted-vs-measured drift table instead")
+                    help="print the predicted-vs-measured drift table and "
+                         "write the machine-readable drift.json sidecar instead")
     args = ap.parse_args(argv)
 
     jsonl = resolve_jsonl(args.path)
@@ -156,7 +163,16 @@ def main(argv=None) -> int:
         return 1
 
     if args.drift:
-        print_drift(drift_report(events))
+        report = drift_report(events)
+        print_drift(report)
+        # the sidecar keeps the drift rows machine-readable instead of
+        # dying in stdout — graft_calibrate consumes it as a fit source
+        out = args.out or os.path.join(os.path.dirname(jsonl), "drift.json")
+        if out != "-":
+            with open(out, "w") as fh:
+                json.dump(report, fh, indent=2)
+                fh.write("\n")
+            print(f"drift sidecar: {out} ({len(report['windows'])} windows)")
         return 0
 
     trace = chrome_trace(events)
