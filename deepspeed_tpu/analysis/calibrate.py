@@ -342,8 +342,18 @@ def collect_samples(paths: Iterable[str],
     sidecar JSONs into per-``<backend>/<scope>`` sample groups. Runs
     without a usable static price (disabled, or stamped ``{"error":...}``)
     contribute nothing; deterministic order (input order, event order)
-    so two collections over the same files are identical."""
+    so two collections over the same files are identical.
+
+    graft-prefix-cache separation: a serving run whose header declares
+    ``prefix_cache: "on"`` skips part of prefill (restored KV rows), so
+    its tick timings fit a DIFFERENT cost line than full-prefill serving
+    — those runs group under ``<scope>_cached``. Serve runs MISSING the
+    ``prefix_cache``/``cached_prefix_tokens`` header fields (pre-PR-19
+    telemetry) are ambiguous — they cannot be pooled with marked runs of
+    the same group without silently mixing the two populations, so a mix
+    raises :class:`CalibrationError` instead of fitting garbage."""
     groups: Dict[str, List[dict]] = {}
+    serve_marking: Dict[str, set] = {}
     for path in paths:
         for run, price, windows in _iter_runs(path):
             if not isinstance(price, dict) or price.get("error") \
@@ -351,6 +361,13 @@ def collect_samples(paths: Iterable[str],
                 continue
             backend = (run or {}).get("backend") or "unknown"
             scope = (run or {}).get("scope") or default_scope
+            if scope.startswith("serve"):
+                marked = ("prefix_cache" in (run or {})
+                          or "cached_prefix_tokens" in (run or {}))
+                serve_marking.setdefault(f"{backend}/{scope}",
+                                         set()).add(marked)
+                if marked and (run or {}).get("prefix_cache") == "on":
+                    scope = f"{scope}_cached"
             key = f"{backend}/{scope}"
             usable = windows[1:] if len(windows) > 1 else windows
             source = (run or {}).get("config_sig") or (run or {}).get("bench") \
@@ -365,6 +382,16 @@ def collect_samples(paths: Iterable[str],
                     "measured_s": float(med),
                     "window_steps": int(w.get("window_steps") or 0),
                     "source": str(source)})
+    mixed = sorted(k for k, flags in serve_marking.items() if len(flags) > 1)
+    if mixed:
+        raise CalibrationError(
+            f"serve sample group(s) {mixed} mix runs WITH the "
+            f"prefix_cache/cached_prefix_tokens header fields and runs "
+            f"WITHOUT them — unmarked runs may contain cached-prefill "
+            f"ticks, so pooling them with full-prefill samples would fit "
+            f"a meaningless cost line; re-collect the unmarked runs with "
+            f"current telemetry (fleet/worker.py stamps the fields) or "
+            f"drop them from the collection")
     return {k: groups[k] for k in sorted(groups)}
 
 

@@ -709,6 +709,71 @@ def serve_quant_decode_step() -> ProgramInfo:
         set_topology(None)
 
 
+@scenario("serve_prefix_decode_step")
+def serve_prefix_decode_step() -> ProgramInfo:
+    """graft-prefix-cache's decode tick: the SAME program as
+    :func:`serve_decode_step` built with the prefix cache installed as
+    the committed serving default. The cache is a HOST-SIDE allocator
+    change — ref-counted content-addressed blocks, restore/publish
+    through host row copies — so the compiled decode program must be
+    BYTE-IDENTICAL to the uncached one: same budget, same tp=2
+    collective signature (R009), same banked cost (R013). Any delta here
+    means prefix caching leaked into the traced program, which would put
+    the cache on the latency path it exists to shorten."""
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.serving import (make_slot_cache,
+                                                 resolve_intended_kv_write,
+                                                 resolve_intended_prefix_cache,
+                                                 set_default_prefix_cache)
+    from deepspeed_tpu.inference.serving.programs import (build_decode_step,
+                                                          make_apply_fn)
+    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+    from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
+
+    if len(jax.devices()) < 2:
+        raise ScenarioSkipped("serve_prefix_decode_step needs >=2 devices "
+                              "for the tensor=2 serving mesh")
+    set_topology(None)
+    set_default_prefix_cache("on")  # the committed serving config
+    try:
+        slots = 16
+        cfg = get_gpt2_config("test", n_layer=2, n_positions=512)
+        topo = MeshTopology(tensor=2, data=1, fsdp=1, devices=jax.devices()[:2])
+        engine = InferenceEngine(GPT2LMHeadModel(cfg),
+                                 DeepSpeedInferenceConfig(), topology=topo)
+        cache = make_slot_cache(engine.module, slots)
+        decode = build_decode_step(make_apply_fn(engine.module, engine._mparams),
+                                   do_sample=False, temperature=1.0, top_k=0,
+                                   top_p=1.0)
+        tokens = jnp.zeros((slots,), jnp.int32)
+        jaxpr = jax.make_jaxpr(decode)(engine.params, cache, tokens)
+        return ProgramInfo(
+            name="serve_prefix_decode_step", jaxpr=jaxpr, kind="serve_decode",
+            lower=lambda: jax.jit(decode).lower(engine.params, cache, tokens),
+            metadata={
+                "serve_slots": slots,
+                # committed intent, env layer skipped — the drift anchors
+                "serve_kv_write": resolve_intended_kv_write(),
+                "serve_prefix_cache": resolve_intended_prefix_cache(None),
+                # same budget as serve_decode_step ON PURPOSE: prefix
+                # caching must not move the decode tick's transient a byte
+                "activation_budget_bytes": int(SERVE_DECODE_BUDGET_MB * 2**20),
+                "collective_signature": [
+                    {"layer": "compiled", "kind": "all_reduce", "count": 5,
+                     "note": "2 all-reduces per block + 1 for the tied "
+                             "LM head on the tp=2 serving mesh — identical "
+                             "to serve_decode_step (host-side cache only)"},
+                    {"layer": "compiled", "kind": "all_gather", "max_count": 2,
+                     "note": "at most the two embedding-table gathers — "
+                             "more would mean prefix caching leaked into "
+                             "the compiled program"}]})
+    finally:
+        set_default_prefix_cache(None)
+        set_topology(None)
+
+
 @scenario("reshard_resume")
 def reshard_resume() -> ProgramInfo:
     """graft-elastic's restore-path data movement, as a static program the

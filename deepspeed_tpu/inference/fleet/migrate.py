@@ -45,12 +45,16 @@ BUNDLE_META = "bundle.json"
 BUNDLE_VERSION = 1
 
 #: payload fields that are plain JSON scalars/lists (everything else —
-#: prompt, kv — travels in the npz)
+#: prompt, kv — travels in the npz). graft-prefix-cache rides here too:
+#: the KV rows in the npz are already MATERIALIZED (per-slot dense —
+#: shared prefix blocks export their bytes, never their refs), so a
+#: bundle needs only the accounting scalar (cached_prefix_tokens) plus
+#: the prefix_cache compat knob the importer's envelope check refuses on
 _SCALAR_FIELDS = ("request_id", "state", "max_new_tokens", "eos_token_id",
                   "arrival_time", "output", "prefill_pos", "first_token_time",
                   "token_times", "drafted_tokens", "accepted_tokens", "meta",
                   "length", "next_token", "kv_quant", "weight_dtype",
-                  "capacity", "spec_k")
+                  "capacity", "spec_k", "cached_prefix_tokens", "prefix_cache")
 
 
 def _npz_name(origin_id: int) -> str:

@@ -17,13 +17,21 @@ The router owns the fleet-wide request ids (``rid``) and three tables:
 * ``replicas`` — name → handle (``LocalReplica`` / ``SubprocessReplica``;
   the router never distinguishes them).
 
-Dispatch is least-loaded: min over alive replicas of ``load()`` (queued
-+ in-flight, straight from the replica's last ``tick`` signals — the
-same numbers its ``serve_tick`` telemetry lands on disk). Liveness is
-``alive`` (exit code) plus, for subprocess replicas, PR-13 heartbeat
-staleness; a dead replica's pending rids are re-dispatched and its
-unacked migration bundle (SIGTERM that died before a peer accepted) is
-re-admitted from disk.
+Dispatch is least-loaded with prefix affinity (graft-prefix-cache): a
+replica's tick signals advertise the ``prefix_key``s of its indexed
+position-0 KV blocks (``prefix_hot``) plus its block size; a request
+whose prompt's first block matches an advertised key routes to the
+least-loaded *matching* replica — its prefix cache already holds the KV
+that request would otherwise re-prefill — unless that replica is more
+than ``affinity_load_gap`` outstanding requests busier than the global
+least-loaded choice (affinity must never defeat balancing under
+pressure). Between ticks the router's own ``_affinity_recent`` map
+remembers where each prefix key last landed, so a same-prefix burst
+co-locates even before the target's next tick advertises the block.
+Liveness is ``alive`` (exit code) plus, for subprocess replicas, PR-13
+heartbeat staleness; a dead replica's pending rids are re-dispatched
+and its unacked migration bundle (SIGTERM that died before a peer
+accepted) is re-admitted from disk.
 """
 
 import itertools
@@ -32,6 +40,7 @@ import time
 from typing import Dict, List, Optional
 
 from deepspeed_tpu.inference.fleet import protocol
+from deepspeed_tpu.inference.serving.blocks import prefix_key
 from deepspeed_tpu.inference.serving.scheduler import MigrationError
 from deepspeed_tpu.utils.logging import log_dist
 
@@ -39,10 +48,15 @@ from deepspeed_tpu.utils.logging import log_dist
 class FleetRouter:
     """Load-balance requests across replicas; survive their deaths."""
 
-    def __init__(self, telemetry=None, heartbeat_timeout: float = 30.0):
+    def __init__(self, telemetry=None, heartbeat_timeout: float = 30.0,
+                 affinity: bool = True, affinity_load_gap: float = 8.0):
         self.replicas: Dict[str, object] = {}
         self.telemetry = telemetry
         self.heartbeat_timeout = float(heartbeat_timeout)
+        #: prefix-affinity dispatch (the A/B control arm sets False to
+        #: measure pure least-loaded on the same trace)
+        self.affinity = bool(affinity)
+        self.affinity_load_gap = float(affinity_load_gap)
         self._rid_counter = itertools.count()
         #: rid -> {"msg": wire request, "replica": name|None}
         self.pending: Dict[str, dict] = {}
@@ -54,6 +68,11 @@ class FleetRouter:
         self.readmitted = 0  # re-dispatches after death/refusal/migration
         #: replica name -> completions it delivered (balance evidence)
         self.completed_by: Dict[str, int] = {}
+        #: prefix key -> replica name of the last dispatch (covers the
+        #: advertisement lag of pipe-borne tick signals)
+        self._affinity_recent: Dict[str, str] = {}
+        self.affinity_hits = 0      # dispatches routed by prefix match
+        self.affinity_overruled = 0  # matches dropped by the load-gap guard
 
     # -- fleet membership ----------------------------------------------
     def add_replica(self, name: str, replica) -> None:
@@ -98,10 +117,48 @@ class FleetRouter:
         if not alive:
             rec["replica"] = None
             return None
-        name = min(sorted(alive), key=lambda n: alive[n].load())
+        name = self._pick_replica(alive, rec["msg"].get("prompt"))
         rec["replica"] = name
         alive[name].send(rec["msg"])
         return name
+
+    def _pick_replica(self, alive: Dict[str, object], prompt) -> str:
+        """Least-loaded, upgraded by prefix affinity: prefer the least-
+        loaded replica whose advertised ``prefix_hot`` set (or the
+        router's own recent-dispatch memory) covers the prompt's first
+        block, unless it is ``affinity_load_gap`` busier than the global
+        least-loaded pick."""
+        base = min(sorted(alive), key=lambda n: alive[n].load())
+        if not self.affinity or prompt is None:
+            return base
+        keys, cands = set(), []
+        for n in sorted(alive):
+            sig = getattr(alive[n], "signals", lambda: None)() or {}
+            bs = sig.get("prefix_block_size")
+            if not bs or len(prompt) < bs:
+                continue  # < one full block can never match a hot key
+            key = prefix_key(prompt[:bs])
+            keys.add(key)
+            if key in (sig.get("prefix_hot") or ()):
+                cands.append(n)
+        for key in keys:
+            n = self._affinity_recent.get(key)
+            if n in alive and n not in cands:
+                cands.append(n)
+        if not cands:
+            for key in keys:
+                self._affinity_recent[key] = base
+            return base
+        best = min(sorted(cands), key=lambda n: alive[n].load())
+        if alive[best].load() - alive[base].load() > self.affinity_load_gap:
+            self.affinity_overruled += 1
+            choice = base
+        else:
+            self.affinity_hits += 1
+            choice = best
+        for key in keys:
+            self._affinity_recent[key] = choice
+        return choice
 
     # -- event pump ----------------------------------------------------
     def poll(self) -> List[dict]:
@@ -258,4 +315,7 @@ class FleetRouter:
             "duplicate_completions": self.duplicate_completions,
             "readmitted": self.readmitted,
             "completed_by": dict(self.completed_by),
+            "affinity": self.affinity,
+            "affinity_hits": self.affinity_hits,
+            "affinity_overruled": self.affinity_overruled,
         }

@@ -20,6 +20,13 @@ Env (set by :class:`SubprocessReplica` / the fleet bench):
   FLEET_CHUNK=16          prefill chunk
   FLEET_POSITIONS=128     context length
   FLEET_KV_QUANT=1        int8 KV pools
+  FLEET_PREFIX_CACHE=     on|off: content-hashed KV prefix caching
+                          (unset = the DS_SERVE_PREFIX_CACHE/config
+                          resolution, default on)
+  FLEET_POOL_TOKENS=0     KV pool token budget (0 = slots x context);
+                          the serve_prefix_fleet_* rungs size this
+                          ABOVE slots x context so the pool has spare
+                          capacity for cached prefixes
   FLEET_TICK_SLEEP_MS=0   emulated per-tick device time: on a real fleet
                           each replica owns an accelerator and the host
                           CPU idles while the tick runs on-device; the
@@ -63,7 +70,9 @@ def build_scheduler():
     scfg = ServingConfig(
         slots=int(os.environ.get("FLEET_SLOTS", "4")),
         prefill_chunk=int(os.environ.get("FLEET_CHUNK", "16")),
-        kv_quant=os.environ.get("FLEET_KV_QUANT", "1") == "1")
+        kv_quant=os.environ.get("FLEET_KV_QUANT", "1") == "1",
+        kv_pool_tokens=int(os.environ.get("FLEET_POOL_TOKENS", "0")) or None,
+        prefix_cache=os.environ.get("FLEET_PREFIX_CACHE") or None)
     sched = ContinuousBatchingScheduler(engine, scfg, telemetry=telemetry)
     if telemetry is not None:
         # the run header carries the serving program's static price +
@@ -72,7 +81,14 @@ def build_scheduler():
         import jax
         telemetry.write_run_header(
             {"bench": "fleet_worker", "model": model, "pid": os.getpid(),
-             "backend": jax.default_backend(), "scope": "serve_decode"},
+             "backend": jax.default_backend(), "scope": "serve_decode",
+             # graft-calibrate separation markers: runs whose prefill is
+             # partly served from the prefix cache must not pool with
+             # full-prefill serve_decode samples (the field's PRESENCE is
+             # what collect_samples keys its mixed-run refusal on; the
+             # per-request counts land in serve_request events)
+             "prefix_cache": sched.prefix_cache,
+             "cached_prefix_tokens": 0},
             static_price=sched.serving_static_price())
     sched.warmup()
     return sched, telemetry

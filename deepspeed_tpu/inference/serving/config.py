@@ -32,9 +32,19 @@ ENV_WEIGHT_DTYPE = "DS_SERVE_WQ"
 WEIGHT_DTYPE_CHOICES = ("fp", "int8", "int4")
 DEFAULT_WEIGHT_DTYPE = "fp"
 
+#: env override for content-hashed KV prefix caching (graft-prefix-cache);
+#: the same drift seam — forcing it off under the env changes admission
+#: depth and prefill skip behaviour while the committed intent (and the
+#: serve_prefix_decode_step budget priced for it) stays put
+ENV_PREFIX_CACHE = "DS_SERVE_PREFIX_CACHE"
+
+PREFIX_CACHE_CHOICES = ("on", "off")
+DEFAULT_PREFIX_CACHE = "on"
+
 _lock = threading.Lock()
 _config_kv_write: Optional[str] = None
 _config_weight_dtype: Optional[str] = None
+_config_prefix_cache: Optional[str] = None
 
 
 def _check(value: Optional[str], choices, what: str) -> Optional[str]:
@@ -120,6 +130,47 @@ def resolve_intended_weight_dtype(mode: Optional[str] = None) -> str:
     return DEFAULT_WEIGHT_DTYPE
 
 
+def set_default_prefix_cache(mode: Optional[str]) -> None:
+    """Install the scheduler-level prefix-cache default (None clears)."""
+    global _config_prefix_cache
+    with _lock:
+        _config_prefix_cache = _check(mode, PREFIX_CACHE_CHOICES, "prefix_cache")
+
+
+def resolve_prefix_cache(mode: Optional[str] = None) -> Tuple[str, str]:
+    """Resolve ``(mode, source)`` for content-hashed KV prefix caching.
+
+    ``on`` (default) ref-counts and content-addresses the BlockPool:
+    committed full blocks index under a rolling hash, freed blocks with a
+    live hash park on a cached-free LRU, and new prompts prefill only
+    their uncached tail. ``off`` restores the private-blocks pool (parity
+    debugging / the A/B control arm). ``source`` names the deciding layer
+    (``explicit`` > ``env`` > ``config`` > ``default``), the same
+    evidence convention as :func:`resolve_kv_write`."""
+    src, m = "default", DEFAULT_PREFIX_CACHE
+    if _config_prefix_cache is not None:
+        m, src = _config_prefix_cache, "config"
+    env = os.environ.get(ENV_PREFIX_CACHE, "").strip() or None
+    if env is not None:
+        m, src = _check(env, PREFIX_CACHE_CHOICES,
+                        f"prefix_cache (from {ENV_PREFIX_CACHE})"), "env"
+    if mode is not None:
+        m, src = _check(mode, PREFIX_CACHE_CHOICES, "prefix_cache"), "explicit"
+    return m, src
+
+
+def resolve_intended_prefix_cache(mode: Optional[str] = None) -> str:
+    """The prefix-cache mode the *committed configuration* intends,
+    skipping the env layer — what ``serve_prefix_decode_step`` stamps in
+    its metadata so a forced/leaked ``DS_SERVE_PREFIX_CACHE`` drifts the
+    traced evidence away from the committed intent (R013 catches it)."""
+    if mode is not None:
+        return _check(mode, PREFIX_CACHE_CHOICES, "prefix_cache")
+    if _config_prefix_cache is not None:
+        return _config_prefix_cache
+    return DEFAULT_PREFIX_CACHE
+
+
 class SpeculationConfig(DeepSpeedConfigModel):
     """Speculative decoding knobs. The drafter is the compression/KD
     student (``compression/compress.py`` ``student_initialization`` seeds
@@ -167,6 +218,10 @@ class ServingConfig(DeepSpeedConfigModel):
     weight_dtype: Optional[str] = None
     #: target rows per quantization group along the contraction axis
     weight_group_size: int = Field(64, ge=1)
+    #: content-hashed KV prefix caching (graft-prefix-cache); resolution
+    #: via :func:`resolve_prefix_cache` (default ``on``). ``off`` is the
+    #: A/B control arm: private blocks, no hash index, full prefill
+    prefix_cache: Optional[str] = None
     #: int8 KV pools for the per-slot serving cache (the serving default:
     #: codes + per-(slot, position, head) scales, quantize-on-write /
     #: dequantize-on-read). False keeps fp KV for parity debugging
@@ -192,6 +247,7 @@ class ServingConfig(DeepSpeedConfigModel):
     def _validate(self):
         _check(self.kv_write, KV_WRITE_CHOICES, "kv_write")
         _check(self.weight_dtype, WEIGHT_DTYPE_CHOICES, "weight_dtype")
+        _check(self.prefix_cache, PREFIX_CACHE_CHOICES, "prefix_cache")
         if self.speculation.enabled and self.do_sample:
             raise ValueError("speculative decoding is only lossless under greedy "
                              "decoding; set do_sample=False or disable speculation")

@@ -22,17 +22,24 @@ from typing import Dict, Iterable, Optional
 #: by the sink itself)
 SERVE_EVENT_SCHEMAS: Dict[str, frozenset] = {
     # one per scheduler tick (cadence: ServingConfig.tick_telemetry_every)
-    # — the router/autoscaler input signals, straight from signals()
+    # — the router/autoscaler input signals, straight from signals().
+    # graft-prefix-cache adds the hit-rate evidence: prefix_cache_hit_rate
+    # (None until a prompt has been through admission) and cached_blocks
+    # (ref-0 blocks parked on the cached-free LRU, still reclaimable);
+    # the optional prefix_hot list (advertised hot position-0 prefix
+    # keys) rides along un-required — the router ignores its absence
     "serve_tick": frozenset({
         "tick", "kind", "queue_depth", "in_flight", "slots", "free_slots",
         "ttft_p50", "ttft_p99", "pool_free_blocks",
         "pool_fragmentation_tokens", "achieved_tok_s",
+        "prefix_cache_hit_rate", "cached_blocks",
     }),
     # terminal accounting of a preemption drain (PR 14 contract)
     "serve_drain": frozenset({"signal", "in_flight", "refused"}),
-    # per-request retirement row
+    # per-request retirement row (cached_prefix_tokens: prompt tokens
+    # restored from the prefix cache instead of prefilled — 0 on a miss)
     "serve_request": frozenset({"request_id", "state", "prompt_len",
-                                "new_tokens"}),
+                                "new_tokens", "cached_prefix_tokens"}),
     # live KV migration: SIGTERM'd replica hands in-flight work off
     "serve_migrate_out": frozenset({"signal", "migrated", "bundle"}),
     # peer accepted a migration bundle (digest-verified restore)

@@ -76,10 +76,15 @@ class RequestQueue:
         admitted: List[Request] = []
         while self._queue and len(admitted) < free_slots:
             head = self._queue[0]
-            if not self.pool.can_allocate(head.total_tokens):
+            # the prompt rides along so a prefix-caching pool can match
+            # indexed blocks: a shared prefix attaches by reference, so
+            # the head may fit where its worst-case block count wouldn't
+            if not self.pool.can_allocate(head.total_tokens,
+                                          prompt=head.prompt):
                 break  # strict FIFO: nothing overtakes the head
             self._queue.popleft()
-            self.pool.reserve(head.request_id, head.total_tokens)
+            self.pool.reserve(head.request_id, head.total_tokens,
+                              prompt=head.prompt)
             admitted.append(head)
         return admitted
 

@@ -33,6 +33,10 @@ class Request:
     refuse_reason: str = ""
     output: List[int] = dataclasses.field(default_factory=list)
     prefill_pos: int = 0                  # prompt tokens already prefilled
+    #: prompt tokens served from the prefix cache at admission (KV rows
+    #: restored instead of prefilled — graft-prefix-cache); prefill_pos
+    #: starts here, so TTFT only pays for the uncached tail
+    cached_prefix_tokens: int = 0
 
     # latency accounting (clock units of the scheduler's injected clock)
     first_token_time: Optional[float] = None
@@ -88,7 +92,8 @@ class Request:
 
     def stats(self) -> dict:
         out = {"request_id": self.request_id, "state": self.state,
-               "prompt_len": self.prompt_len, "new_tokens": len(self.output)}
+               "prompt_len": self.prompt_len, "new_tokens": len(self.output),
+               "cached_prefix_tokens": self.cached_prefix_tokens}
         if self.ttft is not None:
             out["ttft"] = self.ttft
         if self.finish_time is not None and self.arrival_time is not None:

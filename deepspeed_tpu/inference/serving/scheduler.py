@@ -39,8 +39,10 @@ import jax
 from deepspeed_tpu.inference.serving.blocks import BlockPool
 from deepspeed_tpu.inference.serving.config import (ServingConfig,
                                                     resolve_kv_write,
+                                                    resolve_prefix_cache,
                                                     resolve_weight_dtype,
                                                     set_default_kv_write,
+                                                    set_default_prefix_cache,
                                                     set_default_weight_dtype)
 from deepspeed_tpu.inference.serving.programs import (KV_LEAVES, _leaf_name,
                                                       make_slot_cache, serve_programs,
@@ -89,6 +91,24 @@ def _quant_view(module, params, weight_dtype: str, group_size: int):
     q_module = type(module)(dataclasses.replace(cfg, serve_weight_dtype=weight_dtype))
     qparams, qscales = quantize_params(params, weight_dtype, group_size)
     return q_module, {"params": qparams, "quant": qscales}
+
+
+def _restore_rows_jit_impl(flat_cache, rows, slot, kv_idx):
+    out = list(flat_cache)
+    for j, i in enumerate(kv_idx):
+        out[i] = out[i].at[slot, :rows[j].shape[0]].set(rows[j])
+    return out
+
+
+#: One program writes every KV leaf's restored rows into a slot, with the
+#: cache DONATED so XLA updates the pool buffers in place. ``slot`` rides
+#: as a traced scalar (no per-slot recompile); the row length keys the
+#: jit cache through the row shapes. Restores happen per prefix-cache
+#: admission, so the eager alternative — per-leaf ``.at[].set``, each
+#: copying the entire pool — is a serving-throughput bug, not a style
+#: choice.
+_restore_rows_jit = jax.jit(_restore_rows_jit_impl,
+                            static_argnums=(3,), donate_argnums=(0,))
 
 
 class ContinuousBatchingScheduler:
@@ -157,8 +177,21 @@ class ContinuousBatchingScheduler:
             pool_tokens = max(config.page_size,
                               int(config.kv_pool_bytes /
                                   max(1.0, self._kv_bytes_per_token())))
+        # graft-prefix-cache: content-address the pool (resolve-intent
+        # layering, DS_SERVE_PREFIX_CACHE drift seam). The hash envelope
+        # folds in every knob that makes cached KV bytes non-reusable —
+        # kv_quant changes the stored codes/scales, the served weight
+        # dtype changes the values prefill computes, speculation adds a
+        # drafter cache role the payload must also carry.
+        set_default_prefix_cache(config.prefix_cache)
+        self.prefix_cache, self.prefix_cache_source = resolve_prefix_cache(None)
+        self.spec_k = int(config.speculation.k) if config.speculation.enabled else 0
+        envelope = (f"kvq:{int(self.kv_quant)}/wq:{self.weight_dtype}"
+                    f"/spec:{self.spec_k}")
         self.pool = BlockPool(num_blocks=max(1, pool_tokens // config.page_size),
-                              block_size=config.page_size)
+                              block_size=config.page_size,
+                              prefix_cache=self.prefix_cache == "on",
+                              envelope=envelope)
         self.queue = RequestQueue(self.pool, max_queue=config.max_queue,
                                   max_total_tokens=self.capacity, clock=self.clock)
 
@@ -171,7 +204,6 @@ class ContinuousBatchingScheduler:
         # construction still binds THIS scheduler's mode.
         set_default_kv_write(config.kv_write)
         self.kv_write, self.kv_write_source = resolve_kv_write(None)
-        self.spec_k = int(config.speculation.k) if config.speculation.enabled else 0
         if self.spec_k and drafter is None:
             raise ValueError("speculation.enabled needs a drafter: pass "
                              "drafter=(module, params) — e.g. the KD student from "
@@ -233,7 +265,8 @@ class ContinuousBatchingScheduler:
                  f"chunk={config.prefill_chunk} kv_write={self.kv_write}"
                  f"({self.kv_write_source}) wq={self.weight_dtype}"
                  f"({self.weight_dtype_source}) kv_quant={self.kv_quant} "
-                 f"spec_k={self.spec_k}")
+                 f"spec_k={self.spec_k} prefix_cache={self.prefix_cache}"
+                 f"({self.prefix_cache_source})")
 
     # ------------------------------------------------------------------
     def _probe_slot_decode(self) -> None:
@@ -331,10 +364,111 @@ class ContinuousBatchingScheduler:
         admitted = self.queue.admit(len(free))
         for slot, req in zip(free, admitted):
             self._slot_req[slot] = req
-            self._lengths[slot] = 0
+            # graft-prefix-cache: the reservation may have matched an
+            # indexed prefix — restore its KV rows into the slot and
+            # start prefill AFTER them, so the tick only pays for the
+            # uncached tail (the match always leaves >= 1 prompt token
+            # so the tail's last position samples the first new token)
+            cached = 0
+            match = self.pool.take_match(req.request_id)
+            if match is not None and match.cached_tokens:
+                self._restore_prefix(slot, match)
+                cached = match.cached_tokens
+            self._lengths[slot] = cached
             req.state = PREFILL
-            req.prefill_pos = 0
+            req.prefill_pos = cached
+            req.cached_prefix_tokens = cached
         return len(admitted)
+
+    # -- prefix cache (graft-prefix-cache) -----------------------------
+    def _kv_rows(self, cache, slot: int, start: int, stop: int) -> Dict[str, np.ndarray]:
+        """Host copies of rows ``[start:stop)`` of one slot's KV leaves —
+        the publish payload. Reads through the whole-leaf ``device_get``
+        (zero-copy on the CPU backend — the migration exporter's lesson)
+        and copies ONLY the requested rows: an eager device-side slice
+        would compile a fresh XLA program per (start, stop) offset, one
+        per publishing request. ``np.array(copy=True)`` because a view
+        would alias the device buffer the next donated decode step
+        frees."""
+        out: Dict[str, np.ndarray] = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(cache)[0]:
+            name = _leaf_name(path)
+            if name in KV_LEAVES or name.endswith("_scale"):
+                host = np.asarray(jax.device_get(leaf))
+                out[jax.tree_util.keystr(path)] = np.array(
+                    host[slot, start:stop], copy=True)
+        return out
+
+    def _restore_prefix(self, slot: int, match) -> None:
+        """Write a :class:`PrefixMatch`'s payload rows into ``slot`` for
+        every cache role (target + drafter when speculating): full-block
+        payloads concatenate, the partial block contributes its first
+        ``partial_tokens`` rows (the COW copy — the shared source block's
+        payload is read, never written). Payload rows restore through the
+        migration writer (``.at[slot, :n].set``) so the buffers stay
+        XLA-owned on the existing placement."""
+        roles = [("target", "_cache")]
+        if self._drafter is not None:
+            roles.append(("drafter", "_drafter_cache"))
+        for role, attr in roles:
+            parts: Dict[str, list] = {}
+            for payload in match.payloads + (
+                    [match.partial_payload] if match.partial_tokens else []):
+                if not isinstance(payload, dict) or role not in payload:
+                    raise MigrationError(
+                        f"prefix-cache payload missing {role!r} KV rows — "
+                        f"the pool indexed a block this scheduler cannot "
+                        f"restore")
+                rows = payload[role]
+                is_partial = payload is match.partial_payload \
+                    and match.partial_tokens
+                for key, arr in rows.items():
+                    part = arr[:match.partial_tokens] if is_partial else arr
+                    parts.setdefault(key, []).append(part)
+            leaves = {k: (np.concatenate(v, axis=0) if len(v) > 1 else v[0])
+                      for k, v in parts.items()}
+            setattr(self, attr, self._restore_slot_kv(
+                getattr(self, attr), slot, leaves, match.cached_tokens))
+
+    def _publish_prefix(self, slot: int, req: Request) -> None:
+        """Index ``req``'s committed full blocks (prompt at the
+        PREFILL->ACTIVE transition, prompt + generated output at
+        retirement — multi-turn conversations re-match their own
+        history). The pool calls ``fetch`` only for blocks not already
+        hashed, so shared prefixes publish their KV rows exactly once."""
+        if self.prefix_cache != "on":
+            return
+        committed = int(self._lengths[slot])
+        if committed < self.pool.block_size:
+            return
+        tokens = np.concatenate([
+            np.asarray(req.prompt, np.int64),
+            np.asarray(req.output, np.int64)])[:committed]
+
+        # ONE device_get per leaf per publish, host-sliced per block:
+        # per-block device slices would compile a fresh XLA program per
+        # (start, stop) offset and dominate the tick under load. Lazy and
+        # tail-only — the pool walks blocks in order and calls fetch only
+        # for blocks not yet indexed, so the first call's ``start`` is the
+        # first new row: a finish-time publish whose prompt blocks are
+        # already shared transfers just the output tail (or, when every
+        # full block is already indexed, nothing at all)
+        full: dict = {}
+
+        def fetch(start: int, stop: int) -> dict:
+            if not full:
+                full["base"] = start
+                full["target"] = self._kv_rows(self._cache, slot,
+                                               start, committed)
+                if self._drafter is not None:
+                    full["drafter"] = self._kv_rows(self._drafter_cache,
+                                                    slot, start, committed)
+            base = full["base"]
+            return {role: {k: arr[start - base:stop - base]
+                           for k, arr in rows.items()}
+                    for role, rows in full.items() if role != "base"}
+
+        self.pool.publish(req.request_id, tokens, fetch=fetch)
 
     # ------------------------------------------------------------------
     # tick
@@ -408,6 +542,12 @@ class ContinuousBatchingScheduler:
             "pool_free_blocks": self.pool.free_blocks,
             "pool_fragmentation_tokens": self.pool.fragmentation_tokens(),
             "achieved_tok_s": self._achieved_tok_s(),
+            # graft-prefix-cache evidence (schema'd serve_tick fields) +
+            # the affinity advertisement the fleet router matches against
+            "prefix_cache_hit_rate": self.pool.prefix_hit_rate(),
+            "cached_blocks": self.pool.cached_blocks,
+            "prefix_hot": self.pool.hot_prefixes(),
+            "prefix_block_size": self.pool.block_size,
         }
 
     def _achieved_tok_s(self) -> Optional[float]:
@@ -510,7 +650,10 @@ class ContinuousBatchingScheduler:
             self.pool.advance(req.request_id, rem)
             if req.prefill_pos >= req.prompt_len:
                 # prompt complete: the chunk's last-position logits sampled
-                # the FIRST new token — TTFT stops here
+                # the FIRST new token — TTFT stops here. The committed
+                # prompt's full blocks enter the hash index now, so the
+                # next same-prefix request skips their prefill entirely
+                self._publish_prefix(i, req)
                 req.state = ACTIVE
                 req.record_token(int(tok[i]), now)
                 self._next_token[i] = tok[i]
@@ -641,14 +784,34 @@ class ContinuousBatchingScheduler:
         copy is zero-copy on the CPU backend, so the restored leaf would
         alias numpy-owned memory — and the next decode step DONATES the
         cache, handing XLA a buffer it doesn't own to free (heap
-        corruption, found the hard way). ``.at[].set`` yields an
-        XLA-owned buffer on the leaf's existing placement."""
+        corruption, found the hard way). All leaves update in ONE jitted,
+        cache-donating program (``_restore_rows_jit``): prefix-cache
+        restores run this per admission, and per-leaf eager ``.at[].set``
+        would copy the whole pool once per leaf.
+
+        Donation makes validation ordering load-bearing: callers
+        restoring SEVERAL caches (target + drafter) must
+        :meth:`_validate_slot_kv` every one of them BEFORE applying the
+        first — once a cache is donated, its old buffers are gone, so a
+        late validation failure could no longer leave the scheduler
+        untouched."""
+        flat, treedef, kv_idx, rows = self._validate_slot_kv(cache, leaves,
+                                                             length)
+        new_flat = _restore_rows_jit([leaf for _, leaf in flat], rows,
+                                     np.int32(slot), tuple(kv_idx))
+        return jax.tree_util.tree_unflatten(treedef, new_flat)
+
+    def _validate_slot_kv(self, cache, leaves: Dict[str, np.ndarray],
+                          length: int):
+        """Check ``leaves`` against ``cache``'s KV geometry WITHOUT
+        touching the cache; raises :class:`MigrationError` on a
+        missing/mis-shaped/mis-typed leaf. Returns the flattened pieces
+        :meth:`_restore_slot_kv` applies."""
         flat, treedef = jax.tree_util.tree_flatten_with_path(cache)
-        new_leaves = []
-        for path, leaf in flat:
+        kv_idx, rows = [], []
+        for i, (path, leaf) in enumerate(flat):
             name = _leaf_name(path)
             if name not in KV_LEAVES and not name.endswith("_scale"):
-                new_leaves.append(leaf)
                 continue
             key = jax.tree_util.keystr(path)
             src = leaves.get(key)
@@ -662,8 +825,9 @@ class ContinuousBatchingScheduler:
                     f"KV leaf {key} mismatch: bundle {src.dtype}{src.shape} "
                     f"vs cache row {want_dtype}{want_shape} — replicas must "
                     f"share kv_quant/geometry to migrate")
-            new_leaves.append(leaf.at[slot, :length].set(jax.numpy.asarray(src)))
-        return jax.tree_util.tree_unflatten(treedef, new_leaves)
+            kv_idx.append(i)
+            rows.append(np.ascontiguousarray(src))
+        return flat, treedef, kv_idx, rows
 
     def export_inflight(self, release: bool = True) -> List[dict]:
         """Serialize every in-flight request — host bookkeeping plus its
@@ -712,11 +876,18 @@ class ContinuousBatchingScheduler:
                 "meta": dict(req.meta),
                 "length": length,
                 "next_token": int(self._next_token[slot]),
-                # compat envelope: the importer refuses on any mismatch
+                "cached_prefix_tokens": req.cached_prefix_tokens,
+                # compat envelope: the importer refuses on any mismatch.
+                # prefix_cache rides in it because the KV slices below are
+                # already MATERIALIZED (per-slot dense rows — shared
+                # blocks export their bytes, not their refs), but the
+                # receiving pool's hash envelope must agree before the
+                # restored request can publish/re-match over there
                 "kv_quant": self.kv_quant,
                 "weight_dtype": self.weight_dtype,
                 "capacity": self.capacity,
                 "spec_k": self.spec_k,
+                "prefix_cache": self.prefix_cache,
                 "kv": kv,
             })
             if release:
@@ -752,7 +923,8 @@ class ContinuousBatchingScheduler:
         processes count from 0 — the wire id would collide) with the
         origin id kept in ``meta["migrated_from"]`` for at-most-once
         completion accounting."""
-        for knob in ("kv_quant", "weight_dtype", "spec_k", "capacity"):
+        for knob in ("kv_quant", "weight_dtype", "spec_k", "capacity",
+                     "prefix_cache"):
             if payload.get(knob) != getattr(self, knob):
                 raise MigrationError(
                     f"migration compat mismatch on {knob}: bundle "
@@ -778,19 +950,22 @@ class ContinuousBatchingScheduler:
         req.token_times = list(payload["token_times"])
         req.drafted_tokens = int(payload["drafted_tokens"])
         req.accepted_tokens = int(payload["accepted_tokens"])
+        req.cached_prefix_tokens = int(payload.get("cached_prefix_tokens", 0))
         length = int(payload["length"])
         slot = free[0]
-        # KV restore first — a MigrationError here must leave the replica
-        # untouched (no reserved blocks, no occupied slot)
-        cache = self._restore_slot_kv(self._cache, slot,
-                                      payload["kv"]["target"], length)
-        d_cache = None
+        # validate EVERY role before restoring ANY — a MigrationError here
+        # must leave the replica untouched (no reserved blocks, no
+        # occupied slot, and no cache buffer already donated away by a
+        # first restore when a second role's leaves turn out bad)
+        self._validate_slot_kv(self._cache, payload["kv"]["target"], length)
         if self._drafter is not None:
-            d_cache = self._restore_slot_kv(self._drafter_cache, slot,
-                                            payload["kv"]["drafter"], length)
-        self._cache = cache
-        if d_cache is not None:
-            self._drafter_cache = d_cache
+            self._validate_slot_kv(self._drafter_cache,
+                                   payload["kv"].get("drafter", {}), length)
+        self._cache = self._restore_slot_kv(self._cache, slot,
+                                            payload["kv"]["target"], length)
+        if self._drafter is not None:
+            self._drafter_cache = self._restore_slot_kv(
+                self._drafter_cache, slot, payload["kv"]["drafter"], length)
         self.pool.reserve(req.request_id, req.total_tokens)
         self.pool.advance(req.request_id, length)
         self._slot_req[slot] = req
@@ -814,6 +989,10 @@ class ContinuousBatchingScheduler:
             return
         req.state = FINISHED
         req.finish_time = now
+        # index the full blocks over prompt + output before the free, so
+        # the freed blocks park on the cached LRU instead of zeroing —
+        # a follow-up turn (prompt = this conversation + more) re-matches
+        self._publish_prefix(slot, req)
         self.pool.free(req.request_id)
         self._slot_req[slot] = None
         self._lengths[slot] = self.capacity  # park
@@ -917,6 +1096,9 @@ class ContinuousBatchingScheduler:
             "weight_dtype": self.weight_dtype,
             "weight_dtype_source": self.weight_dtype_source,
             "kv_quant": self.kv_quant,
+            "prefix_cache": self.prefix_cache,
+            "prefix_cache_source": self.prefix_cache_source,
+            "cached_prefix_tokens": sum(r.cached_prefix_tokens for r in done),
             "ttft": self.ttft_hist.snapshot() if self.ttft_hist.count else None,
             "per_token": self.tok_hist.snapshot() if self.tok_hist.count else None,
         }

@@ -666,6 +666,64 @@ def scenario_replica_sigterm_migrate(workdir):
                 migrated=inflight_before)
 
 
+def scenario_replica_sigterm_shared_prefix(workdir):
+    """SIGTERM a replica whose in-flight requests HOLD shared prefix
+    blocks (graft-prefix-cache): ref-counted sharing must not leak into
+    the bundle — the export materializes each slot's KV rows (bytes, not
+    block refs), the bundle digest verifies, and the peer, whose pool
+    shares no state with the victim's, continues every request
+    bit-identically to an uninterrupted run."""
+    import numpy as np
+    from deepspeed_tpu.inference.serving import Request
+    from deepspeed_tpu.runtime.resilience.manifest import (
+        CheckpointCorruptError, verify_checkpoint_dir)
+    mk_sched, prompts, _, max_new, _ = _fleet_fixture()
+    rng = np.random.default_rng(29)
+    template = prompts[0]  # 24 tokens: one full 16-token block shared
+    pool_ids = np.concatenate(prompts)
+    shared = [np.concatenate([template, rng.choice(pool_ids, 6)])
+              .astype(np.int32) for _ in range(6)]
+    ref_sched = mk_sched()
+    refs = [Request(prompt=p, max_new_tokens=max_new) for p in shared]
+    for r in refs:
+        ref_sched.submit(r)
+    ref_sched.run_until_drained()
+    ref_out = [list(r.output) for r in refs]
+
+    router, replicas = _fleet_pair(mk_sched)
+    # warm: two requests publish the template's blocks, then retire
+    warm_rids = [router.submit(p, max_new) for p in shared[:2]]
+    router.run_until_complete(max_rounds=5000)
+    # the burst admits against the warm index: prefix affinity routes it
+    # to the replica already holding the template's KV
+    rids = warm_rids + [router.submit(p, max_new) for p in shared[2:]]
+    for _ in range(3):           # genuinely in flight, prefixes restored
+        router.step()
+    victim = max(replicas.values(), key=lambda r: len(r.scheduler.in_flight))
+    shared_held = sum(1 for r in victim.scheduler.in_flight
+                      if r.cached_prefix_tokens > 0)
+    bundle = os.path.join(workdir, "fleet_sigterm_prefix.bundle")
+    victim.sigterm(bundle)
+    router.run_until_complete(max_rounds=5000)
+    st = router.stats()
+    try:
+        verify_checkpoint_dir(bundle)
+        digest = "verified"
+    except (CheckpointCorruptError, FileNotFoundError) as e:
+        digest = f"corrupt: {str(e)[:80]}"
+    parity = all(router.completed[rid]["output"] == ref_out[i]
+                 for i, rid in enumerate(rids) if rid in router.completed)
+    ok = (st["completed"] == len(shared) and st["pending"] == 0
+          and st["failed"] == 0 and shared_held >= 1
+          and digest == "verified" and parity)
+    return _row("replica_sigterm_shared_prefix",
+                "in-flight requests holding SHARED prefix-cache blocks "
+                "migrate digest-verified with greedy parity, zero dropped",
+                f"{st} shared_held_at_sigterm={shared_held} "
+                f"bundle={digest} parity={parity}", ok,
+                migrated=shared_held)
+
+
 def scenario_replica_sigkill_readmit(workdir):
     """SIGKILL a fleet replica mid-flight: no drain, no bundle — the
     router's liveness sweep must re-admit every orphaned request on the
@@ -707,6 +765,7 @@ SCENARIOS = {
     "torn_save": scenario_torn_save,
     "serve_drain": scenario_serve_drain,
     "replica_sigterm_migrate": scenario_replica_sigterm_migrate,
+    "replica_sigterm_shared_prefix": scenario_replica_sigterm_shared_prefix,
     "replica_sigkill_readmit": scenario_replica_sigkill_readmit,
     "truncate": lambda wd: scenario_corrupt_checkpoint(wd, "truncate"),
     "bitflip": lambda wd: scenario_corrupt_checkpoint(wd, "bitflip"),

@@ -383,6 +383,61 @@ SERVE_RUNGS = {
                       "SERVE_QPS": "16", "SERVE_REQUESTS": "48",
                       "SERVE_PROMPT": "64", "SERVE_NEW": "32",
                       "SERVE_WQ": "int4"},
+    # graft-prefix-cache rungs (ISSUE 19): the seeded shared-prefix trace
+    # (8 templates, each 3/4 of the prompt) served cache-on vs cache-off
+    # at IDENTICAL pool bytes. The comparison row carries goodput ratio,
+    # per-arm TTFT p99, hit rate / cached-blocks evidence, and the
+    # token-level greedy match — which must be EXACT (a restored block is
+    # the same KV bytes prefill would have written). QPS saturates the
+    # 8 slots so prefill compute is the contended resource the cache
+    # relieves (PERF.md §PR19). Three geometry choices are load-bearing
+    # and each was MEASURED to flip the A/B when wrong:
+    #  - POOL_TOKENS sizes the pool ABOVE slots x context (the
+    #    default): 192 blocks = 104 in-use at saturation + 72 for the
+    #    8 shared templates + headroom. At the default the spare
+    #    capacity can't hold one 9-block template and the LRU thrashes
+    #    (measured: hit rate 0.83 -> 0.48, 263 evictions, cache-on
+    #    LOSES 0.76x). A prefix cache needs the deployment reality of
+    #    spare pool; both arms price the same bytes either way.
+    #  - NEW_JITTER: with every request decoding exactly NEW tokens,
+    #    slots free in perfect waves of 8 and the OFF arm prefills in
+    #    fully-batched cohorts — an artifact of uniform lengths that
+    #    mixed hot/cold admission then breaks (measured: cache-on
+    #    0.93x despite hit rate 0.75, prefill ticks UP 42 -> 48 on
+    #    HALF the slot-chunks). Variable output lengths fragment both
+    #    arms alike and let the 2x work cut show up as ticks.
+    #  - NEW=16 << PROMPT=192 is the workload prefix caching exists
+    #    for (RAG / few-shot: long shared prompt, short completion);
+    #    at NEW=32 decode ticks dominate the budget and cap the best
+    #    possible ratio near 1.1x.
+    "serve_prefix_ab": {"SERVE_MODE": "prefix_ab", "SERVE_SLOTS": "8",
+                        "SERVE_QPS": "16", "SERVE_REQUESTS": "48",
+                        "SERVE_PROMPT": "192", "SERVE_NEW": "16",
+                        "SERVE_NEW_JITTER": "1",
+                        "SERVE_CHUNK": "32", "SERVE_SHARED_PREFIX": "8",
+                        "SERVE_POOL_TOKENS": "3072"},
+    # prefix-affinity fleet routing: the same shared-prefix trace through
+    # 2 replicas, affinity dispatch (replicas advertise their hot root
+    # prefixes in tick signals) vs pure least-loaded (FLEET_AFFINITY=0).
+    # Affinity keeps same-template requests on the replica already
+    # holding their prefix blocks — the control arm scatters each
+    # template across both replicas, paying ~2x the fleet-wide cold
+    # prefills and duplicating every template's blocks in both pools
+    # (per-worker hit rate / cold counts in the replica telemetry are
+    # the evidence; on a 1-core rig the goodput delta is muted because
+    # the replicas' compute serializes either way).
+    "serve_prefix_fleet_affinity": {
+        "SERVE_MODE": "fleet", "SERVE_REPLICAS": "2", "SERVE_QPS": "16",
+        "SERVE_REQUESTS": "48", "SERVE_PROMPT": "192", "SERVE_NEW": "16",
+        "SERVE_NEW_JITTER": "1",
+        "SERVE_SLOTS": "8", "SERVE_CHUNK": "32", "SERVE_SHARED_PREFIX": "8",
+        "SERVE_POOL_TOKENS": "3072"},
+    "serve_prefix_fleet_leastloaded": {
+        "SERVE_MODE": "fleet", "SERVE_REPLICAS": "2", "SERVE_QPS": "16",
+        "SERVE_REQUESTS": "48", "SERVE_PROMPT": "192", "SERVE_NEW": "16",
+        "SERVE_NEW_JITTER": "1",
+        "SERVE_SLOTS": "8", "SERVE_CHUNK": "32", "SERVE_SHARED_PREFIX": "8",
+        "SERVE_POOL_TOKENS": "3072", "FLEET_AFFINITY": "0"},
     # graft-fleet scaling rungs (ISSUE 17): the SAME trace through a
     # FleetRouter over N real worker subprocesses (fleet/worker.py; each
     # builds + warms its own engine off the clock). The x1/x2/x4 trio

@@ -42,6 +42,21 @@ Env: SERVE_MODEL=test|125m|350m...   model family config
   KV, under the SAME KV byte budget (SERVE_POOL_BYTES), reporting
   blocks-per-GB, goodput ratio at the offered load, and the token-level
   greedy match rate of the quantized arm against fp (PERF.md §PR16).
+  SERVE_MODE may also name "prefix_ab" (or pass --prefix-ab): the
+  graft-prefix-cache comparison — the SAME trace (use
+  SERVE_SHARED_PREFIX for a trace that actually shares prefixes) served
+  twice, prefix cache ON vs OFF, at IDENTICAL pool bytes, reporting
+  goodput ratio, TTFT p99 per arm, hit rate / cached blocks, and the
+  token-level greedy match of the cached arm against the uncached one —
+  which must be EXACT: restored KV rows are the same bytes prefill
+  would have written (PERF.md §PR19).
+     SERVE_SHARED_PREFIX=0           >0 = shared-prefix workload family:
+                                    that many template prefixes (each
+                                    3/4 of SERVE_PROMPT tokens); request
+                                    i takes template i%N + a unique
+                                    suffix. Deterministic from
+                                    SERVE_SEED, so every arm replays the
+                                    identical trace
   SERVE_MODE may also name "fleet" (or pass --fleet): the graft-fleet
   scaling row — the SAME trace replayed through a FleetRouter over
   SERVE_REPLICAS subprocess workers (fleet/worker.py, compile off the
@@ -90,6 +105,7 @@ WQ = os.environ.get("SERVE_WQ", "fp")
 KV_QUANT = os.environ.get("SERVE_KV_QUANT", "1") == "1"
 TELEMETRY = os.environ.get("SERVE_TELEMETRY", "0") == "1"
 SEED = int(os.environ.get("SERVE_SEED", "0"))
+SHARED_PREFIX = int(os.environ.get("SERVE_SHARED_PREFIX", "0"))
 REPLICAS = int(os.environ.get("SERVE_REPLICAS", "2"))
 TICK_MS = float(os.environ.get("SERVE_TICK_MS", "0"))
 
@@ -147,6 +163,32 @@ def poisson_trace(rng, vocab):
     return trace
 
 
+def shared_prefix_trace(rng, vocab):
+    """The graft-prefix-cache workload family: ``SERVE_SHARED_PREFIX``
+    template prefixes (each 3/4 of SERVE_PROMPT tokens, drawn once up
+    front), each request = a uniformly drawn template + a unique random
+    suffix, arrivals Poisson at SERVE_QPS. Everything is drawn from the
+    seeded ``rng``, so cache-on and cache-off arms replay the IDENTICAL
+    trace — the A/B's whole premise. Template choice is random rather
+    than round-robin: a cyclic assignment resonates with alternating
+    least-loaded dispatch (period N divisible by the replica count
+    partitions templates perfectly by accident), which would make the
+    affinity-vs-least-loaded control meaningless."""
+    gaps = rng.exponential(1.0 / QPS, REQUESTS)
+    arrivals = np.cumsum(gaps)
+    shared = max((PROMPT * 3) // 4, 1)
+    templates = [rng.integers(0, vocab, (shared,)).astype(np.int32)
+                 for _ in range(SHARED_PREFIX)]
+    trace = []
+    for i in range(REQUESTS):
+        suffix = rng.integers(0, vocab, (PROMPT - shared,)).astype(np.int32)
+        t = int(rng.integers(0, SHARED_PREFIX))
+        prompt = np.concatenate([templates[t], suffix])
+        n = int(rng.integers(max(NEW // 4, 1), NEW + 1)) if NEW_JITTER else NEW
+        trace.append((float(arrivals[i]), prompt, n))
+    return trace
+
+
 def _lat_row(hist):
     if hist is None or (hasattr(hist, "count") and not hist.count):
         return None
@@ -195,7 +237,7 @@ def serve_evidence(engine, slots, wq="fp", kv_quant=False):
 
 def run_continuous(engine, cfg, trace, drafter=None, telemetry=None,
                    wq=None, kv_quant=None, pool_bytes=None, label="continuous",
-                   collect_outputs=False):
+                   collect_outputs=False, prefix_cache=None):
     from deepspeed_tpu.inference.serving import (ContinuousBatchingScheduler,
                                                  Request, ServingConfig)
 
@@ -210,6 +252,9 @@ def run_continuous(engine, cfg, trace, drafter=None, telemetry=None,
         # (not the bench) is what catches a leaked env
         weight_dtype=(None if WQ == "fp" else WQ) if wq is None else wq,
         kv_quant=KV_QUANT if kv_quant is None else kv_quant,
+        # None = the DS_SERVE_PREFIX_CACHE/config resolution (default on);
+        # the prefix_ab arms pin "on"/"off" explicitly
+        prefix_cache=prefix_cache,
         prefill_chunk=CHUNK if CHUNK > 0 else n_positions,
         speculation={"enabled": drafter is not None, "k": SPEC_K})
     sched = ContinuousBatchingScheduler(engine, scfg, drafter=drafter,
@@ -247,6 +292,10 @@ def run_continuous(engine, cfg, trace, drafter=None, telemetry=None,
         "weight_dtype": stats["weight_dtype"],
         "weight_dtype_source": stats["weight_dtype_source"],
         "kv_quant": stats["kv_quant"],
+        "prefix_cache": stats["prefix_cache"],
+        "prefix_cache_source": stats["prefix_cache_source"],
+        "cached_prefix_tokens": stats["cached_prefix_tokens"],
+        "prefix_hit_rate": stats["pool"].get("prefix_hit_rate"),
         "chunked_prefill": CHUNK > 0, "prefill_chunk": CHUNK or n_positions,
         "slots": sched.slots,
     }
@@ -328,6 +377,57 @@ def quant_ab(engine, cfg, trace, header, drafter=None):
     return comparison
 
 
+def prefix_ab(engine, cfg, trace, header, drafter=None):
+    """The graft-prefix-cache A/B (PERF.md §PR19): the same trace served
+    twice — prefix cache OFF then ON — with IDENTICAL pool sizing (same
+    SERVE_POOL_TOKENS/SERVE_POOL_BYTES, asserted on the pool the
+    scheduler actually built). Reports goodput ratio, per-arm TTFT p99,
+    hit rate / cached-tokens / cached-blocks evidence, and the
+    token-level greedy match of the cached arm against the uncached one.
+    The match must be EXACT: a cache hit restores the same KV bytes
+    prefill would have written, so any divergence is a correctness bug,
+    not a tolerance."""
+    arms = {}
+    for label in ("off", "on"):
+        row = run_continuous(engine, cfg, trace, drafter=drafter,
+                             prefix_cache=label, label=f"prefix_ab:{label}",
+                             collect_outputs=True)
+        row.update(serve_evidence(engine, SLOTS, wq=row["weight_dtype"],
+                                  kv_quant=row["kv_quant"]))
+        arms[label] = row
+        printable = dict(header, **{k: v for k, v in row.items()
+                                    if not k.startswith("_")})
+        print(json.dumps(printable), flush=True)
+    off_row, on_row = arms["off"], arms["on"]
+    comparison = {
+        "comparison": "prefix_cache_on_vs_off", "qps": QPS,
+        "shared_prefix_templates": SHARED_PREFIX or None,
+        "pool_blocks_off": off_row["pool"]["num_blocks"],
+        "pool_blocks_on": on_row["pool"]["num_blocks"],
+        "pool_blocks_equal":
+            off_row["pool"]["num_blocks"] == on_row["pool"]["num_blocks"],
+        "prefix_hit_rate": on_row["prefix_hit_rate"],
+        "cached_prefix_tokens": on_row["cached_prefix_tokens"],
+        "cached_blocks_final": on_row["pool"]["cached_blocks"],
+        "published_blocks": on_row["pool"]["published_blocks"],
+        "goodput_off_tok_s": off_row["goodput_tok_s"],
+        "goodput_on_tok_s": on_row["goodput_tok_s"],
+        "goodput_ratio": round(on_row["goodput_tok_s"]
+                               / max(off_row["goodput_tok_s"], 1e-9), 3),
+        "ttft_p99_off": (off_row["ttft"] or {}).get("p99"),
+        "ttft_p99_on": (on_row["ttft"] or {}).get("p99"),
+        "ttft_p99_improved":
+            (on_row["ttft"] or {}).get("p99") is not None
+            and (off_row["ttft"] or {}).get("p99") is not None
+            and on_row["ttft"]["p99"] < off_row["ttft"]["p99"],
+        "greedy_match": _token_match(on_row["_outputs"], off_row["_outputs"]),
+        "cache_on_beats_off_goodput":
+            on_row["goodput_tok_s"] > off_row["goodput_tok_s"],
+    }
+    print(json.dumps(comparison), flush=True)
+    return comparison
+
+
 def _probe_kv_bytes_per_token(engine, cfg):
     """The fp cache's per-token KV footprint, measured the same way the
     scheduler's byte-budget sizing measures it."""
@@ -402,12 +502,18 @@ def run_fleet(cfg, trace, n_positions):
            "FLEET_SLOTS": str(SLOTS),
            "FLEET_CHUNK": str(CHUNK if CHUNK > 0 else n_positions),
            "FLEET_KV_QUANT": "1" if KV_QUANT else "0"}
+    if POOL_TOKENS:
+        env["FLEET_POOL_TOKENS"] = str(POOL_TOKENS)
     if TICK_MS:
         env["FLEET_TICK_SLEEP_MS"] = str(TICK_MS)
     if TELEMETRY:
         env["FLEET_TELEMETRY_DIR"] = os.environ.get(
             "SERVE_TELEMETRY_DIR", "/tmp/ds_tpu_serve_telemetry")
-    router = FleetRouter(heartbeat_timeout=120.0)
+    # prefix-affinity dispatch A/B toggle (FLEET_AFFINITY=0 = pure
+    # least-loaded): the serve_prefix_fleet_* perf-ladder rungs compare
+    # the two on the same shared-prefix trace
+    affinity = os.environ.get("FLEET_AFFINITY", "1") == "1"
+    router = FleetRouter(heartbeat_timeout=120.0, affinity=affinity)
     replicas = [SubprocessReplica(f"w{i}", os.path.join(workdir, f"w{i}"),
                                   env=env)
                 for i in range(REPLICAS)]
@@ -446,6 +552,9 @@ def run_fleet(cfg, trace, n_positions):
             "duplicate_completions": rstats["duplicate_completions"],
             "readmitted": rstats["readmitted"],
             "completed_by": rstats["completed_by"],
+            "affinity": rstats["affinity"],
+            "affinity_hits": rstats["affinity_hits"],
+            "affinity_overruled": rstats["affinity_overruled"],
             "ticks_by": {r.name: r.ticks_seen for r in replicas},
             "goodput_tok_s": round(tokens_out / wall, 1),
             "ttft": _lat_row(ttft_h),
@@ -470,8 +579,11 @@ def main():
     modes = ["continuous", "static"] if MODES == "both" else MODES.split(",")
     if "--fleet" in sys.argv:
         modes = ["fleet"]
+    if "--prefix-ab" in sys.argv:
+        modes = ["prefix_ab"]
     unknown = [m for m in modes
-               if m not in ("continuous", "static", "quant_ab", "fleet")]
+               if m not in ("continuous", "static", "quant_ab", "prefix_ab",
+                            "fleet")]
     if unknown:
         raise SystemExit(f"unknown SERVE_MODE entry {unknown[0]!r}")
     if "fleet" in modes and modes != ["fleet"]:
@@ -501,7 +613,8 @@ def main():
     else:
         engine, cfg = build_engine(n_positions)
     rng = np.random.default_rng(SEED)
-    trace = poisson_trace(rng, cfg.vocab_size)
+    trace = (shared_prefix_trace(rng, cfg.vocab_size) if SHARED_PREFIX
+             else poisson_trace(rng, cfg.vocab_size))
 
     drafter = None
     if SPEC and ("continuous" in modes or "quant_ab" in modes):
@@ -519,13 +632,20 @@ def main():
             output_path=os.environ.get("SERVE_TELEMETRY_DIR",
                                        "/tmp/ds_tpu_serve_telemetry"),
             job_name=f"serve_{MODEL}_qps{QPS}"))
+        from deepspeed_tpu.inference.serving import resolve_prefix_cache
+        # graft-calibrate separation markers (same contract as the fleet
+        # worker's header): the field's presence keys collect_samples'
+        # mixed-run refusal for serve-scope samples
         telemetry.write_run_header({"bench": "serve_bench", "model": MODEL,
-                                    "qps": QPS, "slots": SLOTS})
+                                    "qps": QPS, "slots": SLOTS,
+                                    "prefix_cache": resolve_prefix_cache(None)[0],
+                                    "cached_prefix_tokens": 0})
 
     rows = {}
     header = {"model": MODEL, "qps": QPS, "requests": REQUESTS, "prompt": PROMPT,
               "new": NEW, "new_jitter": NEW_JITTER, "long_every": LONG_EVERY,
-              "slots": SLOTS, "backend": jax.default_backend(), "seed": SEED}
+              "slots": SLOTS, "backend": jax.default_backend(), "seed": SEED,
+              "shared_prefix": SHARED_PREFIX or None}
     for mode in modes:
         if mode == "continuous":
             row = run_continuous(engine, cfg, trace, drafter=drafter,
@@ -535,6 +655,9 @@ def main():
                                       kv_quant=row["kv_quant"]))
         elif mode == "quant_ab":
             quant_ab(engine, cfg, trace, header, drafter=drafter)
+            continue
+        elif mode == "prefix_ab":
+            prefix_ab(engine, cfg, trace, header, drafter=drafter)
             continue
         elif mode == "fleet":
             row = run_fleet(cfg, trace, n_positions)
