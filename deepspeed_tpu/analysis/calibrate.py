@@ -351,9 +351,18 @@ def collect_samples(paths: Iterable[str],
     ``prefix_cache``/``cached_prefix_tokens`` header fields (pre-PR-19
     telemetry) are ambiguous — they cannot be pooled with marked runs of
     the same group without silently mixing the two populations, so a mix
-    raises :class:`CalibrationError` instead of fitting garbage."""
+    raises :class:`CalibrationError` instead of fitting garbage.
+
+    graft-rlhf separation (same pattern): ``rlhf_rollout`` /
+    ``rlhf_learner`` scopes join the fit set. An overlapped rollout
+    run's tick timings carry interleaved learner work (the overlap being
+    priced!), so runs whose header declares ``rlhf_overlap: "on"`` group
+    under ``<scope>_overlap``; rlhf runs missing the ``rlhf_overlap``
+    header field are ambiguous and a marked/unmarked mix in one group
+    refuses loudly."""
     groups: Dict[str, List[dict]] = {}
     serve_marking: Dict[str, set] = {}
+    rlhf_marking: Dict[str, set] = {}
     for path in paths:
         for run, price, windows in _iter_runs(path):
             if not isinstance(price, dict) or price.get("error") \
@@ -368,6 +377,12 @@ def collect_samples(paths: Iterable[str],
                                          set()).add(marked)
                 if marked and (run or {}).get("prefix_cache") == "on":
                     scope = f"{scope}_cached"
+            elif scope.startswith("rlhf"):
+                marked = "rlhf_overlap" in (run or {})
+                rlhf_marking.setdefault(f"{backend}/{scope}",
+                                        set()).add(marked)
+                if marked and (run or {}).get("rlhf_overlap") == "on":
+                    scope = f"{scope}_overlap"
             key = f"{backend}/{scope}"
             usable = windows[1:] if len(windows) > 1 else windows
             source = (run or {}).get("config_sig") or (run or {}).get("bench") \
@@ -392,6 +407,17 @@ def collect_samples(paths: Iterable[str],
             f"a meaningless cost line; re-collect the unmarked runs with "
             f"current telemetry (fleet/worker.py stamps the fields) or "
             f"drop them from the collection")
+    mixed_rlhf = sorted(k for k, flags in rlhf_marking.items()
+                        if len(flags) > 1)
+    if mixed_rlhf:
+        raise CalibrationError(
+            f"rlhf sample group(s) {mixed_rlhf} mix runs WITH the "
+            f"rlhf_overlap header field and runs WITHOUT it — unmarked "
+            f"runs may contain overlapped-learner ticks, so pooling them "
+            f"with rollout-only samples would fit a meaningless cost "
+            f"line; re-collect the unmarked runs with current telemetry "
+            f"(tools/rlhf_bench.py stamps the field) or drop them from "
+            f"the collection")
     return {k: groups[k] for k in sorted(groups)}
 
 

@@ -774,6 +774,104 @@ def serve_prefix_decode_step() -> ProgramInfo:
         set_topology(None)
 
 
+#: committed activation budget (MiB) for the graft-rlhf rollout decode
+#: tick below (8 slots x 128 positions, tiny GPT-2 served at
+#: tensor=2/data=4 from a ZeRO-3 hybrid engine's inference view).
+#: Measured static transient on the pinned container: 1.05 MiB;
+#: committed at 1.25 MiB (~19% headroom).
+RLHF_ROLLOUT_BUDGET_MB = 1.25
+
+
+@scenario("rlhf_rollout_step")
+def rlhf_rollout_step() -> ProgramInfo:
+    """graft-rlhf's rollout decode tick: the continuous-scheduler decode
+    program exactly as the RLHF loop serves it — built over a
+    ``DeepSpeedHybridEngine``'s inference view (ZeRO-3 training params on
+    a data=2/fsdp=4 mesh, relayouted into the tp=2 serving placement
+    through the PR-15 planner), one token per slot against the per-slot
+    ragged cache. R009 pins the tp collective signature of the tick the
+    learner overlaps with, R010 gates its per-tick transient against
+    :data:`RLHF_ROLLOUT_BUDGET_MB`, and R013 ratchets both against the
+    committed baseline. The planner's priced summary of the
+    train-mesh→serve-mesh weight sync (the per-``sync_every`` cost the
+    rollout loop stamps as evidence) rides the metadata next to the
+    compiled inventory — the reshard_resume pattern."""
+    import deepspeed_tpu
+    import numpy as np
+    from deepspeed_tpu.inference.serving import make_slot_cache
+    from deepspeed_tpu.inference.serving.programs import (build_decode_step,
+                                                          make_apply_fn)
+    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+    from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
+    from deepspeed_tpu.runtime.rlhf.sync import plan_params_sync
+
+    if len(jax.devices()) < 8:
+        raise ScenarioSkipped("rlhf_rollout_step expects >=8 host devices "
+                              "(data=2/fsdp=4 train mesh, tp=2 serve mesh)")
+    set_topology(None)
+    try:
+        slots = 8
+        seq = 32
+        cfg = get_gpt2_config("test", n_layer=2, n_positions=128)
+        ds = {"train_batch_size": 8,
+              "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+              "zero_optimization": {"stage": 3,
+                                    "stage3_param_persistence_threshold": 0},
+              "hybrid_engine": {"enabled": True, "max_out_tokens": 128,
+                                "inference_tp_size": 2},
+              "steps_per_print": 10**9}
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT2LMHeadModel(cfg), config=ds,
+            loss_fn=lambda logits, batch: logits.mean(),
+            topology=MeshTopology(data=2, fsdp=4))
+        engine.initialize_state({"input_ids": np.zeros((8, seq), np.int32)})
+        engine._infer_engine = engine._build_inference_engine()
+        infer = engine._infer_engine
+        sync_plan = plan_params_sync(engine._inference_params_value(),
+                                     engine.mesh, infer.params, infer.mesh)
+        sync_plan.pop("plan_s", None)  # static evidence only, no wall time
+        set_topology(infer.topology)
+        cache = make_slot_cache(infer.module, slots)
+        decode = build_decode_step(make_apply_fn(infer.module, infer._mparams),
+                                   do_sample=False, temperature=1.0, top_k=0,
+                                   top_p=1.0)
+        tokens = jnp.zeros((slots,), jnp.int32)
+        jaxpr = jax.make_jaxpr(decode)(infer.params, cache, tokens)
+        return ProgramInfo(
+            name="rlhf_rollout_step", jaxpr=jaxpr, kind="serve_decode",
+            lower=lambda: jax.jit(decode).lower(infer.params, cache, tokens),
+            metadata={
+                "serve_slots": slots,
+                "rlhf_weight_sync_plan": sync_plan,
+                "activation_budget_bytes": int(RLHF_ROLLOUT_BUDGET_MB * 2**20),
+                "collective_signature": [
+                    # the hybrid engine builds its serve mesh over ALL
+                    # devices (tensor=2, data=4 on the 8-device rig), so
+                    # the compiled tick carries the serve_decode_step tp
+                    # skeleton PLUS small data-axis redistributions of
+                    # the 8-slot batch (measured: 3072 bytes/tick on the
+                    # g4 axis — the slot ids land data-sharded, GSPMD
+                    # regathers them for the replicated cache update)
+                    {"layer": "compiled", "kind": "all_reduce", "count": 5,
+                     "note": "2 all-reduces per block + 1 for the tied "
+                             "LM head on the hybrid engine's tp=2 serve "
+                             "mesh"},
+                    {"layer": "compiled", "kind": "all_gather",
+                     "max_count": 14,
+                     "note": "2 embedding-table gathers + the data-axis "
+                             "slot-batch regathers of the tensor=2/data=4 "
+                             "hybrid serve mesh; more would mean the "
+                             "learner's ZeRO layout leaked through the "
+                             "weight sync into the compiled rollout tick"},
+                    {"layer": "compiled", "kind": "collective_permute",
+                     "max_count": 4,
+                     "note": "slot-batch redistribution between the "
+                             "data-sharded token ids and the replicated "
+                             "KV cache — O(slots) bytes, not O(params)"}]})
+    finally:
+        set_topology(None)
+
+
 @scenario("reshard_resume")
 def reshard_resume() -> ProgramInfo:
     """graft-elastic's restore-path data movement, as a static program the

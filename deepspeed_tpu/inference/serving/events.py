@@ -28,11 +28,18 @@ SERVE_EVENT_SCHEMAS: Dict[str, frozenset] = {
     # (ref-0 blocks parked on the cached-free LRU, still reclaimable);
     # the optional prefix_hot list (advertised hot position-0 prefix
     # keys) rides along un-required — the router ignores its absence
+    # graft-rlhf adds the rollout evidence triple: rollout_experience
+    # (completed experience through this scheduler), learner_steps_over-
+    # lapped (train_batch calls interleaved while requests were in
+    # flight), weight_sync_generation (0 = still serving construction
+    # weights; bumped by every swap_served_params)
     "serve_tick": frozenset({
         "tick", "kind", "queue_depth", "in_flight", "slots", "free_slots",
         "ttft_p50", "ttft_p99", "pool_free_blocks",
         "pool_fragmentation_tokens", "achieved_tok_s",
         "prefix_cache_hit_rate", "cached_blocks",
+        "rollout_experience", "learner_steps_overlapped",
+        "weight_sync_generation",
     }),
     # terminal accounting of a preemption drain (PR 14 contract)
     "serve_drain": frozenset({"signal", "in_flight", "refused"}),
@@ -47,6 +54,13 @@ SERVE_EVENT_SCHEMAS: Dict[str, frozenset] = {
     # one per restored request on the receiving replica
     "serve_admit_migrated": frozenset({"request_id", "migrated_from",
                                        "state", "length"}),
+    # graft-rlhf: one per weight hot-swap — the planner-priced sync
+    # evidence (gather_bytes/total_bytes may be None when the plan
+    # degraded to an error stamp; digest_verified is the bit-identity
+    # proof between learner-published and served params)
+    "rlhf_weight_sync": frozenset({"generation", "gather_bytes",
+                                   "total_bytes", "digest_verified",
+                                   "in_flight"}),
 }
 
 

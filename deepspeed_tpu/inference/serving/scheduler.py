@@ -260,6 +260,14 @@ class ContinuousBatchingScheduler:
         self.drafted_total = 0
         self.accepted_total = 0
         self.finished: List[Request] = []
+        # graft-rlhf rollout evidence: experience completed through this
+        # scheduler, learner steps the rollout loop interleaved while
+        # requests were in flight, and the weight-sync generation counter
+        # (bumped by swap_served_params — 0 means construction weights)
+        self.rollout_experience = 0
+        self.learner_steps_overlapped = 0
+        self.weight_sync_generation = 0
+        self.last_weight_sync: Optional[dict] = None
         log_dist(f"graft-serve: slots={self.slots} capacity={self.capacity} "
                  f"pool={self.pool.num_blocks}x{self.pool.block_size} "
                  f"chunk={config.prefill_chunk} kv_write={self.kv_write}"
@@ -548,6 +556,10 @@ class ContinuousBatchingScheduler:
             "cached_blocks": self.pool.cached_blocks,
             "prefix_hot": self.pool.hot_prefixes(),
             "prefix_block_size": self.pool.block_size,
+            # graft-rlhf rollout evidence (schema'd serve_tick fields)
+            "rollout_experience": self.rollout_experience,
+            "learner_steps_overlapped": self.learner_steps_overlapped,
+            "weight_sync_generation": self.weight_sync_generation,
         }
 
     def _achieved_tok_s(self) -> Optional[float]:
@@ -589,6 +601,91 @@ class ContinuousBatchingScheduler:
                                            kind="serve_decode")
         except Exception as e:  # pricing must never take the replica down
             return {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+
+    # ------------------------------------------------------------------
+    # graft-rlhf: weight hot-swap seam
+    # ------------------------------------------------------------------
+    def swap_served_params(self, params, expected_digest: Optional[str] = None,
+                           generation: Optional[int] = None,
+                           evidence: Optional[dict] = None) -> None:
+        """Hot-swap the served params between decode ticks (graft-rlhf
+        weight sync). Every serving program takes ``self._serve_params``
+        explicitly per call, so swapping the attribute swaps the weights
+        the NEXT tick serves with zero recompile — KV already written
+        stays valid (it was computed under the generation that wrote it;
+        in-flight requests finish on the new weights, which is the
+        standard in-flight RLHF staleness contract).
+
+        The new tree must match the served tree exactly (structure,
+        shapes, dtypes) — a drifted learner tree is refused loudly, not
+        served. When ``expected_digest`` is given the placed params are
+        re-digested and verified against what the learner published, so
+        generation N's served weights are proven bit-identical to the
+        sync evidence. Under a quantized weight view (``weight_dtype !=
+        "fp"``) the fp params are re-encoded through ``_quant_view`` and
+        digest verification is refused (the re-encode is lossy by
+        design — the caller must not expect fp-bit identity)."""
+        if self.weight_dtype != "fp":
+            if expected_digest is not None:
+                raise ValueError(
+                    f"digest verification is meaningless under a quantized "
+                    f"weight view (wq={self.weight_dtype}): the served "
+                    f"params are a lossy re-encode of what the learner "
+                    f"published — pass expected_digest=None")
+            _, new_params = _quant_view(self.engine.module, params,
+                                        self.weight_dtype,
+                                        self.config.weight_group_size)
+        else:
+            new_params = params
+
+        old_leaves, old_def = jax.tree_util.tree_flatten_with_path(
+            self._serve_params)
+        new_leaves, new_def = jax.tree_util.tree_flatten_with_path(new_params)
+        if old_def != new_def:
+            raise ValueError(
+                "swap_served_params: new tree structure differs from the "
+                "served tree — the learner's params drifted from what this "
+                "scheduler compiled against")
+        problems = []
+        for (path, old), (_, new) in zip(old_leaves, new_leaves):
+            if getattr(old, "shape", None) != getattr(new, "shape", None) \
+                    or getattr(old, "dtype", None) != getattr(new, "dtype", None):
+                problems.append(
+                    f"{jax.tree_util.keystr(path)}: served "
+                    f"{getattr(old, 'shape', '?')}/{getattr(old, 'dtype', '?')}"
+                    f" vs new {getattr(new, 'shape', '?')}/"
+                    f"{getattr(new, 'dtype', '?')}")
+        if problems:
+            raise ValueError("swap_served_params: leaf drift — "
+                             + "; ".join(problems[:5]))
+
+        placed = jax.tree.map(
+            lambda v, old: jax.device_put(v, old.sharding),  # graft-lint: waive R008 jax-owned served weights, never donated
+            new_params, self._serve_params)
+        jax.block_until_ready(placed)
+        digest_verified = False
+        if expected_digest is not None:
+            from deepspeed_tpu.runtime.rlhf.sync import params_digest
+            got = params_digest(placed)
+            if got != expected_digest:
+                raise ValueError(
+                    f"swap_served_params: digest mismatch after placement — "
+                    f"learner published {expected_digest[:16]}… but the "
+                    f"placed params digest to {got[:16]}…")
+            digest_verified = True
+        self._serve_params = placed
+        self.weight_sync_generation = (generation if generation is not None
+                                       else self.weight_sync_generation + 1)
+        self.last_weight_sync = dict(evidence or {},
+                                     digest_verified=digest_verified)
+        if self.telemetry is not None:
+            ev = evidence or {}
+            self.telemetry.emit(
+                "rlhf_weight_sync", generation=self.weight_sync_generation,
+                gather_bytes=ev.get("gather_bytes"),
+                total_bytes=ev.get("total_bytes"),
+                digest_verified=digest_verified,
+                in_flight=len(self.in_flight))
 
     def _touch_serving_heartbeat(self, tick: int) -> None:
         """Refresh the PR-13 supervisor heartbeat with a serving role
@@ -1108,4 +1205,14 @@ class ContinuousBatchingScheduler:
             out["accepted"] = self.accepted_total
             out["acceptance_rate"] = (self.accepted_total / self.drafted_total
                                       if self.drafted_total else None)
+        if (self.rollout_experience or self.weight_sync_generation
+                or self.learner_steps_overlapped):
+            # graft-rlhf rollout evidence (present iff this scheduler
+            # served an RLHF loop — plain serving stats stay unchanged)
+            out["rollout"] = {
+                "experience": self.rollout_experience,
+                "learner_steps_overlapped": self.learner_steps_overlapped,
+                "weight_sync_generation": self.weight_sync_generation,
+                "last_weight_sync": self.last_weight_sync,
+            }
         return out

@@ -18,8 +18,9 @@ fold adapter pairs into the *inference copy* of each kernel
 adapters separate.
 """
 
+import os
 import time
-from typing import Dict
+from typing import Dict, List, Optional
 
 import jax
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine, _cast_floating
@@ -64,6 +65,12 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         self._training_latency = 0.0
         self._iters = 0
         self._gather_latency = 0.0
+        # graft-rlhf: planner-priced weight-sync evidence. Every
+        # train-mesh->serve-mesh relayout bumps the generation counter
+        # and stamps the plan's gather_bytes + a content digest.
+        self.weight_sync_generation = 0
+        self.last_weight_sync: Optional[dict] = None
+        self.weight_sync_log: List[dict] = []
 
     # ------------------------------------------------------------------
     def train_batch(self, batch=None, data_iter=None):
@@ -104,16 +111,32 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
             params = fuse_lora_params(params, fuse=True)
         return _cast_floating(params, self.compute_dtype)
 
-    def _refresh_inference_params(self):
+    def _refresh_inference_params(self) -> dict:
+        """Relayout the live training params into the inference-TP
+        placement through the PR-15 reshard planner: plan the
+        train-mesh->serve-mesh move on host (priced ``gather_bytes``
+        stamped as evidence), execute with one ``device_put`` onto the
+        planned target shardings (XLA emits the all-gathers — the
+        reference's explicit partition gathering), digest the synced
+        leaves so the serving side can verify the hot-swap. Returns the
+        per-sync evidence row (also kept in ``weight_sync_log``)."""
+        from deepspeed_tpu.runtime.rlhf.sync import (execute_params_sync,
+                                                     plan_params_sync)
         t0 = time.perf_counter()
         values = self._inference_params_value()
-        # reshard train-layout -> inference-TP layout; XLA emits the
-        # all-gathers (the reference's explicit partition gathering)
         specs = self._infer_engine.params  # current placement template
-        self._infer_engine.params = jax.tree.map(
-            lambda v, old: jax.device_put(v, old.sharding), values, specs)  # graft-lint: waive R008 jax-owned training params, device-to-device reshard
+        plan = plan_params_sync(values, self.mesh, specs,
+                                self._infer_engine.mesh)
+        digest = os.environ.get("DS_RLHF_SYNC_DIGEST", "1") != "0"
+        self._infer_engine.params, evidence = execute_params_sync(
+            values, specs, plan_summary=plan, digest=digest)
         self._infer_params_stale = False
+        self.weight_sync_generation += 1
+        evidence["generation"] = self.weight_sync_generation
+        self.last_weight_sync = evidence
+        self.weight_sync_log.append(evidence)
         self._gather_latency += time.perf_counter() - t0
+        return evidence
 
     # ------------------------------------------------------------------
     def generate(self, input_ids, **kwargs):
@@ -136,6 +159,54 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
             set_topology(self.topology)
         self._generate_latency += time.perf_counter() - t0
         return out
+
+    # ------------------------------------------------------------------
+    # graft-rlhf: in-flight rollouts on the continuous scheduler
+    # ------------------------------------------------------------------
+    def rollout_scheduler(self, serving_config=None, telemetry=None,
+                          seed: int = 0):
+        """A :class:`ContinuousBatchingScheduler` over this engine's
+        inference view, for in-flight RLHF rollouts (prompts stream in,
+        experience streams out while the learner trains). The served
+        params snapshot the live training weights at construction;
+        :meth:`sync_rollout_weights` hot-swaps them between decode ticks."""
+        assert self.state is not None, \
+            "initialize_state / train_batch must run before rollout_scheduler()"
+        from deepspeed_tpu.inference.serving import ContinuousBatchingScheduler
+        from deepspeed_tpu.parallel.topology import set_topology
+        if self._infer_engine is None:
+            self._infer_engine = self._build_inference_engine()
+            self._infer_params_stale = False
+        elif self._infer_params_stale:
+            self._refresh_inference_params()
+        set_topology(self._infer_engine.topology)
+        try:
+            sched = ContinuousBatchingScheduler(
+                self._infer_engine, serving_config, telemetry=telemetry,
+                seed=seed)
+        finally:
+            set_topology(self.topology)
+        sched.weight_sync_generation = self.weight_sync_generation
+        return sched
+
+    def sync_rollout_weights(self, scheduler) -> dict:
+        """Planner-priced weight sync into a rollout scheduler: refresh
+        the inference view from the live training params (plan + priced
+        ``gather_bytes``), then hot-swap the scheduler's served params
+        between decode ticks, digest-verified. Returns the evidence row."""
+        from deepspeed_tpu.parallel.topology import set_topology
+        assert self._infer_engine is not None, \
+            "rollout_scheduler() must run before sync_rollout_weights()"
+        evidence = self._refresh_inference_params()
+        set_topology(self._infer_engine.topology)
+        try:
+            scheduler.swap_served_params(
+                self._infer_engine.params,
+                expected_digest=evidence.get("digest"),
+                generation=self.weight_sync_generation, evidence=evidence)
+        finally:
+            set_topology(self.topology)
+        return evidence
 
     def infer_forward(self, input_ids):
         """Logits from the inference view (no cache)."""

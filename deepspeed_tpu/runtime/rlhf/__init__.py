@@ -1,0 +1,19 @@
+"""graft-rlhf: in-flight RLHF rollouts on the continuous scheduler.
+
+The rollout loop (:class:`RolloutLoop`) streams prompts into a
+:class:`~deepspeed_tpu.inference.serving.ContinuousBatchingScheduler`
+built over the hybrid engine's inference view and interleaves the
+learner's ``train_batch`` at decode-tick granularity; weight sync is
+planner-priced (:mod:`deepspeed_tpu.runtime.rlhf.sync`) and hot-swapped
+between decode ticks, digest-verified.
+"""
+
+from deepspeed_tpu.runtime.rlhf.rollout import (Experience, RolloutConfig,
+                                                RolloutLoop)
+from deepspeed_tpu.runtime.rlhf.sync import (execute_params_sync,
+                                             params_digest, plan_params_sync,
+                                             value_layout)
+
+__all__ = ["Experience", "RolloutConfig", "RolloutLoop",
+           "execute_params_sync", "params_digest", "plan_params_sync",
+           "value_layout"]

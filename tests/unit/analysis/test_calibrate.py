@@ -201,3 +201,43 @@ def test_drift_sidecar_equivalent_to_jsonl(tmp_path):
                                                    "measured_s")}
                                 for s in v] for k, v in groups.items()}
     assert strip(from_jsonl) == strip(from_sidecar)
+
+
+# ---------------------------------------------------------------------------
+# graft-rlhf scope separation (PR 20): overlapped rollout/learner windows
+# share a tick with the other workload, so they fit as distinct scopes
+# ---------------------------------------------------------------------------
+
+def test_rlhf_overlap_groups_separately(tmp_path):
+    """rlhf runs whose header marks rlhf_overlap=on land in a dedicated
+    <scope>_overlap group; marked-off runs keep the plain scope — the two
+    regimes must never pool into one fit."""
+    price = {"flops_proxy": 10**9, "bytes_moved": 0}
+    _write_run_jsonl(tmp_path / "on.jsonl", price, [0.5, 0.011, 0.012],
+                     run={"scope": "rlhf_rollout", "rlhf_overlap": "on"})
+    _write_run_jsonl(tmp_path / "off.jsonl", price, [0.4, 0.02],
+                     run={"scope": "rlhf_rollout", "rlhf_overlap": "off"})
+    _write_run_jsonl(tmp_path / "learner.jsonl", price, [0.3, 0.03],
+                     run={"scope": "rlhf_learner", "rlhf_overlap": "on"})
+    groups = cal.collect_samples([str(tmp_path / "on.jsonl"),
+                                  str(tmp_path / "off.jsonl"),
+                                  str(tmp_path / "learner.jsonl")])
+    assert [s["measured_s"] for s in groups["cpu/rlhf_rollout_overlap"]] \
+        == [0.011, 0.012]
+    assert [s["measured_s"] for s in groups["cpu/rlhf_rollout"]] == [0.02]
+    assert [s["measured_s"] for s in groups["cpu/rlhf_learner_overlap"]] \
+        == [0.03]
+
+
+def test_rlhf_mixed_marking_refuses(tmp_path):
+    """An rlhf sample group mixing runs WITH the rlhf_overlap header field
+    and runs WITHOUT it is ambiguous (pre-PR-20 telemetry?) — the collector
+    must refuse rather than fit a polluted pool."""
+    price = {"flops_proxy": 10**9, "bytes_moved": 0}
+    _write_run_jsonl(tmp_path / "marked.jsonl", price, [0.5, 0.011],
+                     run={"scope": "rlhf_rollout", "rlhf_overlap": "off"})
+    _write_run_jsonl(tmp_path / "unmarked.jsonl", price, [0.4, 0.02],
+                     run={"scope": "rlhf_rollout"})
+    with pytest.raises(cal.CalibrationError, match="rlhf"):
+        cal.collect_samples([str(tmp_path / "marked.jsonl"),
+                             str(tmp_path / "unmarked.jsonl")])

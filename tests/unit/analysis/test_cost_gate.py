@@ -59,7 +59,7 @@ def test_committed_cost_baseline_covers_the_matrix():
     for name in ("moe_ep_step", "pipe_chunked_step", "pipe_1f1b_step",
                  "zero3_train_step", "train_batch_parity",
                  "serve_decode_step", "serve_quant_decode_step",
-                 "reshard_resume"):
+                 "rlhf_rollout_step", "reshard_resume"):
         assert name in programs, name
         assert programs[name]["peak_bytes"] > 0
         assert "collective_counts" in programs[name]

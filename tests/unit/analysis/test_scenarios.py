@@ -41,7 +41,8 @@ def test_matrix_builds_expected_scenarios(matrix):
     expected = {"gpt2_fwd_bwd", "llama_fwd_bwd", "bert_fwd_bwd",
                 "moe_top1_route", "moe_top2_route", "train_batch_parity",
                 "zero2_train_step", "zero3_train_step", "moe_ep_step",
-                "pipe_chunked_step", "pipe_1f1b_step", "serve_decode_step"}
+                "pipe_chunked_step", "pipe_1f1b_step", "serve_decode_step",
+                "rlhf_rollout_step"}
     assert expected <= set(programs) | set(skipped)
     # the pipe pipe*data*fsdp scenario is allowed to skip on the 0.4.37
     # container (the known partial-manual shard_map gap) and the

@@ -295,6 +295,16 @@ def test_sigterm_mid_serve_drains_and_exits_143(tmp_path):
     assert row["ok"], row
 
 
+def test_rlhf_sigterm_drains_and_stitches(tmp_path):
+    """graft-rlhf preemption contract under a REAL SIGTERM (subprocess):
+    in-flight rollouts drain to full budget and are banked (zero dropped),
+    the learner checkpoints at one step boundary with the loop cursors in
+    client_state, and the resumed life finishes with a stitched loss curve
+    inside RLHF_STITCH_LOSS_RTOL of an uninterrupted reference."""
+    row = fault_bench.scenario_rlhf_sigterm(str(tmp_path))
+    assert row["ok"], row
+
+
 def test_replica_sigterm_migrates_inflight_kv(tmp_path):
     """graft-fleet SIGTERM contract: every in-flight request's KV moves
     to the peer through a digest-verified bundle, nothing is dropped,

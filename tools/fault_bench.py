@@ -24,6 +24,10 @@ fails.
 | SIGKILL fleet replica  | hard-kill a replica, no drain, no bundle    | router re-admits orphaned requests on the    |
 |                        |                                             | peer at-most-once; zero dropped; bounded     |
 |                        |                                             | TTFT spike                                   |
+| SIGTERM mid RLHF loop  | real SIGTERM after >=1 learner step of the  | in-flight rollouts drained + banked (zero    |
+|                        | in-flight rollout loop (graft-rlhf)         | dropped), learner checkpoints at a boundary, |
+|                        |                                             | resumed run stitches the loss curve within   |
+|                        |                                             | RLHF_STITCH_LOSS_RTOL of uninterrupted       |
 
 Run: python tools/fault_bench.py            (scenario subset: FAULT_SCENARIOS=...)
 Tests import the scenario functions directly (tests/unit/resilience/).
@@ -574,6 +578,200 @@ def scenario_serve_drain(workdir):
                 f"rc={p.returncode} {drain}", ok)
 
 
+# -- RLHF rollout-loop preemption (graft-rlhf, subprocess) -------------------
+
+# stitched-vs-reference loss envelope (parity with RESHARD_LOSS_RTOL): the
+# cohort-aligned config below is observed bit-exact on one host — the rtol
+# absorbs cross-platform reduction-order drift only
+RLHF_STITCH_LOSS_RTOL = 2e-4
+
+_RLHF_CHILD = textwrap.dedent("""
+    import json, os, signal, sys, threading, time
+    sys.path.insert(0, {repo!r})
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir", os.path.join({repo!r}, ".jax_cache"))
+    import jax.numpy as jnp
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.serving import Request, ServingConfig
+    from deepspeed_tpu.models import GPT2LMHeadModel, get_gpt2_config
+    from deepspeed_tpu.parallel.topology import MeshTopology
+    from deepspeed_tpu.runtime.resilience.signals import PreemptionGuard
+    from deepspeed_tpu.runtime.rlhf import RolloutConfig, RolloutLoop
+
+    CKPT = sys.argv[1]
+    FAULT = os.environ.get("RLHF_FB_FAULT") == "1"
+    # cohort-aligned config: slots == train_batch_size, uniform budgets,
+    # sync_every=1 and align_cohorts=True — every request's entire decode
+    # runs under ONE weight generation, so the cohort the drain banks at
+    # SIGTERM equals the uninterrupted run's cohort bit-for-bit
+    B, TOTAL, PROMPT, NEW = 4, 16, 8, 16
+
+    cfg = get_gpt2_config("test", n_layer=2, n_positions=PROMPT + NEW)
+
+    def loss_fn(logits, batch):
+        adv = batch["advantage"]
+        mask = batch["mask"].astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+        tgt = jnp.take_along_axis(logp, batch["rollouts"][:, 1:, None],
+                                  axis=-1)[..., 0]
+        return -(adv[:, None] * tgt * mask[:, 1:]).sum() / jnp.maximum(
+            mask[:, 1:].sum(), 1.0)
+
+    ds = {{"train_batch_size": B,
+           "optimizer": {{"type": "AdamW", "params": {{"lr": 1e-4}}}},
+           "zero_optimization": {{"stage": 3,
+                                  "stage3_param_persistence_threshold": 0}},
+           "hybrid_engine": {{"enabled": True, "max_out_tokens": PROMPT + NEW,
+                              "inference_tp_size": 1}},
+           "steps_per_print": 10**9}}
+    # pin to ONE device regardless of any inherited
+    # --xla_force_host_platform_device_count (pytest's conftest forces 8):
+    # train_batch_size=B must stay whole on one data rank, and the
+    # checkpoint layout must be identical across every life
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(cfg), config=ds, loss_fn=loss_fn,
+        topology=MeshTopology(data=1, fsdp=1, devices=jax.devices()[:1]))
+
+    def pad(pairs, adv):
+        width = PROMPT + NEW
+        toks = np.zeros((len(pairs), width), np.int32)
+        mask = np.zeros((len(pairs), width), np.float32)
+        for j, (p, o) in enumerate(pairs):
+            seq = np.concatenate([np.asarray(p, np.int32),
+                                  np.asarray(o, np.int32)])[:width]
+            toks[j, :len(seq)] = seq
+            mask[j, len(p):len(seq)] = 1.0
+        return {{"input_ids": toks, "rollouts": toks, "advantage": adv,
+                 "mask": mask}}
+
+    def make_batch(exps):
+        pairs = [(np.asarray(e.prompt, np.int32),
+                  np.asarray(e.output, np.int32)) for e in exps]
+        reward = np.asarray([(np.asarray(o) % 2 == 0).mean()
+                             for _, o in pairs], np.float32)
+        return pad(pairs, reward - reward.mean())
+
+    def prompt_fn(i):
+        r = np.random.RandomState(1234 + i)
+        return Request(prompt=r.randint(0, cfg.vocab_size,
+                                        size=(PROMPT,)).astype(np.int32),
+                       max_new_tokens=NEW)
+
+    engine.initialize_state(pad([(np.zeros(PROMPT, np.int32),
+                                  np.zeros(0, np.int32))] * B,
+                                np.zeros(B, np.float32)))
+    tag, client_state = engine.resume(CKPT)
+    guard = PreemptionGuard().install()
+    loop = RolloutLoop(engine, prompt_fn, make_batch,
+                       RolloutConfig(train_batch_size=B, total_rollouts=TOTAL,
+                                     sync_every=1, checkpoint_dir=CKPT,
+                                     align_cohorts=True),
+                       serving_config=ServingConfig(slots=B,
+                                                    prefill_chunk=PROMPT))
+    resumed = loop.restore(client_state)
+    if FAULT:
+        def _arm():
+            # a REAL SIGTERM through the flag-only handler, delivered once
+            # the learner has stepped so the stitch spans a train/sync
+            # boundary (deterministic landing; the external-delivery path
+            # is already proven by sigterm_mid_serve)
+            while engine.global_steps < 1:
+                time.sleep(0.002)
+            os.kill(os.getpid(), signal.SIGTERM)
+        threading.Thread(target=_arm, daemon=True).start()
+    print("RLHF_READY", flush=True)
+    res = loop.run(guard=guard, max_ticks=10**6)
+    sync = (res["sync_evidence"] or [{{}}])[-1]
+    print("RLHF_EXIT " + json.dumps({{
+        "rc": res["exit_code"], "learner_steps": res["learner_steps"],
+        "consumed": res["experience_consumed"],
+        "banked": res["experience_banked"], "dropped": res["dropped"],
+        "drained": res.get("drained", 0),
+        "refused": res.get("refused_queued", 0),
+        "checkpoint_tag": res.get("checkpoint_tag"), "resumed": resumed,
+        "resumed_tag": tag, "sync_generation": res["weight_sync_generation"],
+        "gather_bytes": sync.get("gather_bytes"),
+        "digest_verified": bool(sync.get("digest")),
+        "losses": {{str(r["step"]): float(r["loss"]).hex()
+                    for r in res["losses"]}}}}), flush=True)
+    sys.exit(res["exit_code"])
+""")
+
+
+def _rlhf_life(workdir, ckpt, fault, name):
+    """One child life of the rollout loop; returns (rc, RLHF_EXIT row, stderr)."""
+    from envutil import cpu_subprocess_env
+    env = cpu_subprocess_env()
+    env["RLHF_FB_FAULT"] = "1" if fault else "0"
+    err_path = os.path.join(workdir, f"rlhf_{name}.stderr")
+    with open(err_path, "w") as err_fh:
+        p = subprocess.run([PY, "-c", _RLHF_CHILD.format(repo=REPO), ckpt],
+                           env=env, stdout=subprocess.PIPE, stderr=err_fh,
+                           text=True, cwd=REPO, timeout=600)
+    row = None
+    for line in p.stdout.splitlines():
+        if line.startswith("RLHF_EXIT "):
+            row = json.loads(line[len("RLHF_EXIT "):])
+    return p.returncode, row, open(err_path).read()
+
+
+def scenario_rlhf_sigterm(workdir):
+    """SIGTERM mid rollout loop (graft-rlhf): in-flight rollouts must drain
+    through the PR-14 path (zero dropped — every one banked as experience),
+    the learner checkpoints at one step boundary with the loop cursors in
+    client_state, and a resumed life finishes the run with a stitched loss
+    curve inside RLHF_STITCH_LOSS_RTOL of an uninterrupted reference."""
+    total_steps = 4                      # TOTAL // B in the child
+    ckpt = os.path.join(workdir, "rlhf_ckpt")
+    rc1, life1, err1 = _rlhf_life(workdir, ckpt, fault=True, name="life1")
+    if rc1 != 143 or life1 is None:
+        return _row("rlhf_sigterm", "life 1 drains and exits 143",
+                    f"rc={rc1} row={life1} stderr: {err1[-200:]}", False)
+    rc2, life2, err2 = _rlhf_life(workdir, ckpt, fault=False, name="life2")
+    if rc2 != 0 or life2 is None:
+        return _row("rlhf_sigterm", "life 2 resumes and finishes",
+                    f"rc={rc2} row={life2} stderr: {err2[-200:]}", False)
+    rc3, ref, err3 = _rlhf_life(workdir, os.path.join(workdir, "rlhf_ref"),
+                                fault=False, name="ref")
+    if rc3 != 0 or ref is None:
+        return _row("rlhf_sigterm", "uninterrupted reference finishes",
+                    f"rc={rc3} row={ref} stderr: {err3[-200:]}", False)
+    stitched = dict(life1["losses"])
+    stitched.update(life2["losses"])
+    worst = float("inf")
+    bit_exact = False
+    if stitched.keys() == ref["losses"].keys():
+        worst, bit_exact = 0.0, True
+        for k, ref_hex in ref["losses"].items():
+            a, b = float.fromhex(stitched[k]), float.fromhex(ref_hex)
+            bit_exact = bit_exact and a == b
+            worst = max(worst, abs(a - b) / max(abs(b), 1e-12))
+    # life 2's learner_steps is the CUMULATIVE cursor (restored at resume),
+    # so it must land exactly on the target; its losses list holds only the
+    # steps trained this life and must be disjoint from life 1's
+    ok = (life1["dropped"] == 0
+          and 1 <= life1["learner_steps"] < total_steps
+          and life1["checkpoint_tag"] and life2["resumed"]
+          and life2["learner_steps"] == total_steps
+          and not set(life1["losses"]) & set(life2["losses"])
+          and life1["gather_bytes"] is not None and life1["digest_verified"]
+          and worst <= RLHF_STITCH_LOSS_RTOL)
+    return _row("rlhf_sigterm",
+                "drain zero dropped, exit 143, resumed learner stitches the "
+                f"loss curve within rtol {RLHF_STITCH_LOSS_RTOL}",
+                f"rc={rc1} steps={life1['learner_steps']}+"
+                f"{life2['learner_steps']} dropped={life1['dropped']} "
+                f"drained={life1['drained']} refused={life1['refused']} "
+                f"banked={life1['banked']} worst_rel={worst:.2e} "
+                f"bit_exact={bit_exact}", ok,
+                checkpoint_tag=life1["checkpoint_tag"],
+                sync_generation=life2["sync_generation"],
+                gather_bytes=life1["gather_bytes"])
+
+
 # -- fleet migration scenarios (graft-fleet, in-process) ---------------------
 #
 # Deliberately LocalReplica-based: the SIGTERM/SIGKILL paths these assert
@@ -764,6 +962,7 @@ def scenario_replica_sigkill_readmit(workdir):
 SCENARIOS = {
     "torn_save": scenario_torn_save,
     "serve_drain": scenario_serve_drain,
+    "rlhf_sigterm": scenario_rlhf_sigterm,
     "replica_sigterm_migrate": scenario_replica_sigterm_migrate,
     "replica_sigterm_shared_prefix": scenario_replica_sigterm_shared_prefix,
     "replica_sigkill_readmit": scenario_replica_sigkill_readmit,

@@ -492,6 +492,58 @@ def run_serve_rung(tag, serve_env, retry_evidence=None):
                            f"{p.stderr[-400:]}")
 
 
+#: graft-rlhf rungs (ISSUE 20): tools/rlhf_bench.py on the SAME indexed
+#: prompt trace + per-rollout budget mix, in-flight loop vs serial
+#: generate-then-train. ``rlhf_overlap_on`` emits the A/B pair + ratio
+#: row in one process (both arms must bank identical experience tokens —
+#: the bench asserts it); ``rlhf_overlap_off`` re-measures the serial arm
+#: alone so a window can re-baseline without paying the loop. Rows carry
+#: the planner-priced weight-sync evidence (gather_bytes per sync,
+#: digest_verified) and the run dirs stamp rlhf_rollout / rlhf_learner
+#: calibration headers (the rlhf_overlap marker collect_samples keys on).
+RLHF_RUNGS = {
+    "rlhf_overlap_on": {"RLHF_MODE": "ab", "RLHF_BATCH": "8",
+                        "RLHF_PROMPT": "64", "RLHF_NEW": "64",
+                        "RLHF_ROLLOUTS": "32", "RLHF_SLOTS": "8",
+                        "RLHF_SYNC_EVERY": "1"},
+    "rlhf_overlap_off": {"RLHF_MODE": "off", "RLHF_BATCH": "8",
+                         "RLHF_PROMPT": "64", "RLHF_NEW": "64",
+                         "RLHF_ROLLOUTS": "32", "RLHF_SLOTS": "8",
+                         "RLHF_SYNC_EVERY": "1"},
+}
+
+
+def run_rlhf_rung(tag, rlhf_env, retry_evidence=None):
+    """One graft-rlhf rung: tools/rlhf_bench.py in a clean subprocess
+    (its own hybrid engine + scheduler; same isolation contract as the
+    serve rungs), each JSON row re-emitted with the rung tag and retry
+    evidence. Never wrapped in `timeout` (bench contract)."""
+    import subprocess
+    import tempfile
+    env = dict(os.environ)
+    env.setdefault("RLHF_MODEL", "350m")
+    env.setdefault("RLHF_TELEMETRY",
+                   tempfile.mkdtemp(prefix=f"rlhf_ladder_{tag}_"))
+    env.update(rlhf_env)
+    p = subprocess.run([sys.executable,
+                        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                     "rlhf_bench.py")],
+                       env=env, capture_output=True, text=True)
+    emitted = 0
+    for line in p.stdout.splitlines():
+        if line.startswith("{"):
+            row = json.loads(line)
+            print(json.dumps(dict({"tag": tag}, **row,
+                                  telemetry_dir=env["RLHF_TELEMETRY"],
+                                  **(retry_evidence or {}))), flush=True)
+            emitted += 1
+        elif line.startswith("#"):
+            print(line, flush=True)
+    if p.returncode != 0 or not emitted:
+        raise RuntimeError(f"rlhf rung {tag} failed rc={p.returncode}: "
+                           f"{p.stderr[-400:]}")
+
+
 def _frontier_rungs():
     """Rungs generated FROM the committed graft-search Pareto frontier
     (analysis_results/search_pareto.json, 350m_judged space): the next
@@ -607,6 +659,9 @@ def main():
         try:
             if tag.strip() in SERVE_RUNGS:
                 policy.call(run_serve_rung, tag, SERVE_RUNGS[tag.strip()],
+                            retry_evidence=evidence, before_attempt=attempt)
+            elif tag.strip() in RLHF_RUNGS:
+                policy.call(run_rlhf_rung, tag, RLHF_RUNGS[tag.strip()],
                             retry_evidence=evidence, before_attempt=attempt)
             else:
                 policy.call(run_rung, tag, retry_evidence=evidence,
